@@ -1,0 +1,90 @@
+"""cluster_anywhere_tpu: a TPU-native distributed computing framework.
+
+Same capability surface as the reference system surveyed in SURVEY.md (tasks,
+actors, a distributed object store, placement groups, and ML libraries for
+data/train/tune/serve), designed TPU-first: the tensor plane is JAX/XLA —
+sharded `jax.Array`s are first-class objects (DeviceRef) that never leave the
+accelerator; parallelism strategies (DP/FSDP/TP/PP/SP/EP, ring attention,
+Ulysses) are first-class in `cluster_anywhere_tpu.parallel`.
+
+Keep this module import-light: jax is only imported when the tensor-plane
+modules (`parallel`, `ops`, `models`) are used.
+"""
+
+from ._version import version as __version__
+from .core import errors as exceptions
+from .core.actor import ActorHandle, exit_actor, get_actor, kill
+from .core.api import (
+    available_resources,
+    cluster_resources,
+    cluster_stats,
+    get,
+    init,
+    is_initialized,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .core.errors import (
+    ActorDiedError,
+    ActorError,
+    CAError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .core.object_ref import DeviceRef, ObjectRef
+from .core.placement import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from .core.runtime_context import get_runtime_context
+from .core.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadSchedulingStrategy,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "put",
+    "get",
+    "wait",
+    "remote",
+    "ObjectRef",
+    "DeviceRef",
+    "ActorHandle",
+    "get_actor",
+    "kill",
+    "exit_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "cluster_stats",
+    "placement_group",
+    "remove_placement_group",
+    "placement_group_table",
+    "PlacementGroup",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "SpreadSchedulingStrategy",
+    "get_runtime_context",
+    "exceptions",
+    "CAError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "WorkerCrashedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "TaskCancelledError",
+]

@@ -1,0 +1,122 @@
+"""Actor API: @remote on classes (analogue of python/ray/actor.py).
+
+ActorClass.remote() registers + places the actor via the head; ActorHandle
+holds the actor id and submits method calls directly to the hosting worker.
+Handles are serializable and can be passed to tasks/other actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from .ids import ActorID
+from .object_ref import ObjectRef
+from .remote_function import _normalize_pg
+from .worker import global_worker
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus",
+    "num_tpus",
+    "resources",
+    "name",
+    "lifetime",
+    "max_restarts",
+    "max_task_retries",
+    "max_concurrency",
+    "placement_group",
+    "placement_group_bundle_index",
+    "scheduling_strategy",
+    "runtime_env",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        return self._handle._submit(
+            self._method_name, args, kwargs, {"num_returns": self._num_returns}
+        )
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def _submit(self, method: str, args, kwargs, opts: Dict[str, Any]):
+        w = global_worker()
+        merged = {"max_task_retries": self._max_task_retries, **opts}
+        refs = w.submit_actor_task(self._actor_id, method, args, kwargs, merged)
+        return refs[0] if merged.get("num_returns", 1) == 1 else refs
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
+
+
+class ActorClass:
+    def __init__(self, cls, default_options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._default_options = default_options or {}
+        unknown = set(self._default_options) - _VALID_ACTOR_OPTIONS
+        if unknown:
+            raise ValueError(f"unknown actor option(s): {sorted(unknown)}")
+        self.__name__ = getattr(cls, "__name__", "ActorClass")
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._default_options)
+
+    def options(self, **opts) -> "ActorClass":
+        unknown = set(opts) - _VALID_ACTOR_OPTIONS
+        if unknown:
+            raise ValueError(f"unknown actor option(s): {sorted(unknown)}")
+        return ActorClass(self._cls, {**self._default_options, **opts})
+
+    def _remote(self, args, kwargs, opts) -> ActorHandle:
+        w = global_worker()
+        actor_id, _addr = w.create_actor(self._cls, args, kwargs, _normalize_pg(opts))
+        return ActorHandle(actor_id, max_task_retries=opts.get("max_task_retries", 0))
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self.__name__!r} cannot be instantiated directly; "
+            f"use .remote()"
+        )
+
+    @property
+    def underlying(self):
+        return self._cls
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a named actor (python/ray/_private/worker.py get_actor)."""
+    w = global_worker()
+    info = w.get_actor_info(name=name)
+    return ActorHandle(ActorID.from_hex(info["actor_id"]))
+
+
+def kill(actor: ActorHandle, no_restart: bool = True):
+    global_worker().kill_actor(actor._actor_id, no_restart)
+
+
+def exit_actor():
+    """Terminate the current actor from inside one of its methods."""
+    raise SystemExit(0)

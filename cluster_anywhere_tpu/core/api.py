@@ -1,0 +1,211 @@
+"""Top-level API: init/shutdown/remote/get/put/wait and cluster introspection
+(analogue of python/ray/_private/worker.py's public functions).
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .actor import ActorClass
+from .config import CAConfig, get_config, set_config
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction
+from .worker import Worker, global_worker, set_global_worker, try_global_worker
+
+_head_proc: Optional[subprocess.Popen] = None
+_session_dir: Optional[str] = None
+
+
+def is_initialized() -> bool:
+    return try_global_worker() is not None
+
+
+def _sweep_stale_sessions(root: str):
+    """GC session dirs (and their /dev/shm segments) whose head process is
+    gone — hard-killed clusters can't clean up after themselves."""
+    import shutil
+
+    for name in os.listdir(root):
+        path = os.path.join(root, name)
+        if not name.startswith("session_"):
+            continue
+        ready = os.path.join(path, "head.ready")
+        pid = None
+        try:
+            pid = int(open(ready).read().strip())
+        except (OSError, ValueError):
+            # head.ready not written yet: a concurrent init may own this dir —
+            # only sweep if it has been around a while
+            try:
+                if time.time() - os.path.getmtime(path) < 120:
+                    continue
+            except OSError:
+                continue
+        alive = False
+        if pid is not None:
+            try:
+                os.kill(pid, 0)
+                alive = True
+            except (ProcessLookupError, PermissionError):
+                pass
+        if not alive:
+            shutil.rmtree(path, ignore_errors=True)
+            shutil.rmtree(os.path.join("/dev/shm", name), ignore_errors=True)
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    config: Optional[CAConfig] = None,
+    session_dir: Optional[str] = None,
+    **config_overrides,
+) -> Dict[str, Any]:
+    """Start a local cluster (head + worker pool) and connect this process as
+    the driver.  Mirrors ray.init (python/ray/_private/worker.py:1275)."""
+    global _head_proc, _session_dir
+    if is_initialized():
+        raise RuntimeError("already initialized; call shutdown() first")
+    cfg = config or CAConfig()
+    for k, v in config_overrides.items():
+        if not hasattr(cfg, k):
+            raise ValueError(f"unknown config key {k!r}")
+        setattr(cfg, k, v)
+    if object_store_memory is not None:
+        cfg.object_store_memory = object_store_memory
+    set_config(cfg)
+
+    if num_cpus is None:
+        num_cpus = min(os.cpu_count() or 4, 16)
+    total: Dict[str, float] = {"CPU": float(num_cpus)}
+    if num_tpus is None:
+        # detect TPU chips without importing jax (env marker or /dev entries)
+        num_tpus = int(os.environ.get("CA_NUM_TPUS", "0"))
+    if num_tpus:
+        total["TPU"] = float(num_tpus)
+    total["memory"] = float(cfg.object_store_memory)
+    if resources:
+        total.update({k: float(v) for k, v in resources.items()})
+
+    if session_dir is None:
+        root = cfg.session_dir_root
+        os.makedirs(root, exist_ok=True)
+        _sweep_stale_sessions(root)
+        session_dir = os.path.join(root, f"session_{int(time.time()*1000)}_{os.getpid()}")
+    os.makedirs(session_dir, exist_ok=True)
+    _session_dir = session_dir
+
+    env = dict(os.environ)
+    env["CA_SESSION_DIR"] = session_dir
+    env["CA_CONFIG_JSON"] = cfg.to_json()
+    env["CA_RESOURCES"] = json.dumps(total)
+    # child processes must find this package regardless of the driver's cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    head_log = open(os.path.join(session_dir, "head.log"), "ab")
+    _head_proc = subprocess.Popen(
+        [sys.executable, "-m", "cluster_anywhere_tpu.core.head"],
+        env=env,
+        stdout=head_log,
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+    )
+    head_log.close()
+    ready = os.path.join(session_dir, "head.ready")
+    deadline = time.monotonic() + 30
+    while not os.path.exists(ready):
+        if _head_proc.poll() is not None:
+            raise RuntimeError(
+                f"head process exited with {_head_proc.returncode}; "
+                f"see {session_dir}/head.log"
+            )
+        if time.monotonic() > deadline:
+            raise RuntimeError("timed out waiting for head to start")
+        time.sleep(0.01)
+
+    w = Worker(
+        mode="driver",
+        session_dir=session_dir,
+        head_sock=os.path.join(session_dir, "head.sock"),
+        config=cfg,
+    )
+    set_global_worker(w)
+    w.connect()
+    return {"session_dir": session_dir, "node_id": w.node_id, "resources": total}
+
+
+def shutdown():
+    global _head_proc, _session_dir
+    w = try_global_worker()
+    if w is not None:
+        w.shutdown(stop_cluster=True)
+    if _head_proc is not None:
+        try:
+            _head_proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            _head_proc.kill()
+            _head_proc.wait(timeout=5)
+        _head_proc = None
+    _session_dir = None
+
+
+def put(value: Any) -> ObjectRef:
+    return global_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    return global_worker().get(refs, timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+):
+    return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes, with or without options:
+    @remote / @remote(num_cpus=2, num_returns=2)."""
+
+    def make(obj, opts):
+        if inspect.isclass(obj):
+            return ActorClass(obj, opts)
+        if callable(obj):
+            return RemoteFunction(obj, opts)
+        raise TypeError("@remote must decorate a function or class")
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or inspect.isclass(args[0])):
+        return make(args[0], {})
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return lambda obj: make(obj, kwargs)
+
+
+def nodes() -> List[dict]:
+    return global_worker().head_call("nodes")["nodes"]
+
+
+def cluster_resources() -> Dict[str, float]:
+    return global_worker().head_call("cluster_resources")["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    return global_worker().head_call("cluster_resources")["available"]
+
+
+def cluster_stats() -> Dict[str, Any]:
+    return global_worker().head_call("stats")["stats"]
+
+
+def timeline() -> List[dict]:
+    return []  # populated by the task-event milestone

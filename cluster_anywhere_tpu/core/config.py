@@ -1,0 +1,95 @@
+"""Central tunables table, the analogue of the reference's RAY_CONFIG macro
+table (src/ray/common/ray_config_def.h): every knob has a typed default and an
+environment-variable override `CA_<NAME>`.  The resolved config dict is handed
+to every spawned process so the whole cluster agrees on values.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "CA_"
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(_ENV_PREFIX + name.upper())
+    if raw is None:
+        return default
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes")
+    if t is int:
+        return int(raw)
+    if t is float:
+        return float(raw)
+    return raw
+
+
+@dataclass
+class CAConfig:
+    # --- object store ---
+    inline_object_max_bytes: int = 100 * 1024  # larger objects go to shm
+    object_store_memory: int = 2 * 1024**3  # shm budget per node
+    shm_parallel_copy_threshold: int = 8 * 1024**2  # use parallel memcpy above
+    shm_copy_threads: int = 8
+
+    # --- scheduler / leases ---
+    max_leases_per_shape: int = 64  # cap on concurrently held leases per resource shape
+    lease_idle_timeout_s: float = 1.0  # return leases idle longer than this
+    max_inflight_per_lease: int = 4  # pipelined task pushes per leased worker
+    worker_prestart: bool = True
+    scheduler_spread_threshold: float = 0.5  # hybrid policy: pack below, spread above
+
+    # --- health / failure detection ---
+    health_check_period_s: float = 2.0
+    health_check_failure_threshold: int = 5
+    worker_register_timeout_s: float = 30.0
+
+    # --- tasks / actors ---
+    default_max_retries: int = 3
+    default_actor_max_restarts: int = 0
+    actor_restart_backoff_s: float = 0.2
+    push_timeout_s: float = 60.0
+
+    # --- misc ---
+    session_dir_root: str = "/tmp/ca_tpu"
+    log_to_driver: bool = True
+    event_buffer_flush_period_s: float = 1.0
+    metrics_report_period_s: float = 5.0
+    # deterministic RPC fault injection, modeled on the reference's
+    # RAY_testing_rpc_failure (src/ray/rpc/rpc_chaos.h): "method=N" pairs,
+    # failing the first N matching RPCs.
+    testing_rpc_failure: str = ""
+
+    def __post_init__(self):
+        for f in fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, s: str) -> "CAConfig":
+        cfg = cls.__new__(cls)
+        data = json.loads(s)
+        for f in fields(cls):
+            setattr(cfg, f.name, data.get(f.name, f.default))
+        return cfg
+
+
+_global_config: CAConfig | None = None
+
+
+def get_config() -> CAConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = CAConfig()
+    return _global_config
+
+
+def set_config(cfg: CAConfig) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,66 @@
+"""Exception hierarchy, mirroring the user-visible error surface of the
+reference (python/ray/exceptions.py): task errors wrap the remote traceback,
+actor errors mark dead actors, object-loss and timeout errors are distinct.
+"""
+
+from __future__ import annotations
+
+
+class CAError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(CAError):
+    """A remote task raised an exception. Re-raised at `get()` on the caller,
+    carrying the remote traceback as text."""
+
+    def __init__(self, cause_repr: str, traceback_str: str = ""):
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+        super().__init__(cause_repr)
+
+    def __str__(self):
+        if self.traceback_str:
+            return f"{self.cause_repr}\n\n--- remote traceback ---\n{self.traceback_str}"
+        return self.cause_repr
+
+
+class WorkerCrashedError(CAError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(CAError):
+    """Generic actor-related failure."""
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead (crashed, killed, or out of restart budget); pending
+    and future calls fail with this."""
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(CAError):
+    """Object data is unavailable and could not be recovered."""
+
+
+class GetTimeoutError(CAError, TimeoutError):
+    """`get()` exceeded its timeout."""
+
+
+class TaskCancelledError(CAError):
+    """The task was cancelled before/while running."""
+
+
+class RuntimeEnvSetupError(CAError):
+    """Preparing the runtime environment for a task/actor failed."""
+
+
+class ObjectStoreFullError(CAError):
+    """The shared-memory object store could not allocate."""
+
+
+class PlacementGroupError(CAError):
+    """Placement group could not be created or was removed."""

@@ -1,0 +1,868 @@
+"""Head control plane ("GCS" analogue).
+
+One process per cluster.  Owns cluster metadata and cluster-wide decisions,
+mirroring the subsystem split of the reference's GCS server
+(src/ray/gcs/gcs_server/gcs_server.h): node/worker tables, worker pool,
+resource accounting + lease scheduler, actor directory with restart FSM,
+placement groups, namespaced KV, pubsub, object directory with refcount GC,
+and health checking.  Workers and drivers talk to it over the msgpack unix
+socket protocol (protocol.py); the hot task path does NOT go through the head
+— drivers lease workers and push tasks directly (normal_task_submitter.h
+lease model).
+
+This is the Python reference implementation of the control plane; the C++
+port (native/) replaces it subsystem-by-subsystem behind the same protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .config import CAConfig
+from .errors import ActorDiedError, PlacementGroupError
+from .protocol import Connection, Server, connect_unix, write_frame
+
+# --------------------------------------------------------------------------
+# state records
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class WorkerRec:
+    worker_id: str
+    pid: int
+    addr: str  # unix socket path it serves
+    proc: Optional[subprocess.Popen] = None
+    state: str = "starting"  # starting | idle | leased | actor | dead
+    purpose: str = "pool"  # pool | actor — actor workers never join the idle pool
+    pool: str = "cpu"  # cpu | tpu — tpu workers keep the accelerator runtime env
+    lease_id: Optional[str] = None
+    actor_id: Optional[str] = None
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    blocked: bool = False  # blocked in get(); its cpus are released
+
+
+@dataclass
+class ActorRec:
+    actor_id: str
+    name: Optional[str]
+    fn_id: bytes
+    init_spec: bytes  # packed (args, kwargs, options)
+    resources: Dict[str, float]
+    max_restarts: int
+    restarts_used: int = 0
+    incarnation: int = 0
+    state: str = "pending"  # pending | alive | restarting | dead
+    worker_id: Optional[str] = None
+    addr: Optional[str] = None
+    detached: bool = False
+    max_concurrency: int = 1
+    death_cause: str = ""
+    pg_id: Optional[str] = None
+    bundle_index: int = -1
+
+
+@dataclass
+class ObjectRec:
+    oid: bytes
+    shm_name: Optional[str]
+    size: int
+    owner: str  # client id of owner process
+    holders: set = field(default_factory=set)  # client ids holding refs
+    owner_released: bool = False
+
+
+@dataclass
+class LeaseReq:
+    shape: Dict[str, float]
+    reply: Any
+    reply_err: Any
+    client: str
+    pg_id: Optional[str] = None
+    bundle_index: int = -1
+
+
+@dataclass
+class BundleRec:
+    resources: Dict[str, float]
+    used: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class PGRec:
+    pg_id: str
+    bundles: List[BundleRec]
+    strategy: str
+    state: str = "created"  # single-node: reservations either fit or error
+
+
+# --------------------------------------------------------------------------
+
+
+class Head:
+    def __init__(self, session_dir: str, config: CAConfig, resources: Dict[str, float]):
+        self.session_dir = session_dir
+        self.session_name = os.path.basename(session_dir)
+        self.config = config
+        self.sock_path = os.path.join(session_dir, "head.sock")
+        self.node_id = os.urandom(8).hex()
+        # -- resources (single node round 1; table keyed by node for the
+        # multi-node milestone) --
+        self.total_resources = dict(resources)
+        self.avail = dict(resources)
+        # -- tables --
+        self.workers: Dict[str, WorkerRec] = {}
+        self.actors: Dict[str, ActorRec] = {}
+        self.named_actors: Dict[str, str] = {}
+        self.objects: Dict[bytes, ObjectRec] = {}
+        # refs reported before obj_created arrived (cross-socket ordering)
+        self._early_refs: Dict[bytes, set] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}
+        self.pgs: Dict[str, PGRec] = {}
+        # -- worker pool (keyed: cpu workers strip the TPU runtime env for
+        # fast start and to keep the chip free; tpu workers keep it) --
+        self.idle_workers: Dict[str, deque] = {"cpu": deque(), "tpu": deque()}
+        self.pending_leases: deque[LeaseReq] = deque()
+        self.leases: Dict[str, str] = {}  # lease_id -> worker_id
+        self._lease_shapes: Dict[str, Dict[str, float]] = {}
+        self._lease_pg: Dict[str, tuple] = {}  # lease_id -> (pg_id, bundle_index)
+        self._spawn_count = 0
+        self.max_workers = int(resources.get("CPU", 4)) * 4 + 4
+        # -- conns --
+        self._worker_conns: Dict[str, Connection] = {}
+        self._clients: Dict[str, dict] = {}  # client_id -> conn state
+        self._register_waiters: Dict[str, asyncio.Future] = {}
+        self.subscribers: Dict[str, List[Any]] = {}  # channel -> [writer]
+        self.server = Server(self.sock_path, self._handle, self._on_disconnect)
+        self.stats = {
+            "leases_granted": 0,
+            "tasks_pushed": 0,
+            "actors_created": 0,
+            "actor_restarts": 0,
+            "objects_created": 0,
+            "objects_gc": 0,
+            "workers_spawned": 0,
+        }
+        self._shutdown = asyncio.Event()
+        self._driver_clients: set = set()
+
+    # ---------------------------------------------------------------- utils
+    def _pub(self, channel: str, data: dict):
+        dead = []
+        for w in self.subscribers.get(channel, []):
+            try:
+                write_frame(w, {"m": "pub", "ch": channel, "data": data})
+            except Exception:
+                dead.append(w)
+        for w in dead:
+            self.subscribers[channel].remove(w)
+
+    def _fits(self, avail: Dict[str, float], shape: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in shape.items())
+
+    def _take(self, avail: Dict[str, float], shape: Dict[str, float]):
+        for k, v in shape.items():
+            avail[k] = avail.get(k, 0.0) - v
+
+    def _give(self, avail: Dict[str, float], shape: Dict[str, float]):
+        for k, v in shape.items():
+            avail[k] = avail.get(k, 0.0) + v
+
+    # ------------------------------------------------------------ worker pool
+    def _spawn_worker(self, purpose: str = "pool", pool: str = "cpu") -> WorkerRec:
+        self._spawn_count += 1
+        wid = f"w{self._spawn_count:04d}"
+        addr = os.path.join(self.session_dir, f"{wid}.sock")
+        log_path = os.path.join(self.session_dir, f"{wid}.log")
+        env = dict(os.environ)
+        env["CA_SESSION_DIR"] = self.session_dir
+        env["CA_HEAD_SOCK"] = self.sock_path
+        env["CA_WORKER_ID"] = wid
+        env["CA_WORKER_SOCK"] = addr
+        env["CA_CONFIG_JSON"] = self.config.to_json()
+        if pool != "tpu":
+            # CPU workers must not grab the accelerator: drop the TPU runtime
+            # hook (which also costs ~2s of jax import at interpreter start)
+            # and pin jax to the host platform if user code imports it.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        logf = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_anywhere_tpu.core.workerproc"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        logf.close()
+        rec = WorkerRec(
+            worker_id=wid, pid=proc.pid, addr=addr, proc=proc, purpose=purpose, pool=pool
+        )
+        self.workers[wid] = rec
+        self.stats["workers_spawned"] += 1
+        return rec
+
+    async def _worker_conn(self, rec: WorkerRec) -> Connection:
+        conn = self._worker_conns.get(rec.worker_id)
+        if conn is None or conn.closed:
+            conn = await connect_unix(rec.addr)
+            self._worker_conns[rec.worker_id] = conn
+        return conn
+
+    async def _wait_registered(self, rec: WorkerRec) -> bool:
+        if rec.state != "starting":
+            return rec.state != "dead"
+        fut = self._register_waiters.setdefault(
+            rec.worker_id, asyncio.get_running_loop().create_future()
+        )
+        try:
+            await asyncio.wait_for(fut, self.config.worker_register_timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    @staticmethod
+    def _pool_key(shape: Dict[str, float]) -> str:
+        return "tpu" if shape.get("TPU") else "cpu"
+
+    def _ensure_pool(self):
+        """Prestart/grow each pool when demand outstrips idle workers."""
+        n_alive = sum(1 for w in self.workers.values() if w.state != "dead")
+        for pool in ("cpu", "tpu"):
+            want = sum(
+                1 for r in self.pending_leases if self._pool_key(r.shape) == pool
+            ) - len(self.idle_workers[pool])
+            want -= sum(
+                1
+                for w in self.workers.values()
+                if w.state == "starting" and w.purpose == "pool" and w.pool == pool
+            )
+            while want > 0 and n_alive < self.max_workers:
+                self._spawn_worker(pool=pool)
+                want -= 1
+                n_alive += 1
+
+    # ------------------------------------------------------------- scheduler
+    def _bundle_avail(self, pg_id: str, bundle_index: int) -> Optional[Dict[str, float]]:
+        pg = self.pgs.get(pg_id)
+        if pg is None or not (0 <= bundle_index < len(pg.bundles)):
+            return None
+        b = pg.bundles[bundle_index]
+        return {k: v - b.used.get(k, 0.0) for k, v in b.resources.items()}
+
+    def _try_grant(self, req: LeaseReq) -> bool:
+        # resource admission: from a PG bundle or the node pool
+        if req.pg_id:
+            avail = self._bundle_avail(req.pg_id, req.bundle_index)
+            if avail is None:
+                req.reply_err(PlacementGroupError(f"placement group {req.pg_id} not found"))
+                return True
+            if not self._fits(avail, req.shape):
+                return False
+        elif not self._fits(self.avail, req.shape):
+            return False
+        pool = self.idle_workers[self._pool_key(req.shape)]
+        if not pool:
+            return False
+        wid = pool.popleft()
+        rec = self.workers.get(wid)
+        if rec is None or rec.state != "idle":
+            return self._try_grant(req)
+        if req.pg_id:
+            b = self.pgs[req.pg_id].bundles[req.bundle_index]
+            for k, v in req.shape.items():
+                b.used[k] = b.used.get(k, 0.0) + v
+        else:
+            self._take(self.avail, req.shape)
+        lease_id = f"l{os.urandom(6).hex()}"
+        rec.state = "leased"
+        rec.lease_id = lease_id
+        self.leases[lease_id] = wid
+        self._lease_shapes[lease_id] = dict(req.shape)
+        if req.pg_id:
+            self._lease_pg[lease_id] = (req.pg_id, req.bundle_index)
+        self.stats["leases_granted"] += 1
+        req.reply(lease_id=lease_id, worker_id=wid, addr=rec.addr)
+        return True
+
+    def _service_queue(self):
+        made_progress = True
+        while made_progress and self.pending_leases:
+            made_progress = False
+            for _ in range(len(self.pending_leases)):
+                req = self.pending_leases.popleft()
+                if self._try_grant(req):
+                    made_progress = True
+                else:
+                    self.pending_leases.append(req)
+        self._ensure_pool()
+
+    def _release_lease(self, lease_id: str, worker_ok: bool = True):
+        wid = self.leases.pop(lease_id, None)
+        shape = self._lease_shapes.pop(lease_id, None)
+        pg = self._lease_pg.pop(lease_id, None)
+        if shape is not None:
+            if pg is not None:
+                pgrec = self.pgs.get(pg[0])
+                if pgrec is not None:
+                    b = pgrec.bundles[pg[1]]
+                    for k, v in shape.items():
+                        b.used[k] = b.used.get(k, 0.0) - v
+            else:
+                self._give(self.avail, shape)
+        if wid is not None:
+            rec = self.workers.get(wid)
+            if rec is not None and rec.state == "leased":
+                if worker_ok:
+                    rec.state = "idle"
+                    rec.lease_id = None
+                    self.idle_workers[rec.pool].append(wid)
+        self._service_queue()
+
+    # --------------------------------------------------------------- actors
+    async def _place_actor(self, a: ActorRec):
+        """Spawn a dedicated worker and run the actor creation task on it.
+        Mirrors GcsActorScheduler: lease resources, push creation, publish."""
+        if a.pg_id:
+            avail = self._bundle_avail(a.pg_id, a.bundle_index)
+            ok = avail is not None and self._fits(avail, a.resources)
+            if ok:
+                b = self.pgs[a.pg_id].bundles[a.bundle_index]
+                for k, v in a.resources.items():
+                    b.used[k] = b.used.get(k, 0.0) + v
+        else:
+            ok = self._fits(self.avail, a.resources)
+            if ok:
+                self._take(self.avail, a.resources)
+        if not ok:
+            a.state = "dead"
+            a.death_cause = "resources unavailable for actor"
+            self._pub("actors", self._actor_info(a))
+            return
+        rec = self._spawn_worker(purpose="actor", pool=self._pool_key(a.resources))
+        rec.actor_id = a.actor_id
+        a.worker_id = rec.worker_id
+        if not await self._wait_registered(rec):
+            a.state = "dead"
+            a.death_cause = "actor worker failed to start"
+            self._pub("actors", self._actor_info(a))
+            return
+        a.addr = rec.addr
+        try:
+            conn = await self._worker_conn(rec)
+            await conn.call(
+                "spawn_actor",
+                actor_id=a.actor_id,
+                fn_id=a.fn_id,
+                init_spec=a.init_spec,
+                max_concurrency=a.max_concurrency,
+                incarnation=a.incarnation,
+            )
+            a.state = "alive"
+            self.stats["actors_created"] += 1
+        except Exception as e:
+            a.state = "dead"
+            a.death_cause = f"actor __init__ failed: {e!r}"
+        self._pub("actors", self._actor_info(a))
+
+    def _actor_info(self, a: ActorRec) -> dict:
+        return {
+            "actor_id": a.actor_id,
+            "state": a.state,
+            "addr": a.addr,
+            "incarnation": a.incarnation,
+            "name": a.name,
+            "death_cause": a.death_cause,
+        }
+
+    async def _on_worker_death(self, rec: WorkerRec):
+        if rec.state == "dead":
+            return
+        prev_state = rec.state
+        rec.state = "dead"
+        fut = self._register_waiters.pop(rec.worker_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(False)
+        conn = self._worker_conns.pop(rec.worker_id, None)
+        if conn is not None:
+            await conn.close()
+        try:
+            self.idle_workers[rec.pool].remove(rec.worker_id)
+        except ValueError:
+            pass
+        if rec.blocked:
+            # its cpus were returned to the pool at block time; take them back
+            # before the lease/actor release re-adds them (double-free guard)
+            shape = None
+            if rec.lease_id:
+                shape = self._lease_shapes.get(rec.lease_id)
+            elif rec.actor_id and rec.actor_id in self.actors:
+                shape = self.actors[rec.actor_id].resources
+            cpus = (shape or {}).get("CPU", 0.0)
+            if cpus:
+                self._take(self.avail, {"CPU": cpus})
+            rec.blocked = False
+        if rec.lease_id:
+            self._release_lease(rec.lease_id, worker_ok=False)
+        if rec.actor_id:
+            a = self.actors.get(rec.actor_id)
+            if a is not None and a.state in ("alive", "restarting", "pending"):
+                # return the actor's lifetime resources
+                if a.pg_id and a.pg_id in self.pgs:
+                    b = self.pgs[a.pg_id].bundles[a.bundle_index]
+                    for k, v in a.resources.items():
+                        b.used[k] = b.used.get(k, 0.0) - v
+                else:
+                    self._give(self.avail, a.resources)
+                if a.max_restarts != 0 and (
+                    a.max_restarts < 0 or a.restarts_used < a.max_restarts
+                ):
+                    a.restarts_used += 1
+                    a.incarnation += 1
+                    a.state = "restarting"
+                    a.addr = None
+                    self.stats["actor_restarts"] += 1
+                    self._pub("actors", self._actor_info(a))
+                    await asyncio.sleep(self.config.actor_restart_backoff_s)
+                    await self._place_actor(a)
+                else:
+                    a.state = "dead"
+                    a.death_cause = a.death_cause or "actor worker died"
+                    self._drop_actor_name(a)
+                    self._pub("actors", self._actor_info(a))
+        self._service_queue()
+
+    def _drop_actor_name(self, a: ActorRec):
+        if a.name and self.named_actors.get(a.name) == a.actor_id:
+            del self.named_actors[a.name]
+
+    # --------------------------------------------------------------- objects
+    def _obj_maybe_gc(self, rec: ObjectRec):
+        if rec.owner_released and not rec.holders:
+            self.objects.pop(rec.oid, None)
+            self.stats["objects_gc"] += 1
+            if rec.shm_name:
+                path = os.path.join("/dev/shm", rec.shm_name)
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+
+    # --------------------------------------------------------------- handler
+    async def _handle(self, state, msg, reply, reply_err):
+        m = msg["m"]
+        h = getattr(self, "_h_" + m, None)
+        if h is None:
+            reply_err(ValueError(f"unknown head method {m}"))
+            return
+        await h(state, msg, reply, reply_err)
+
+    async def _h_register(self, state, msg, reply, reply_err):
+        role = msg["role"]
+        client_id = msg["client_id"]
+        state["client_id"] = client_id
+        state["role"] = role
+        self._clients[client_id] = state
+        if role == "driver":
+            self._driver_clients.add(client_id)
+        if role == "worker":
+            rec = self.workers.get(client_id)
+            if rec is None:
+                # externally started worker (future multi-node); register it
+                rec = WorkerRec(client_id, msg.get("pid", 0), msg["addr"])
+                self.workers[client_id] = rec
+            rec.last_heartbeat = time.monotonic()
+            if rec.purpose == "actor":
+                rec.state = "actor"
+            else:
+                rec.state = "idle"
+                self.idle_workers[rec.pool].append(client_id)
+            fut = self._register_waiters.pop(client_id, None)
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            self._service_queue()
+        reply(
+            node_id=self.node_id,
+            session=self.session_name,
+            resources=self.total_resources,
+        )
+
+    async def _h_heartbeat(self, state, msg, reply, reply_err):
+        rec = self.workers.get(msg.get("client_id", state.get("client_id")))
+        if rec is not None:
+            rec.last_heartbeat = time.monotonic()
+
+    async def _h_request_lease(self, state, msg, reply, reply_err):
+        req = LeaseReq(
+            shape=msg.get("shape") or {"CPU": 1.0},
+            reply=reply,
+            reply_err=reply_err,
+            client=state.get("client_id", "?"),
+            pg_id=msg.get("pg_id"),
+            bundle_index=msg.get("bundle_index", -1),
+        )
+        if not self._try_grant(req):
+            self.pending_leases.append(req)
+            self._ensure_pool()
+
+    async def _h_return_lease(self, state, msg, reply, reply_err):
+        for lid in msg["lease_ids"]:
+            self._release_lease(lid)
+
+    async def _h_worker_blocked(self, state, msg, reply, reply_err):
+        # a leased/actor worker blocked in get(): release its cpus so nested
+        # tasks can run (deadlock avoidance, as the reference raylet does when
+        # a worker blocks — local_task_manager ReleaseCpuResourcesFromBlockedWorker)
+        wid = msg.get("client_id", state.get("client_id"))
+        rec = self.workers.get(wid)
+        if rec is not None and not rec.blocked:
+            rec.blocked = True
+            shape = None
+            if rec.lease_id:
+                shape = self._lease_shapes.get(rec.lease_id)
+            elif rec.actor_id and rec.actor_id in self.actors:
+                shape = self.actors[rec.actor_id].resources
+            cpus = (shape or {}).get("CPU", 0.0)
+            if cpus:
+                self._give(self.avail, {"CPU": cpus})
+                self._service_queue()
+
+    async def _h_worker_unblocked(self, state, msg, reply, reply_err):
+        wid = msg.get("client_id", state.get("client_id"))
+        rec = self.workers.get(wid)
+        if rec is not None and rec.blocked:
+            rec.blocked = False
+            shape = None
+            if rec.lease_id:
+                shape = self._lease_shapes.get(rec.lease_id)
+            elif rec.actor_id and rec.actor_id in self.actors:
+                shape = self.actors[rec.actor_id].resources
+            cpus = (shape or {}).get("CPU", 0.0)
+            if cpus:
+                # oversubscribe temporarily rather than deadlock
+                self._take(self.avail, {"CPU": cpus})
+
+    async def _h_create_actor(self, state, msg, reply, reply_err):
+        a = ActorRec(
+            actor_id=msg["actor_id"],
+            name=msg.get("name"),
+            fn_id=msg["fn_id"],
+            init_spec=msg["init_spec"],
+            resources=msg.get("resources") or {},
+            max_restarts=msg.get("max_restarts", 0),
+            detached=msg.get("detached", False),
+            max_concurrency=msg.get("max_concurrency", 1),
+            pg_id=msg.get("pg_id"),
+            bundle_index=msg.get("bundle_index", -1),
+        )
+        if a.name:
+            if a.name in self.named_actors:
+                reply_err(ValueError(f"actor name {a.name!r} already taken"))
+                return
+            self.named_actors[a.name] = a.actor_id
+        self.actors[a.actor_id] = a
+        await self._place_actor(a)
+        if a.state == "alive":
+            reply(addr=a.addr, incarnation=a.incarnation)
+        else:
+            self._drop_actor_name(a)
+            reply_err(ActorDiedError(a.death_cause))
+
+    async def _h_get_actor(self, state, msg, reply, reply_err):
+        aid = msg.get("actor_id")
+        if aid is None and msg.get("name") is not None:
+            aid = self.named_actors.get(msg["name"])
+            if aid is None:
+                reply_err(ValueError(f"no actor named {msg['name']!r}"))
+                return
+        a = self.actors.get(aid)
+        if a is None:
+            reply_err(ValueError("actor not found"))
+            return
+        info = self._actor_info(a)
+        info["fn_id"] = a.fn_id
+        reply(**info)
+
+    async def _h_kill_actor(self, state, msg, reply, reply_err):
+        a = self.actors.get(msg["actor_id"])
+        if a is None:
+            reply()
+            return
+        if msg.get("no_restart", True):
+            a.max_restarts = 0
+        a.death_cause = "killed via kill()"
+        rec = self.workers.get(a.worker_id) if a.worker_id else None
+        if rec is not None and rec.proc is not None and rec.proc.poll() is None:
+            try:
+                os.kill(rec.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        reply()
+
+    async def _h_actor_exited(self, state, msg, reply, reply_err):
+        # graceful actor exit (__ray_terminate__ analogue): no restart
+        a = self.actors.get(msg["actor_id"])
+        if a is not None:
+            a.max_restarts = 0
+            a.death_cause = "actor exited"
+
+    # KV ------------------------------------------------------------------
+    async def _h_kv_put(self, state, msg, reply, reply_err):
+        ns = self.kv.setdefault(msg.get("ns", ""), {})
+        exists = msg["key"] in ns
+        if not (msg.get("overwrite", True) is False and exists):
+            ns[msg["key"]] = msg["value"]
+        reply(added=not exists)
+
+    async def _h_kv_get(self, state, msg, reply, reply_err):
+        ns = self.kv.get(msg.get("ns", ""), {})
+        reply(value=ns.get(msg["key"]))
+
+    async def _h_kv_del(self, state, msg, reply, reply_err):
+        ns = self.kv.get(msg.get("ns", ""), {})
+        reply(deleted=1 if ns.pop(msg["key"], None) is not None else 0)
+
+    async def _h_kv_keys(self, state, msg, reply, reply_err):
+        ns = self.kv.get(msg.get("ns", ""), {})
+        prefix = msg.get("prefix", "")
+        reply(keys=[k for k in ns.keys() if k.startswith(prefix)])
+
+    async def _h_register_function(self, state, msg, reply, reply_err):
+        ns = self.kv.setdefault("__functions__", {})
+        ns[msg["fn_id"]] = msg["blob"]
+        reply()
+
+    async def _h_get_function(self, state, msg, reply, reply_err):
+        blob = self.kv.get("__functions__", {}).get(msg["fn_id"])
+        if blob is None:
+            reply_err(KeyError(f"function {msg['fn_id']!r} not registered"))
+        else:
+            reply(blob=blob)
+
+    # pubsub ---------------------------------------------------------------
+    async def _h_subscribe(self, state, msg, reply, reply_err):
+        self.subscribers.setdefault(msg["ch"], []).append(state["writer"])
+        reply()
+
+    async def _h_publish(self, state, msg, reply, reply_err):
+        self._pub(msg["ch"], msg.get("data"))
+
+    # objects --------------------------------------------------------------
+    async def _h_obj_created(self, state, msg, reply, reply_err):
+        oid = msg["oid"]
+        rec = ObjectRec(
+            oid=oid,
+            shm_name=msg.get("shm_name"),
+            size=msg.get("size", 0),
+            # the submitter owns task returns; the connecting client owns puts
+            owner=msg.get("owner") or state.get("client_id", "?"),
+        )
+        rec.holders |= self._early_refs.pop(oid, set())
+        self.objects[oid] = rec
+        self.stats["objects_created"] += 1
+
+    async def _h_obj_locate(self, state, msg, reply, reply_err):
+        rec = self.objects.get(msg["oid"])
+        if rec is None:
+            reply(found=False)
+        else:
+            reply(found=True, shm_name=rec.shm_name, size=rec.size, owner=rec.owner)
+
+    async def _h_obj_refs(self, state, msg, reply, reply_err):
+        cid = state.get("client_id", "?")
+        for oid in msg.get("inc", []):
+            rec = self.objects.get(oid)
+            if rec is not None:
+                rec.holders.add(cid)
+            else:
+                # inc may race ahead of obj_created (different sockets)
+                self._early_refs.setdefault(oid, set()).add(cid)
+        for oid in msg.get("dec", []):
+            rec = self.objects.get(oid)
+            if rec is not None:
+                rec.holders.discard(cid)
+                if cid == rec.owner:
+                    rec.owner_released = True
+                self._obj_maybe_gc(rec)
+            else:
+                early = self._early_refs.get(oid)
+                if early is not None:
+                    early.discard(cid)
+                    if not early:
+                        del self._early_refs[oid]
+
+    # placement groups ------------------------------------------------------
+    async def _h_create_pg(self, state, msg, reply, reply_err):
+        bundles = [BundleRec(resources=b) for b in msg["bundles"]]
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.resources.items():
+                total[k] = total.get(k, 0.0) + v
+        if not self._fits(self.avail, total):
+            reply_err(
+                PlacementGroupError(
+                    f"infeasible placement group: need {total}, available {self.avail}"
+                )
+            )
+            return
+        self._take(self.avail, total)
+        self.pgs[msg["pg_id"]] = PGRec(
+            pg_id=msg["pg_id"], bundles=bundles, strategy=msg.get("strategy", "PACK")
+        )
+        reply()
+
+    async def _h_remove_pg(self, state, msg, reply, reply_err):
+        pg = self.pgs.pop(msg["pg_id"], None)
+        if pg is not None:
+            total: Dict[str, float] = {}
+            for b in pg.bundles:
+                for k, v in b.resources.items():
+                    total[k] = total.get(k, 0.0) + v
+            self._give(self.avail, total)
+            self._service_queue()
+        reply()
+
+    async def _h_list_pgs(self, state, msg, reply, reply_err):
+        reply(
+            pgs=[
+                {
+                    "pg_id": p.pg_id,
+                    "strategy": p.strategy,
+                    "state": p.state,
+                    "bundles": [b.resources for b in p.bundles],
+                }
+                for p in self.pgs.values()
+            ]
+        )
+
+    # introspection ---------------------------------------------------------
+    async def _h_nodes(self, state, msg, reply, reply_err):
+        reply(
+            nodes=[
+                {
+                    "node_id": self.node_id,
+                    "alive": True,
+                    "resources": self.total_resources,
+                    "available": self.avail,
+                    "n_workers": sum(1 for w in self.workers.values() if w.state != "dead"),
+                }
+            ]
+        )
+
+    async def _h_cluster_resources(self, state, msg, reply, reply_err):
+        reply(total=self.total_resources, available=self.avail)
+
+    async def _h_stats(self, state, msg, reply, reply_err):
+        reply(
+            stats=dict(
+                self.stats,
+                pending_leases=len(self.pending_leases),
+                idle_workers=sum(len(d) for d in self.idle_workers.values()),
+                n_workers=sum(1 for w in self.workers.values() if w.state != "dead"),
+                n_actors=len(self.actors),
+                n_objects=len(self.objects),
+            )
+        )
+
+    async def _h_list_actors(self, state, msg, reply, reply_err):
+        reply(actors=[self._actor_info(a) for a in self.actors.values()])
+
+    async def _h_list_workers(self, state, msg, reply, reply_err):
+        reply(
+            workers=[
+                {
+                    "worker_id": w.worker_id,
+                    "pid": w.pid,
+                    "state": w.state,
+                    "actor_id": w.actor_id,
+                }
+                for w in self.workers.values()
+            ]
+        )
+
+    async def _h_job_stop(self, state, msg, reply, reply_err):
+        reply()
+        self._shutdown.set()
+
+    # ------------------------------------------------------------ lifecycle
+    async def _on_disconnect(self, state):
+        cid = state.get("client_id")
+        if cid is None:
+            return
+        self._clients.pop(cid, None)
+        if state.get("role") == "worker":
+            rec = self.workers.get(cid)
+            if rec is not None:
+                await self._on_worker_death(rec)
+        elif state.get("role") == "driver":
+            self._driver_clients.discard(cid)
+            if not self._driver_clients:
+                # last driver gone -> tear down the job (detached actors would
+                # survive in the multi-job milestone)
+                self._shutdown.set()
+
+    async def _monitor_loop(self):
+        period = self.config.health_check_period_s
+        while not self._shutdown.is_set():
+            await asyncio.sleep(min(period, 0.2))
+            now = time.monotonic()
+            for rec in list(self.workers.values()):
+                if rec.state == "dead":
+                    continue
+                if rec.proc is not None and rec.proc.poll() is not None:
+                    await self._on_worker_death(rec)
+                elif (
+                    rec.state != "starting"
+                    and now - rec.last_heartbeat
+                    > period * self.config.health_check_failure_threshold
+                ):
+                    await self._on_worker_death(rec)
+
+    async def run(self):
+        await self.server.start()
+        # prestart one worker per CPU (worker_pool.h prestart behavior)
+        if self.config.worker_prestart:
+            for _ in range(int(self.total_resources.get("CPU", 1))):
+                self._spawn_worker()
+        monitor = asyncio.ensure_future(self._monitor_loop())
+        # readiness marker for the driver
+        with open(os.path.join(self.session_dir, "head.ready"), "w") as f:
+            f.write(str(os.getpid()))
+        await self._shutdown.wait()
+        monitor.cancel()
+        await self._teardown()
+
+    async def _teardown(self):
+        for rec in self.workers.values():
+            if rec.proc is not None and rec.proc.poll() is None:
+                try:
+                    os.kill(rec.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        await self.server.stop()
+        # GC all shm segments of this session
+        import shutil
+
+        shutil.rmtree(os.path.join("/dev/shm", self.session_name), ignore_errors=True)
+
+
+def main():
+    session_dir = os.environ["CA_SESSION_DIR"]
+    config = CAConfig.from_json(os.environ["CA_CONFIG_JSON"])
+    import json
+
+    resources = json.loads(os.environ.get("CA_RESOURCES", '{"CPU": 4}'))
+    head = Head(session_dir, config, resources)
+    asyncio.run(head.run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""ObjectRef / DeviceRef handles.
+
+ObjectRef is the future-like handle returned by `put()` and `.remote()`.
+Mirrors the reference's ObjectRef (python/ray/includes/object_ref.pxi) incl.
+refcount notification on destruction so the owner can GC shared-memory data.
+
+DeviceRef is the TPU-native extension: a handle to a sharded `jax.Array` (or a
+pytree of them) that lives on TPU inside the owning actor's process and is
+never copied to host when passed back into that actor's methods.  `get()`ing a
+DeviceRef outside the owning process materializes it to host explicitly — the
+framework refuses to do that silently for arrays above a threshold unless
+`allow_device_fetch` is set, because implicit device->host copies are the #1
+TPU performance foot-gun.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner", "_worker", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: Optional[str] = None, worker=None):
+        self.id = object_id
+        self.owner = owner  # worker/actor address owning the primary copy
+        self._worker = worker
+        if worker is not None:
+            worker.reference_counter.add_local_ref(self.id)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from .worker import global_worker
+
+        return global_worker().resolve_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def __del__(self):
+        w = self._worker
+        if w is not None:
+            try:
+                w.reference_counter.remove_local_ref(self.id)
+            except Exception:
+                pass
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Serialized refs travel through task specs; the receiving process
+        # reconstructs a handle registered with its local worker so borrowed
+        # references are counted (reference ownership protocol; the full
+        # borrowing ledger of the reference's reference_count.h lands with the
+        # distributed refcount milestone).
+        return (_rehydrate_ref, (type(self).__name__, self.id.binary(), self.owner))
+
+
+class DeviceRef(ObjectRef):
+    __slots__ = ("spec",)
+
+    def __init__(self, object_id, owner=None, worker=None, spec: Any = None):
+        super().__init__(object_id, owner, worker)
+        # spec: lightweight description (shapes/dtypes/sharding) for display
+        # and for shape-checking without touching the device data.
+        self.spec = spec
+
+    def __repr__(self):
+        return f"DeviceRef({self.id.hex()}, owner={self.owner}, spec={self.spec})"
+
+    def __reduce__(self):
+        return (_rehydrate_device_ref, (self.id.binary(), self.owner, self.spec))
+
+
+def _rehydrate_ref(kind: str, id_bytes: bytes, owner):
+    from .worker import try_global_worker
+
+    w = try_global_worker()
+    return ObjectRef(ObjectID(id_bytes), owner, w)
+
+
+def _rehydrate_device_ref(id_bytes: bytes, owner, spec):
+    from .worker import try_global_worker
+
+    w = try_global_worker()
+    return DeviceRef(ObjectID(id_bytes), owner, w, spec)

@@ -1,0 +1,247 @@
+"""In-process memory store + node-local shared-memory store client.
+
+MemoryStore is the analogue of the reference's CoreWorkerMemoryStore
+(src/ray/core_worker/store_provider/memory_store/memory_store.h): small
+objects and inlined task returns, resolved in-process without shm.
+
+ShmObjectStore is the plasma analogue (src/ray/object_manager/plasma/): a
+node-local shared-memory arena for large immutable objects, zero-copy mapped
+by every process on the node.  Unlike plasma there is no store daemon on the
+data path: the *producer* creates and seals a per-object shm segment and
+registers it with the head; readers mmap it directly.  Accounting/eviction is
+centralized at the head (refcount-based GC).  A native C++ helper
+(native/shmstore) accelerates large copies with parallel memcpy when built.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .errors import ObjectStoreFullError, TaskError
+from .ids import ObjectID
+
+SHM_DIR = "/dev/shm"
+
+
+@dataclass
+class _Entry:
+    state: str  # "pending" | "value" | "packed" | "shm" | "error"
+    value: Any = None
+    packed: Optional[bytes] = None
+    shm_name: Optional[str] = None
+    error: Optional[BaseException] = None
+    size: int = 0
+
+
+class MemoryStore:
+    """Thread-safe in-process object table with blocking waits."""
+
+    def __init__(self):
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._cv = threading.Condition()
+
+    def put_value(self, oid: ObjectID, value: Any, size: int = 0):
+        with self._cv:
+            self._entries[oid] = _Entry("value", value=value, size=size)
+            self._cv.notify_all()
+
+    def put_packed(self, oid: ObjectID, packed: bytes):
+        with self._cv:
+            self._entries[oid] = _Entry("packed", packed=packed, size=len(packed))
+            self._cv.notify_all()
+
+    def put_shm(self, oid: ObjectID, shm_name: str, size: int):
+        with self._cv:
+            self._entries[oid] = _Entry("shm", shm_name=shm_name, size=size)
+            self._cv.notify_all()
+
+    def put_error(self, oid: ObjectID, error: BaseException):
+        with self._cv:
+            self._entries[oid] = _Entry("error", error=error)
+            self._cv.notify_all()
+
+    def mark_pending(self, oid: ObjectID):
+        with self._cv:
+            self._entries.setdefault(oid, _Entry("pending"))
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._cv:
+            e = self._entries.get(oid)
+            return e is not None and e.state != "pending"
+
+    def get_entry(self, oid: ObjectID) -> Optional[_Entry]:
+        with self._cv:
+            return self._entries.get(oid)
+
+    def wait_ready(self, oids: List[ObjectID], num_returns: int, timeout: Optional[float]) -> Tuple[List[ObjectID], List[ObjectID]]:
+        """Block until num_returns of oids are ready (or timeout). Returns
+        (ready, not_ready) preserving input order — `wait()` semantics of the
+        reference (python/ray/_private/worker.py:2868)."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [o for o in oids if (e := self._entries.get(o)) and e.state != "pending"]
+                if len(ready) >= num_returns:
+                    ready_set = set(ready[:num_returns])
+                    # preserve order, cap at num_returns
+                    ready_list, rest = [], []
+                    for o in oids:
+                        if o in ready_set and len(ready_list) < num_returns:
+                            ready_list.append(o)
+                        else:
+                            rest.append(o)
+                    return ready_list, rest
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    ready_set = set(ready)
+                    return [o for o in oids if o in ready_set], [o for o in oids if o not in ready_set]
+                self._cv.wait(remaining if remaining is None or remaining < 0.25 else 0.25)
+
+    def delete(self, oid: ObjectID):
+        with self._cv:
+            self._entries.pop(oid, None)
+
+    def keys(self):
+        with self._cv:
+            return list(self._entries.keys())
+
+
+class ShmObjectStore:
+    """Producer/consumer interface to per-object shm segments.
+
+    Segment layout = serialization.pack() format, written in place.
+    """
+
+    def __init__(self, session_name: str):
+        self.session_name = session_name
+        self.dir = os.path.join(SHM_DIR, session_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._native = None
+        self._native_tried = False
+        self._open_maps: Dict[str, Tuple[mmap.mmap, int]] = {}
+        self._lock = threading.Lock()
+
+    # -- native acceleration ------------------------------------------------
+    def _native_lib(self):
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from ..native import shmstore_binding
+
+                self._native = shmstore_binding.load()
+            except Exception:
+                self._native = None
+        return self._native
+
+    # -- producer -----------------------------------------------------------
+    def name_for(self, oid: ObjectID) -> str:
+        return f"{self.session_name}/obj_{oid.hex()}"
+
+    def create_and_pack(self, oid: ObjectID, data: bytes, raws: List[Any]) -> Tuple[str, int]:
+        """Write a serialized value into a new sealed segment. Returns
+        (shm_name, size)."""
+        size = serialization.packed_size(data, raws)
+        name = self.name_for(oid)
+        path = os.path.join(SHM_DIR, name)
+        tmp = path + ".tmp"
+        try:
+            fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        except FileExistsError:
+            raise ObjectStoreFullError(f"object {oid} already being written")
+        try:
+            os.ftruncate(fd, size)
+            with mmap.mmap(fd, size) as m:
+                native = self._native_lib()
+                mv = memoryview(m)
+                if native is not None:
+                    serialization_pack_into_native(native, mv, data, raws)
+                else:
+                    serialization.pack_into(mv, data, raws)
+                mv.release()
+        except OSError as e:
+            os.close(fd)
+            os.unlink(tmp)
+            raise ObjectStoreFullError(str(e)) from e
+        os.close(fd)
+        os.rename(tmp, path)  # atomic seal
+        return name, size
+
+    def put(self, oid: ObjectID, value: Any) -> Tuple[str, int]:
+        data, buffers = serialization.serialize(value)
+        return self.create_and_pack(oid, data, [b.raw() for b in buffers])
+
+    # -- consumer -----------------------------------------------------------
+    def open(self, shm_name: str) -> memoryview:
+        """Map a sealed segment read-only (zero-copy)."""
+        with self._lock:
+            cached = self._open_maps.get(shm_name)
+            if cached is not None:
+                return memoryview(cached[0])
+        path = os.path.join(SHM_DIR, shm_name)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            size = os.fstat(fd).st_size
+            m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        with self._lock:
+            self._open_maps[shm_name] = (m, size)
+        return memoryview(m)
+
+    def get(self, shm_name: str) -> Any:
+        return serialization.unpack(self.open(shm_name))
+
+    def release(self, shm_name: str):
+        with self._lock:
+            cached = self._open_maps.pop(shm_name, None)
+        if cached is not None:
+            try:
+                cached[0].close()
+            except BufferError:
+                # still referenced by a live numpy view; keep mapping alive
+                with self._lock:
+                    self._open_maps[shm_name] = cached
+
+    def unlink(self, shm_name: str):
+        self.release(shm_name)
+        try:
+            os.unlink(os.path.join(SHM_DIR, shm_name))
+        except FileNotFoundError:
+            pass
+
+    def cleanup_session(self):
+        import shutil
+
+        with self._lock:
+            maps = list(self._open_maps.values())
+            self._open_maps.clear()
+        for m, _ in maps:
+            try:
+                m.close()
+            except BufferError:
+                pass
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def serialization_pack_into_native(native, mv: memoryview, data: bytes, raws: List[Any]) -> int:
+    """pack_into using the native parallel memcpy for large buffers."""
+    import msgpack
+
+    header = msgpack.packb({"p": data, "l": [len(r) for r in raws]}, use_bin_type=True)
+    hlen = len(header)
+    mv[:4] = hlen.to_bytes(4, "big")
+    mv[4 : 4 + hlen] = header
+    offset = 4 + hlen
+    for r in raws:
+        offset = serialization._align(offset)
+        ln = len(r)
+        native.copy_into(mv, offset, r)
+        offset += ln
+    return offset

@@ -1,0 +1,70 @@
+"""Placement groups (analogue of python/ray/util/placement_group.py).
+
+A placement group atomically reserves a list of resource bundles; tasks and
+actors scheduled into a bundle consume from that reservation.  Strategies
+(PACK/SPREAD/STRICT_PACK/STRICT_SPREAD) control node placement; on the
+current single-node milestone they are recorded and validated but equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .errors import PlacementGroupError
+from .ids import PlacementGroupID
+from .worker import global_worker
+
+STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundles = bundles
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return self.bundles
+
+    def ready(self):
+        """Returns an ObjectRef resolving when the PG is created (already
+        created synchronously on this milestone)."""
+        return global_worker().put(True)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return True
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in STRATEGIES:
+        raise ValueError(f"strategy must be one of {STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    for b in bundles:
+        if any(v < 0 for v in b.values()):
+            raise ValueError("bundle resources must be non-negative")
+    pg_id = PlacementGroupID.from_random()
+    w = global_worker()
+    w.head_call(
+        "create_pg",
+        pg_id=pg_id.hex(),
+        bundles=[{k: float(v) for k, v in b.items()} for b in bundles],
+        strategy=strategy,
+    )
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    global_worker().head_call("remove_pg", pg_id=pg.id.hex())
+
+
+def placement_group_table() -> List[dict]:
+    return global_worker().head_call("list_pgs")["pgs"]

@@ -1,0 +1,232 @@
+"""Wire protocol between processes: length-prefixed msgpack frames over unix
+domain sockets.
+
+This is the analogue of the reference's gRPC services + local-socket
+flatbuffer protocol (src/ray/protobuf/*.proto, src/ray/raylet/format/): a
+small set of typed messages between driver <-> head <-> workers.  msgpack maps
+keep the schema explicit and language-neutral so the head can later be swapped
+for the C++ implementation without changing clients.
+
+Frame format: [u32 big-endian length][msgpack map]
+Every request carries "m" (method), "i" (request id); responses echo "i" and
+carry "ok" plus method-specific fields, or "err" with a pickled exception.
+
+A deterministic fault-injection hook mirrors the reference's RPC chaos
+(src/ray/rpc/rpc_chaos.h): CA_TESTING_RPC_FAILURE="method=N,method2=M" makes
+the first N sends of `method` raise ConnectionError before the write.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+from .config import get_config
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 31
+
+
+class RpcChaos:
+    """Counts down per-method failure budgets from config.testing_rpc_failure."""
+
+    def __init__(self, spec: str):
+        self._budget: Dict[str, int] = {}
+        for part in filter(None, (spec or "").split(",")):
+            method, _, n = part.partition("=")
+            self._budget[method.strip()] = int(n or 1)
+
+    def maybe_fail(self, method: str):
+        left = self._budget.get(method)
+        if left:
+            self._budget[method] = left - 1
+            raise ConnectionError(f"[chaos] injected RPC failure for {method}")
+
+
+_chaos: Optional[RpcChaos] = None
+
+
+def rpc_chaos() -> RpcChaos:
+    global _chaos
+    if _chaos is None:
+        _chaos = RpcChaos(get_config().testing_rpc_failure)
+    return _chaos
+
+
+def reset_rpc_chaos(spec: str = ""):
+    global _chaos
+    _chaos = RpcChaos(spec)
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        hdr = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = _LEN.unpack(hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+def encode_frame(msg: dict) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+def write_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
+    writer.write(encode_frame(msg))
+
+
+class Connection:
+    """A client connection with request/response correlation.
+
+    Multiple outstanding requests are multiplexed over one socket; responses
+    are matched by request id.  One-way notifications (no reply expected) use
+    notify().  Thread-compat: must only be used from the owning event loop.
+    """
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._req_ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._on_push: Optional[Callable[[dict], Awaitable[None]]] = None
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    def set_push_handler(self, fn: Callable[[dict], Awaitable[None]]):
+        """Handler for unsolicited server->client frames (pubsub pushes)."""
+        self._on_push = fn
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg = await read_frame(self.reader)
+                if msg is None:
+                    break
+                rid = msg.get("i")
+                fut = self._pending.pop(rid, None) if rid is not None else None
+                if fut is not None:
+                    if not fut.done():
+                        fut.set_result(msg)
+                elif self._on_push is not None:
+                    await self._on_push(msg)
+        except Exception:
+            pass
+        finally:
+            self._closed = True
+            err = ConnectionError("connection closed")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+
+    async def call(self, _method: str, timeout: Optional[float] = None, **fields) -> dict:
+        rpc_chaos().maybe_fail(_method)
+        if self._closed:
+            raise ConnectionError("connection closed")
+        rid = next(self._req_ids)
+        msg = {"m": _method, "i": rid, **fields}
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        write_frame(self.writer, msg)
+        reply = await asyncio.wait_for(fut, timeout)
+        if not reply.get("ok", True):
+            import pickle
+
+            raise pickle.loads(reply["err"])
+        return reply
+
+    def notify(self, _method: str, **fields) -> None:
+        rpc_chaos().maybe_fail(_method)
+        if self._closed:
+            raise ConnectionError("connection closed")
+        write_frame(self.writer, {"m": _method, **fields})
+
+    async def close(self):
+        self._closed = True
+        self._reader_task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+async def connect_unix(path: str) -> Connection:
+    reader, writer = await asyncio.open_unix_connection(path)
+    return Connection(reader, writer)
+
+
+class Server:
+    """Asyncio unix-socket server dispatching frames to a handler.
+
+    handler(conn_state, msg, reply) — `reply(**fields)` sends the response for
+    request-style frames; notifications have no "i" and get no reply.
+    """
+
+    def __init__(self, path: str, handler, on_disconnect=None):
+        self.path = path
+        self.handler = handler
+        self.on_disconnect = on_disconnect
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self):
+        self._server = await asyncio.start_unix_server(self._on_client, path=self.path)
+
+    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        state: Dict[str, Any] = {"writer": writer}
+        try:
+            while True:
+                msg = await read_frame(reader)
+                if msg is None:
+                    break
+                # Dispatch each frame as its own task so a slow handler (e.g.
+                # actor creation, task execution) doesn't head-of-line block
+                # other requests multiplexed on this connection.  Tasks start
+                # in frame-arrival order (FIFO loop scheduling), which
+                # preserves per-caller actor-call ordering up to the executor
+                # queue.
+                asyncio.ensure_future(self._dispatch(state, msg, writer))
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            if self.on_disconnect is not None:
+                await self.on_disconnect(state)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, state, msg, writer):
+        rid = msg.get("i")
+
+        def reply(**fields):
+            if rid is not None:
+                write_frame(writer, {"i": rid, "ok": True, **fields})
+
+        def reply_err(exc: BaseException):
+            if rid is not None:
+                import pickle
+
+                write_frame(writer, {"i": rid, "ok": False, "err": pickle.dumps(exc)})
+
+        try:
+            await self.handler(state, msg, reply, reply_err)
+        except Exception as e:  # handler bug: report to client
+            reply_err(e)
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

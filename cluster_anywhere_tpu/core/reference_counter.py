@@ -1,0 +1,72 @@
+"""Local reference counting with batched release notifications.
+
+Round-1 scope of the reference's distributed ReferenceCounter
+(src/ray/core_worker/reference_count.h): per-process local refcounts for every
+ObjectRef handle; when the local count for an object hits zero the release is
+batched and flushed to the head, which maintains the cluster-wide count and
+unlinks shared-memory segments at zero.  The full borrowing ledger
+(AddBorrowedObject / WaitForRefRemoved worker<->worker pubsub) is scheduled
+for the multi-node milestone.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .ids import ObjectID
+
+
+class ReferenceCounter:
+    def __init__(self, flush_cb: Optional[Callable[[List[bytes], List[bytes]], None]] = None):
+        self._counts: Dict[ObjectID, int] = {}
+        self._lock = threading.Lock()
+        self._pending_inc: List[bytes] = []
+        self._pending_dec: List[bytes] = []
+        self._flush_cb = flush_cb
+        # objects this process owns (created here); owner keeps data alive
+        # until cluster count drops to zero.
+        self._owned: set = set()
+
+    def set_flush_cb(self, cb):
+        self._flush_cb = cb
+
+    def add_owned(self, oid: ObjectID):
+        with self._lock:
+            self._owned.add(oid)
+
+    def add_local_ref(self, oid: ObjectID):
+        with self._lock:
+            n = self._counts.get(oid, 0)
+            self._counts[oid] = n + 1
+            if n == 0:
+                self._pending_inc.append(oid.binary())
+
+    def remove_local_ref(self, oid: ObjectID):
+        flush = None
+        with self._lock:
+            n = self._counts.get(oid, 0) - 1
+            if n <= 0:
+                self._counts.pop(oid, None)
+                self._pending_dec.append(oid.binary())
+                if len(self._pending_dec) >= 64:
+                    flush = self._take_pending_locked()
+            else:
+                self._counts[oid] = n
+        if flush and self._flush_cb:
+            self._flush_cb(*flush)
+
+    def _take_pending_locked(self):
+        inc, dec = self._pending_inc, self._pending_dec
+        self._pending_inc, self._pending_dec = [], []
+        return inc, dec
+
+    def flush(self):
+        with self._lock:
+            inc, dec = self._take_pending_locked()
+        if (inc or dec) and self._flush_cb:
+            self._flush_cb(inc, dec)
+
+    def local_count(self, oid: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(oid, 0)

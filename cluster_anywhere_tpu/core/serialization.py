@@ -1,0 +1,95 @@
+"""Value serialization.
+
+Uses cloudpickle with pickle protocol 5 out-of-band buffers so that numpy
+arrays (and any buffer-exporting object) are serialized without copies: the
+pickle stream holds only metadata while raw buffers are collected separately
+and written directly into shared memory.  This mirrors the reference's
+zero-copy plasma reads (python/ray/_private/serialization.py) in spirit, with
+the TPU-native twist that `jax.Array` device values are never serialized at
+all — they become DeviceRef handles resolved in the owning process (see
+object_ref.DeviceRef).
+
+Wire format of a serialized value (used both inline and in shm):
+    meta: msgpack {pickle: bytes, buffer_lens: [int, ...]}
+    followed by the concatenated raw buffers (8-byte aligned each).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+import msgpack
+
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Returns (pickle_bytes, out_of_band_buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    data = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    return data, buffers
+
+def deserialize(data: bytes, buffers: List[Any]) -> Any:
+    return pickle.loads(data, buffers=buffers)
+
+
+def pack(value: Any) -> bytes:
+    """Serialize into a single contiguous blob (inline path)."""
+    data, buffers = serialize(value)
+    raws = [b.raw() for b in buffers]
+    header = msgpack.packb(
+        {"p": data, "l": [len(r) for r in raws]}, use_bin_type=True
+    )
+    parts = [len(header).to_bytes(4, "big"), header]
+    offset = 4 + len(header)
+    for r in raws:
+        pad = _align(offset) - offset
+        parts.append(b"\x00" * pad)
+        parts.append(bytes(r))
+        offset += pad + len(r)
+    return b"".join(parts)
+
+
+def unpack(blob) -> Any:
+    """Inverse of pack(). Accepts bytes or a memoryview (zero-copy for
+    buffer-backed payloads when given a memoryview over shm)."""
+    mv = memoryview(blob)
+    hlen = int.from_bytes(bytes(mv[:4]), "big")
+    header = msgpack.unpackb(bytes(mv[4 : 4 + hlen]), raw=False)
+    offset = 4 + hlen
+    buffers = []
+    for ln in header["l"]:
+        offset = _align(offset)
+        buffers.append(mv[offset : offset + ln])
+        offset += ln
+    return deserialize(header["p"], buffers)
+
+
+def packed_size(data: bytes, raws: List[Any]) -> int:
+    header = msgpack.packb({"p": data, "l": [len(r) for r in raws]}, use_bin_type=True)
+    offset = 4 + len(header)
+    for r in raws:
+        offset = _align(offset) + len(r)
+    return offset
+
+
+def pack_into(buf: memoryview, data: bytes, raws: List[Any]) -> int:
+    """Write the pack() format into a preallocated buffer (e.g. shm mapping).
+    Returns bytes written."""
+    header = msgpack.packb({"p": data, "l": [len(r) for r in raws]}, use_bin_type=True)
+    hlen = len(header)
+    buf[:4] = hlen.to_bytes(4, "big")
+    buf[4 : 4 + hlen] = header
+    offset = 4 + hlen
+    for r in raws:
+        offset = _align(offset)
+        ln = len(r)
+        buf[offset : offset + ln] = r if isinstance(r, (bytes, memoryview)) else memoryview(r)
+        offset += ln
+    return offset

@@ -1,0 +1,798 @@
+"""CoreWorker runtime: the per-process engine embedded in the driver and in
+every worker process (analogue of src/ray/core_worker/core_worker.h).
+
+Owns: the IO thread (asyncio loop), the connection to the head, direct
+connections to other workers, the in-process memory store, the shm store
+client, reference counting, function export, lease-based task submission with
+pipelining (normal_task_submitter.h), actor call submission, and get/put/wait.
+
+Threading model: user code calls the blocking public API from any thread; all
+socket IO happens on the IO thread.  ObjectRef readiness is tracked in the
+MemoryStore (condition-variable waits) so `get`/`wait` never touch the loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import serialization
+from .config import CAConfig, get_config
+from .errors import (
+    ActorDiedError,
+    CAError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .function_manager import FunctionManager
+from .ids import ActorID, JobID, ObjectID, TaskID, _Counter
+from .object_ref import DeviceRef, ObjectRef
+from .object_store import MemoryStore, ShmObjectStore, _Entry
+from .protocol import Connection, connect_unix
+from .reference_counter import ReferenceCounter
+
+_global_worker: Optional["Worker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> "Worker":
+    if _global_worker is None:
+        raise RuntimeError("not initialized — call init() first")
+    return _global_worker
+
+
+def try_global_worker() -> Optional["Worker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["Worker"]):
+    global _global_worker
+    with _global_lock:
+        _global_worker = w
+
+
+def _is_device_value(value: Any) -> bool:
+    """True if the pytree contains jax.Array leaves on an accelerator (or any
+    jax array — device-resident values must not transit pickle)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    import jax
+
+    found = False
+
+    def check(x):
+        nonlocal found
+        if isinstance(x, jax.Array):
+            found = True
+        return x
+
+    try:
+        jax.tree_util.tree_map(check, value)
+    except Exception:
+        return False
+    return found
+
+
+def _device_spec(value: Any) -> str:
+    import jax
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return f"Array{tuple(x.shape)}:{x.dtype}"
+        return type(x).__name__
+
+    try:
+        return str(jax.tree_util.tree_map(leaf, value))
+    except Exception:
+        return "<device value>"
+
+
+@dataclass
+class _Lease:
+    lease_id: str
+    worker_id: str
+    addr: str
+    inflight: int = 0
+    dead: bool = False
+    last_idle: float = field(default_factory=time.monotonic)
+
+
+class LeasePool:
+    """Per-resource-shape pool of worker leases with pipelining.
+
+    Mirrors the lease reuse + pipelining of NormalTaskSubmitter: hold up to
+    `max_leases` concurrent leases per shape, pipeline up to
+    `max_inflight_per_lease` pushes onto each, return leases idle beyond the
+    timeout so other processes (nested tasks, actors) can use the CPUs.
+    """
+
+    def __init__(self, worker: "Worker", shape_key: tuple, shape: Dict[str, float], pg: Optional[Tuple[str, int]]):
+        self.worker = worker
+        self.shape = shape
+        self.pg = pg
+        self.leases: List[_Lease] = []
+        self.waiters: deque = deque()
+        self.requests_outstanding = 0
+        cfg = worker.config
+        self.max_leases = cfg.max_leases_per_shape
+        self.max_inflight = cfg.max_inflight_per_lease
+
+    def _pick(self) -> Optional[_Lease]:
+        best = None
+        for l in self.leases:
+            if not l.dead and l.inflight < self.max_inflight:
+                if best is None or l.inflight < best.inflight:
+                    best = l
+        return best
+
+    async def acquire(self) -> _Lease:
+        while True:
+            lease = self._pick()
+            if lease is not None:
+                lease.inflight += 1
+                return lease
+            if (
+                len([l for l in self.leases if not l.dead]) + self.requests_outstanding
+                < self.max_leases
+            ):
+                self.requests_outstanding += 1
+                asyncio.ensure_future(self._request_lease())
+            fut = asyncio.get_running_loop().create_future()
+            self.waiters.append(fut)
+            await fut  # raises if the lease request failed terminally
+
+    async def _request_lease(self):
+        try:
+            kw = {}
+            if self.pg is not None:
+                kw = {"pg_id": self.pg[0], "bundle_index": self.pg[1]}
+            reply = await self.worker.head.call(
+                "request_lease", shape=self.shape, timeout=None, **kw
+            )
+            lease = _Lease(reply["lease_id"], reply["worker_id"], reply["addr"])
+            self.leases.append(lease)
+            self.requests_outstanding -= 1
+            self._wake(self.max_inflight)
+        except Exception as e:
+            # unrecoverable admission errors (e.g. removed placement group)
+            # must surface on the waiting tasks, not spin forever
+            self.requests_outstanding -= 1
+            self._fail_waiters(e)
+
+    def _wake(self, n: int = 1):
+        while self.waiters and n > 0:
+            fut = self.waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                n -= 1
+
+    def _fail_waiters(self, exc: BaseException):
+        while self.waiters:
+            fut = self.waiters.popleft()
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def release(self, lease: _Lease, dead: bool = False):
+        lease.inflight -= 1
+        if dead:
+            lease.dead = True
+        if lease.inflight == 0:
+            lease.last_idle = time.monotonic()
+        self._wake()
+
+    def reap_idle(self, now: float, timeout: float) -> List[str]:
+        """Return lease_ids to give back to the head."""
+        out = []
+        keep = []
+        for l in self.leases:
+            if l.dead:
+                continue
+            if l.inflight == 0 and now - l.last_idle > timeout and not self.waiters:
+                l.dead = True
+                out.append(l.lease_id)
+            else:
+                keep.append(l)
+        self.leases = [l for l in self.leases if not l.dead]
+        return out
+
+
+class Worker:
+    """Per-process core runtime."""
+
+    def __init__(
+        self,
+        mode: str,
+        session_dir: str,
+        head_sock: str,
+        config: Optional[CAConfig] = None,
+        client_id: Optional[str] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+        serve_addr: Optional[str] = None,
+    ):
+        self.mode = mode  # "driver" | "worker"
+        self.session_dir = session_dir
+        self.session_name = os.path.basename(session_dir)
+        self.head_sock = head_sock
+        self.config = config or get_config()
+        self.client_id = client_id or f"{mode}-{os.getpid()}-{os.urandom(3).hex()}"
+        self.serve_addr = serve_addr
+        self.job_id = JobID.from_random()
+        self.memory_store = MemoryStore()
+        self.shm_store = ShmObjectStore(self.session_name)
+        self.fn_manager = FunctionManager()
+        self.reference_counter = ReferenceCounter(self._flush_refs)
+        self._put_counter = _Counter()
+        self._task_counter = _Counter()
+        self.head: Optional[Connection] = None
+        self._conns: Dict[str, Connection] = {}
+        self._lease_pools: Dict[tuple, LeasePool] = {}
+        self._actor_addr_cache: Dict[str, Tuple[str, int]] = {}  # aid -> (addr, incarnation)
+        self.node_id: Optional[str] = None
+        self.total_resources: Dict[str, float] = {}
+        # device object table: oid-bytes -> live device value (owner side)
+        self.device_objects: Dict[bytes, Any] = {}
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+        self._stopped = False
+        self._external_loop = loop is not None
+        if loop is None:
+            self.loop = asyncio.new_event_loop()
+            self._io_thread = threading.Thread(
+                target=self._run_loop, name="ca-io", daemon=True
+            )
+            self._io_thread.start()
+        else:
+            self.loop = loop
+            self._io_thread = None
+
+    # ------------------------------------------------------------- io thread
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run_coro(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the IO loop from a user thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn_coro(self, coro):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+        def _report(f):
+            exc = f.exception()
+            if exc is not None:
+                import traceback
+
+                print(
+                    f"[ca] internal submission error: {exc!r}\n"
+                    + "".join(traceback.format_exception(exc)),
+                    flush=True,
+                )
+
+        fut.add_done_callback(_report)
+        return fut
+
+    # ------------------------------------------------------------- bootstrap
+    def connect(self):
+        async def _connect():
+            self.head = await connect_unix(self.head_sock)
+            self.head.set_push_handler(self._on_push)
+            reply = await self.head.call(
+                "register",
+                role=self.mode,
+                client_id=self.client_id,
+                pid=os.getpid(),
+                addr=self.serve_addr or "",
+            )
+            self.node_id = reply["node_id"]
+            self.total_resources = reply["resources"]
+            asyncio.ensure_future(self._housekeeping())
+
+        self.run_coro(_connect(), timeout=30)
+
+    async def connect_async(self):
+        self.head = await connect_unix(self.head_sock)
+        self.head.set_push_handler(self._on_push)
+        reply = await self.head.call(
+            "register",
+            role=self.mode,
+            client_id=self.client_id,
+            pid=os.getpid(),
+            addr=self.serve_addr or "",
+        )
+        self.node_id = reply["node_id"]
+        self.total_resources = reply["resources"]
+        asyncio.ensure_future(self._housekeeping())
+
+    async def _on_push(self, msg):
+        if msg.get("m") == "pub" and msg.get("ch") == "actors":
+            data = msg.get("data") or {}
+            aid = data.get("actor_id")
+            if aid and data.get("addr"):
+                self._actor_addr_cache[aid] = (data["addr"], data.get("incarnation", 0))
+
+    async def _housekeeping(self):
+        period = 0.25
+        while not self._stopped:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            to_return = []
+            for pool in self._lease_pools.values():
+                to_return.extend(pool.reap_idle(now, self.config.lease_idle_timeout_s))
+            if to_return and self.head and not self.head.closed:
+                try:
+                    self.head.notify("return_lease", lease_ids=to_return)
+                except Exception:
+                    pass
+            self.reference_counter.flush()
+
+    def _flush_refs(self, inc: List[bytes], dec: List[bytes]):
+        def _send():
+            if self.head is not None and not self.head.closed:
+                try:
+                    self.head.notify("obj_refs", inc=inc, dec=dec)
+                except Exception:
+                    pass
+
+        try:
+            self.loop.call_soon_threadsafe(_send)
+        except RuntimeError:
+            pass
+
+    async def conn_to(self, addr: str) -> Connection:
+        conn = self._conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await connect_unix(addr)
+            self._conns[addr] = conn
+        return conn
+
+    # ------------------------------------------------------------------ put
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed")
+        task_id = self.current_task_id or TaskID.for_normal_task(self.job_id)
+        oid = ObjectID.for_put(task_id, self._put_counter.next())
+        self._put_value(oid, value)
+        ref = ObjectRef(oid, owner=self.client_id, worker=self)
+        self.reference_counter.add_owned(oid)
+        return ref
+
+    def _put_value(self, oid: ObjectID, value: Any):
+        if _is_device_value(value):
+            self.device_objects[oid.binary()] = value
+            self.memory_store.put_value(oid, value)
+            return
+        data, buffers = serialization.serialize(value)
+        raws = [b.raw() for b in buffers]
+        total = len(data) + sum(len(r) for r in raws)
+        if total < self.config.inline_object_max_bytes:
+            self.memory_store.put_value(oid, value, size=total)
+        else:
+            shm_name, size = self.shm_store.create_and_pack(oid, data, raws)
+            self.memory_store.put_shm(oid, shm_name, size)
+
+            def _notify():
+                if self.head and not self.head.closed:
+                    try:
+                        self.head.notify(
+                            "obj_created", oid=oid.binary(), shm_name=shm_name, size=size
+                        )
+                    except Exception:
+                        pass
+
+            self.loop.call_soon_threadsafe(_notify)
+
+    # ------------------------------------------------------------------ get
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        oids = [r.id for r in ref_list]
+        notified = False
+        if self.mode == "worker" and not all(self.memory_store.contains(o) for o in oids):
+            self._notify_blocked(True)
+            notified = True
+        try:
+            ready, not_ready = self.memory_store.wait_ready(oids, len(oids), timeout)
+            if not_ready:
+                raise GetTimeoutError(f"get() timed out waiting for {len(not_ready)} objects")
+            values = [self._resolve_entry(r) for r in ref_list]
+        finally:
+            if notified:
+                self._notify_blocked(False)
+        return values[0] if single else values
+
+    def _notify_blocked(self, blocked: bool):
+        def _send():
+            if self.head and not self.head.closed:
+                try:
+                    self.head.notify(
+                        "worker_blocked" if blocked else "worker_unblocked",
+                        client_id=self.client_id,
+                    )
+                except Exception:
+                    pass
+
+        try:
+            self.loop.call_soon_threadsafe(_send)
+        except RuntimeError:
+            pass
+
+    def _resolve_entry(self, ref: ObjectRef) -> Any:
+        e = self.memory_store.get_entry(ref.id)
+        if e is None:
+            raise ObjectLostError(f"object {ref.id} unknown")
+        if e.state == "value":
+            return e.value
+        if e.state == "error":
+            raise e.error
+        if e.state == "packed":
+            value = serialization.unpack(e.packed)
+            self.memory_store.put_value(ref.id, value, size=e.size)
+            return value
+        if e.state == "shm":
+            value = self.shm_store.get(e.shm_name)
+            # cache the value; e.shm_name is kept so args can still be passed
+            # by shm reference instead of re-packing
+            e.value = value
+            e.state = "value"
+            return value
+        if e.state == "device":
+            # device value owned by another process: explicit materialization
+            return self._fetch_remote(ref, e)
+        raise ObjectLostError(f"object {ref.id} in unexpected state {e.state}")
+
+    def _fetch_remote(self, ref: ObjectRef, e: _Entry) -> Any:
+        owner_addr = e.shm_name  # device entries store owner addr here
+        reply = self.run_coro(self._fetch_remote_async(owner_addr, ref.id.binary()))
+        value = serialization.unpack(reply["packed"])
+        self.memory_store.put_value(ref.id, value)
+        return value
+
+    async def _fetch_remote_async(self, addr: str, oid: bytes):
+        conn = await self.conn_to(addr)
+        return await conn.call("fetch_object", oid=oid, timeout=self.config.push_timeout_s)
+
+    # ------------------------------------------------------------------ wait
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None):
+        ref_list = list(refs)
+        if num_returns > len(ref_list):
+            raise ValueError("num_returns exceeds number of refs")
+        ready_ids, rest_ids = self.memory_store.wait_ready(
+            [r.id for r in ref_list], num_returns, timeout
+        )
+        ready_set = set(ready_ids)
+        ready, rest = [], []
+        for r in ref_list:
+            (ready if r.id in ready_set and len(ready) < num_returns else rest).append(r)
+        return ready, rest
+
+    def resolve_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _wait():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=_wait, daemon=True).start()
+        return fut
+
+    # ----------------------------------------------------------- arg packing
+    async def _build_arg(self, value: Any) -> dict:
+        """Build the wire spec for one task argument."""
+        if isinstance(value, ObjectRef):
+            oid = value.id
+            # dependency resolution: wait until the local entry is ready
+            while True:
+                e = self.memory_store.get_entry(oid)
+                if e is None:
+                    raise ObjectLostError(f"arg object {oid} unknown to this process")
+                if e.state != "pending":
+                    break
+                await asyncio.sleep(0.002)
+            if e.state == "error":
+                raise e.error
+            if e.state == "device":
+                return {"dev": oid.binary(), "owner": e.shm_name, "spec": e.value}
+            if e.shm_name and e.state in ("shm", "value"):
+                # keep shm provenance even after a local zero-copy read
+                return {"shm": e.shm_name, "size": e.size, "oid": oid.binary()}
+            if oid.binary() in self.device_objects:
+                if not self.serve_addr:
+                    # driver has no serving socket: materialize to host
+                    import jax
+
+                    return {
+                        "v": serialization.pack(
+                            jax.device_get(self.device_objects[oid.binary()])
+                        )
+                    }
+                return {
+                    "dev": oid.binary(),
+                    "owner": self.serve_addr,
+                    "spec": _device_spec(self.device_objects[oid.binary()]),
+                }
+            # small local value: inline (packed)
+            if e.state == "packed":
+                return {"v": e.packed}
+            return {"v": serialization.pack(e.value)}
+        # plain value: device values stay on device when this process can
+        # serve them (workers/actors); the driver materializes to host.
+        if _is_device_value(value):
+            if not self.serve_addr:
+                import jax
+
+                return {"v": serialization.pack(jax.device_get(value))}
+            ref = self.put(value)
+            return {
+                "dev": ref.id.binary(),
+                "owner": self.serve_addr,
+                "spec": _device_spec(value),
+            }
+        return {"v": serialization.pack(value)}
+
+    async def _build_args(self, args: Sequence[Any], kwargs: Dict[str, Any]):
+        specs = [await self._build_arg(a) for a in args]
+        kwspecs = {k: await self._build_arg(v) for k, v in kwargs.items()}
+        return specs, kwspecs
+
+    # ---------------------------------------------------------- task submit
+    def submit_task(self, fn, args, kwargs, opts: Dict[str, Any]) -> List[ObjectRef]:
+        num_returns = opts.get("num_returns", 1)
+        task_id = TaskID.for_normal_task(self.job_id)
+        oids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
+        for oid in oids:
+            self.memory_store.mark_pending(oid)
+            self.reference_counter.add_owned(oid)
+        refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
+        fn_id, blob = self.fn_manager.export(fn)
+        self.spawn_coro(self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids))
+        return refs
+
+    def _shape_of(self, opts) -> Dict[str, float]:
+        shape = dict(opts.get("resources") or {})
+        shape["CPU"] = float(opts.get("num_cpus", 1))
+        if opts.get("num_tpus"):
+            shape["TPU"] = float(opts["num_tpus"])
+        return {k: v for k, v in shape.items() if v}
+
+    def _lease_pool(self, opts) -> LeasePool:
+        shape = self._shape_of(opts)
+        pg = None
+        if opts.get("placement_group") is not None:
+            pg = (opts["placement_group"], opts.get("placement_group_bundle_index", 0))
+        key = (tuple(sorted(shape.items())), pg)
+        pool = self._lease_pools.get(key)
+        if pool is None:
+            pool = LeasePool(self, key, shape, pg)
+            self._lease_pools[key] = pool
+        return pool
+
+    async def _submit_task(self, task_id, fn_id, blob, args, kwargs, opts, oids):
+        try:
+            if blob is not None:
+                await self.head.call("register_function", fn_id=fn_id, blob=blob)
+                self.fn_manager.mark_exported(fn_id)
+            specs, kwspecs = await self._build_args(args, kwargs)
+        except BaseException as e:
+            for oid in oids:
+                self.memory_store.put_error(oid, e if isinstance(e, CAError) else TaskError(repr(e)))
+            return
+        retries = opts.get("max_retries", self.config.default_max_retries)
+        pool = self._lease_pool(opts)
+        while True:
+            try:
+                lease = await pool.acquire()
+            except BaseException as e:
+                for oid in oids:
+                    self.memory_store.put_error(
+                        oid, e if isinstance(e, CAError) else TaskError(repr(e))
+                    )
+                return
+            dead = False
+            try:
+                conn = await self.conn_to(lease.addr)
+                # no RPC timeout here: the reply arrives only after the task
+                # finishes, which may legitimately take arbitrarily long;
+                # worker death is detected by the connection breaking.
+                reply = await conn.call(
+                    "push_task",
+                    task_id=task_id.binary(),
+                    fn_id=fn_id,
+                    owner=self.client_id,
+                    args=specs,
+                    kwargs=kwspecs,
+                    num_returns=opts.get("num_returns", 1),
+                    timeout=None,
+                )
+            except ConnectionError as e:
+                dead = True
+                if retries > 0:
+                    retries -= 1
+                    continue
+                for oid in oids:
+                    self.memory_store.put_error(
+                        oid, WorkerCrashedError(f"worker died executing task: {e}")
+                    )
+                return
+            finally:
+                pool.release(lease, dead=dead)
+            self._store_results(oids, reply["results"], lease.addr)
+            return
+
+    def _store_results(self, oids: List[ObjectID], results: List[dict], exec_addr: str):
+        for oid, res in zip(oids, results):
+            if "e" in res:
+                import pickle
+
+                self.memory_store.put_error(oid, pickle.loads(res["e"]))
+            elif "v" in res:
+                self.memory_store.put_packed(oid, res["v"])
+            elif "shm" in res:
+                self.memory_store.put_shm(oid, res["shm"], res.get("size", 0))
+            elif "dev" in res:
+                e = _Entry("device", value=res.get("spec"), shm_name=res.get("owner", exec_addr))
+                with self.memory_store._cv:
+                    self.memory_store._entries[oid] = e
+                    self.memory_store._cv.notify_all()
+
+    # ------------------------------------------------------------- actors
+    def create_actor(self, cls, args, kwargs, opts: Dict[str, Any]) -> Tuple[ActorID, str]:
+        actor_id = ActorID.of(self.job_id)
+        fn_id, blob = self.fn_manager.export(cls)
+
+        async def _create():
+            if blob is not None:
+                await self.head.call("register_function", fn_id=fn_id, blob=blob)
+                self.fn_manager.mark_exported(fn_id)
+            specs, kwspecs = await self._build_args(args, kwargs)
+            init_spec = serialization.pack((specs, kwspecs))
+            shape = dict(opts.get("resources") or {})
+            if opts.get("num_cpus"):
+                shape["CPU"] = float(opts["num_cpus"])
+            if opts.get("num_tpus"):
+                shape["TPU"] = float(opts["num_tpus"])
+            reply = await self.head.call(
+                "create_actor",
+                actor_id=actor_id.hex(),
+                name=opts.get("name"),
+                fn_id=fn_id,
+                init_spec=init_spec,
+                resources=shape,
+                max_restarts=opts.get("max_restarts", self.config.default_actor_max_restarts),
+                detached=(opts.get("lifetime") == "detached"),
+                max_concurrency=opts.get("max_concurrency", 1),
+                pg_id=opts.get("placement_group"),
+                bundle_index=opts.get("placement_group_bundle_index", -1),
+                timeout=None,
+            )
+            return reply
+
+        reply = self.run_coro(_create())
+        self._actor_addr_cache[actor_id.hex()] = (reply["addr"], reply["incarnation"])
+        return actor_id, reply["addr"]
+
+    async def _actor_addr(self, actor_id_hex: str, refresh: bool = False) -> str:
+        if not refresh:
+            cached = self._actor_addr_cache.get(actor_id_hex)
+            if cached is not None:
+                return cached[0]
+        deadline = time.monotonic() + 30.0
+        while True:
+            reply = await self.head.call("get_actor", actor_id=actor_id_hex)
+            state = reply["state"]
+            if state == "alive":
+                self._actor_addr_cache[actor_id_hex] = (reply["addr"], reply["incarnation"])
+                return reply["addr"]
+            if state == "dead":
+                raise ActorDiedError(reply.get("death_cause") or "actor is dead")
+            if time.monotonic() > deadline:
+                raise ActorDiedError(f"actor stuck in state {state}")
+            await asyncio.sleep(0.1)
+
+    def submit_actor_task(self, actor_id: ActorID, method: str, args, kwargs, opts) -> List[ObjectRef]:
+        num_returns = opts.get("num_returns", 1)
+        task_id = TaskID.for_actor_task(actor_id)
+        oids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
+        for oid in oids:
+            self.memory_store.mark_pending(oid)
+            self.reference_counter.add_owned(oid)
+        refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
+        self.spawn_coro(
+            self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
+        )
+        return refs
+
+    async def _submit_actor_task(self, actor_id, method, args, kwargs, opts, task_id, oids):
+        aid = actor_id.hex()
+        try:
+            specs, kwspecs = await self._build_args(args, kwargs)
+        except BaseException as e:
+            for oid in oids:
+                self.memory_store.put_error(oid, e if isinstance(e, CAError) else TaskError(repr(e)))
+            return
+        attempts = 1 + max(0, opts.get("max_task_retries", 0))
+        last_err: Optional[BaseException] = None
+        refresh = False
+        for _ in range(attempts + 1):
+            try:
+                addr = await self._actor_addr(aid, refresh=refresh)
+                conn = await self.conn_to(addr)
+                reply = await conn.call(
+                    "actor_call",
+                    actor_id=aid,
+                    method=method,
+                    task_id=task_id.binary(),
+                    owner=self.client_id,
+                    args=specs,
+                    kwargs=kwspecs,
+                    num_returns=opts.get("num_returns", 1),
+                    timeout=None,
+                )
+                self._store_results(oids, reply["results"], addr)
+                return
+            except (ConnectionError, asyncio.TimeoutError) as e:
+                last_err = ActorDiedError(
+                    f"actor {aid} died during call to {method!r}: {e}"
+                )
+                refresh = True
+                await asyncio.sleep(0.05)
+            except ActorDiedError as e:
+                last_err = e
+                break
+        for oid in oids:
+            self.memory_store.put_error(oid, last_err or ActorDiedError("actor call failed"))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.run_coro(
+            self.head.call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)
+        )
+
+    def get_actor_info(self, name: Optional[str] = None, actor_id: Optional[str] = None) -> dict:
+        return self.run_coro(self.head.call("get_actor", name=name, actor_id=actor_id))
+
+    # ------------------------------------------------------------- cluster
+    def head_call(self, method: str, **fields) -> dict:
+        return self.run_coro(self.head.call(method, **fields))
+
+    def shutdown(self, stop_cluster: bool = False):
+        self._stopped = True
+        try:
+            self.reference_counter.flush()
+        except Exception:
+            pass
+        if stop_cluster and self.head is not None and not self.head.closed:
+            try:
+                self.run_coro(self.head.call("job_stop", timeout=2.0), timeout=3.0)
+            except Exception:
+                pass
+
+        async def _close_all():
+            if self.head is not None:
+                await self.head.close()
+            for c in self._conns.values():
+                await c.close()
+
+        try:
+            self.run_coro(_close_all(), timeout=5)
+        except Exception:
+            pass
+        if self._io_thread is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._io_thread.join(timeout=2)
+        set_global_worker(None)

@@ -1,0 +1,303 @@
+"""Worker process main: executes pushed tasks and hosts actors.
+
+The analogue of the reference's worker-side TaskReceiver + scheduling queues
+(src/ray/core_worker/transport/task_receiver.h): a unix-socket server receives
+direct task pushes from drivers/other workers, executes them on an executor
+(single thread by default; a pool for max_concurrency>1; the asyncio loop for
+async-def actor methods), and replies with inline / shm / device-ref results.
+
+Each worker process embeds a full Worker runtime so task code can itself call
+remote()/get()/put() (nested tasks), sharing the process's asyncio loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from . import serialization
+from .config import CAConfig, set_config
+from .errors import TaskCancelledError, TaskError
+from .ids import ActorID, ObjectID, TaskID
+from .object_ref import ObjectRef
+from .protocol import Server
+from .worker import Worker, _device_spec, _is_device_value, set_global_worker
+
+
+class ActorContext:
+    def __init__(self, actor_id: str, instance: Any, max_concurrency: int, incarnation: int):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.incarnation = incarnation
+
+
+class WorkerProcess:
+    def __init__(self):
+        self.session_dir = os.environ["CA_SESSION_DIR"]
+        self.head_sock = os.environ["CA_HEAD_SOCK"]
+        self.worker_id = os.environ["CA_WORKER_ID"]
+        self.sock_path = os.environ["CA_WORKER_SOCK"]
+        self.config = CAConfig.from_json(os.environ["CA_CONFIG_JSON"])
+        set_config(self.config)
+        self.loop = asyncio.new_event_loop()
+        self.worker: Optional[Worker] = None
+        self.server = Server(self.sock_path, self._handle)
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ca-exec"
+        )
+        self.actor: Optional[ActorContext] = None
+        self._exiting = False
+
+    # ----------------------------------------------------------- args/results
+    def _resolve_arg(self, spec: dict) -> Any:
+        if "v" in spec:
+            return serialization.unpack(spec["v"])
+        if "shm" in spec:
+            return self.worker.shm_store.get(spec["shm"])
+        if "dev" in spec:
+            oid = spec["dev"]
+            if spec.get("owner") == self.sock_path and oid in self.worker.device_objects:
+                return self.worker.device_objects[oid]
+            reply = asyncio.run_coroutine_threadsafe(
+                self.worker._fetch_remote_async(spec["owner"], oid), self.loop
+            ).result(self.config.push_timeout_s)
+            return serialization.unpack(reply["packed"])
+        raise ValueError(f"bad arg spec keys: {list(spec)}")
+
+    def _resolve_args(self, specs, kwspecs):
+        args = [self._resolve_arg(s) for s in specs]
+        kwargs = {k: self._resolve_arg(s) for k, s in (kwspecs or {}).items()}
+        return args, kwargs
+
+    def _package_result(self, oid_bytes: bytes, value: Any, owner: str) -> dict:
+        if _is_device_value(value):
+            self.worker.device_objects[oid_bytes] = value
+            return {"dev": oid_bytes, "owner": self.sock_path, "spec": _device_spec(value)}
+        data, buffers = serialization.serialize(value)
+        raws = [b.raw() for b in buffers]
+        total = len(data) + sum(len(r) for r in raws)
+        if total < self.config.inline_object_max_bytes:
+            return {"v": serialization.pack(value)}
+        oid = ObjectID(oid_bytes)
+        shm_name, size = self.worker.shm_store.create_and_pack(oid, data, raws)
+
+        def _notify():
+            # ownership of the returned object belongs to the *submitter*
+            # (reference ownership model): it decides when the segment dies.
+            try:
+                self.worker.head.notify(
+                    "obj_created", oid=oid_bytes, shm_name=shm_name, size=size, owner=owner
+                )
+            except Exception:
+                pass
+
+        self.loop.call_soon_threadsafe(_notify)
+        return {"shm": shm_name, "size": size}
+
+    def _package_results(
+        self, task_id: bytes, num_returns: int, value: Any, owner: str
+    ) -> List[dict]:
+        tid = TaskID(task_id)
+        if num_returns == 1:
+            values = [value]
+        else:
+            if not isinstance(value, (tuple, list)) or len(value) != num_returns:
+                raise TaskError(
+                    f"task declared num_returns={num_returns} but returned {type(value).__name__}"
+                )
+            values = list(value)
+        return [
+            self._package_result(ObjectID.for_return(tid, i).binary(), v, owner)
+            for i, v in enumerate(values)
+        ]
+
+    def _error_results(self, num_returns: int, exc: BaseException) -> List[dict]:
+        import pickle
+
+        if not isinstance(exc, TaskError):
+            exc = TaskError(repr(exc), traceback.format_exc())
+        blob = pickle.dumps(exc)
+        return [{"e": blob} for _ in range(num_returns)]
+
+    # --------------------------------------------------------------- execute
+    def _exec_sync(self, fn, msg, task_id: bytes, actor_id: Optional[str]):
+        """Arg resolution + user code, both inside the executor job so that
+        per-caller actor-call ordering is preserved end-to-end (frames are
+        submitted to the executor in arrival order)."""
+        args, kwargs = self._resolve_args(msg["args"], msg.get("kwargs"))
+        w = self.worker
+        w.current_task_id = TaskID(task_id)
+        if actor_id:
+            w.current_actor_id = ActorID.from_hex(actor_id)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            w.current_task_id = None
+
+    async def _execute(self, msg, is_actor_call: bool) -> List[dict]:
+        num_returns = msg.get("num_returns", 1)
+        task_id = msg.get("task_id") or os.urandom(16)
+        try:
+            if is_actor_call:
+                if self.actor is None or self.actor.actor_id != msg["actor_id"]:
+                    raise TaskError(f"actor {msg.get('actor_id')} not hosted here")
+                method = getattr(self.actor.instance, msg["method"])
+                if asyncio.iscoroutinefunction(method):
+                    args, kwargs = await self.loop.run_in_executor(
+                        None, self._resolve_args, msg["args"], msg.get("kwargs")
+                    )
+                    value = await method(*args, **kwargs)
+                else:
+                    value = await self.loop.run_in_executor(
+                        self.executor, self._exec_sync, method, msg, task_id, msg["actor_id"]
+                    )
+            else:
+                fn = self.worker.fn_manager.get(msg["fn_id"])
+                if fn is None:
+                    reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
+                    fn = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
+                value = await self.loop.run_in_executor(
+                    self.executor, self._exec_sync, fn, msg, task_id, None
+                )
+            return await self.loop.run_in_executor(
+                None,
+                self._package_results,
+                task_id,
+                num_returns,
+                value,
+                msg.get("owner", ""),
+            )
+        except SystemExit:
+            self._exiting = True
+            if self.actor is not None:
+                try:
+                    self.worker.head.notify("actor_exited", actor_id=self.actor.actor_id)
+                except Exception:
+                    pass
+            return self._error_results(num_returns, TaskError("actor exited via exit_actor()"))
+        except BaseException as e:
+            return self._error_results(num_returns, e)
+
+    # --------------------------------------------------------------- handlers
+    async def _handle(self, state, msg, reply, reply_err):
+        m = msg["m"]
+        if m == "push_task":
+            results = await self._execute(msg, is_actor_call=False)
+            reply(results=results)
+        elif m == "actor_call":
+            results = await self._execute(msg, is_actor_call=True)
+            reply(results=results)
+            if self._exiting:
+                await self._graceful_exit()
+        elif m == "spawn_actor":
+            try:
+                await self._spawn_actor(msg)
+                reply()
+            except BaseException as e:
+                reply_err(TaskError(repr(e), traceback.format_exc()))
+        elif m == "fetch_object":
+            try:
+                reply(packed=await self._fetch_object(msg["oid"]))
+            except BaseException as e:
+                reply_err(e)
+        elif m == "ping":
+            reply(worker_id=self.worker_id, actor=self.actor.actor_id if self.actor else None)
+        elif m == "actor_shutdown":
+            reply()
+            await self._graceful_exit()
+        elif m == "cancel":
+            reply()
+        else:
+            reply_err(ValueError(f"unknown worker method {m}"))
+
+    async def _spawn_actor(self, msg):
+        cls = self.worker.fn_manager.get(msg["fn_id"])
+        if cls is None:
+            reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
+            cls = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
+        specs, kwspecs = serialization.unpack(msg["init_spec"])
+        max_concurrency = msg.get("max_concurrency", 1)
+        if max_concurrency > 1:
+            self.executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max_concurrency, thread_name_prefix="ca-exec"
+            )
+
+        def _make():
+            args, kwargs = self._resolve_args(specs, kwspecs)
+            return cls(*args, **kwargs)
+
+        instance = await self.loop.run_in_executor(self.executor, _make)
+        self.actor = ActorContext(
+            msg["actor_id"], instance, max_concurrency, msg.get("incarnation", 0)
+        )
+        self.worker.current_actor_id = ActorID.from_hex(msg["actor_id"])
+
+    async def _fetch_object(self, oid: bytes) -> bytes:
+        value = self.worker.device_objects.get(oid)
+        if value is None:
+            e = self.worker.memory_store.get_entry(ObjectID(oid))
+            if e is None or e.state == "pending":
+                raise KeyError(f"object {oid.hex()} not found on this worker")
+            value = self.worker._resolve_entry(ObjectRef(ObjectID(oid)))
+        if _is_device_value(value):
+            import jax
+
+            value = jax.device_get(value)
+        return await self.loop.run_in_executor(None, serialization.pack, value)
+
+    async def _graceful_exit(self):
+        await asyncio.sleep(0.05)  # let replies flush
+        os._exit(0)
+
+    async def _heartbeat_loop(self):
+        period = self.config.health_check_period_s / 2
+        while True:
+            await asyncio.sleep(period)
+            try:
+                self.worker.head.notify("heartbeat", client_id=self.worker_id)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ main
+    async def _amain(self):
+        self.worker = Worker(
+            mode="worker",
+            session_dir=self.session_dir,
+            head_sock=self.head_sock,
+            config=self.config,
+            client_id=self.worker_id,
+            loop=self.loop,
+            serve_addr=self.sock_path,
+        )
+        set_global_worker(self.worker)
+        await self.server.start()
+        await self.worker.connect_async()
+        asyncio.ensure_future(self._heartbeat_loop())
+        # park forever; the head kills us at job teardown
+        await asyncio.Event().wait()
+
+    def main(self):
+        asyncio.set_event_loop(self.loop)
+        try:
+            self.loop.run_until_complete(self._amain())
+        except (KeyboardInterrupt, SystemExit):
+            pass
+
+
+def main():
+    # debugging facility: SIGUSR1 dumps all thread stacks to the worker log
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+    WorkerProcess().main()
+
+
+if __name__ == "__main__":
+    main()

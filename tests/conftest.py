@@ -1,0 +1,44 @@
+"""Test configuration.
+
+Tensor-plane tests run on a virtual 8-device CPU mesh (the reference tests
+"distributed" behavior in-process the same way — cluster_utils.Cluster); the
+env vars must be set before jax is first imported anywhere in the process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ca_cluster():
+    """A running local cluster, torn down after the test (analogue of the
+    reference's ray_start_regular fixture)."""
+    import cluster_anywhere_tpu as ca
+
+    if ca.is_initialized():
+        ca.shutdown()
+    info = ca.init(num_cpus=4)
+    yield info
+    ca.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ca_cluster_module():
+    import cluster_anywhere_tpu as ca
+
+    if ca.is_initialized():
+        ca.shutdown()
+    info = ca.init(num_cpus=4)
+    yield info
+    ca.shutdown()

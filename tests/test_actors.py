@@ -1,0 +1,191 @@
+"""Actor tests: creation, state, named actors, restart, async actors,
+handles passed to tasks.  Modeled on python/ray/tests/test_actor*.py coverage.
+"""
+
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+
+
+@ca.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+    def pid(self):
+        import os
+
+        return os.getpid()
+
+
+def test_actor_basic(ca_cluster_module):
+    c = Counter.remote(10)
+    assert ca.get(c.inc.remote()) == 11
+    assert ca.get(c.inc.remote(5)) == 16
+    assert ca.get(c.read.remote()) == 16
+
+
+def test_actor_ordering(ca_cluster_module):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    assert ca.get(refs) == list(range(1, 51))
+
+
+def test_actor_method_error(ca_cluster_module):
+    c = Counter.remote()
+    with pytest.raises(ca.TaskError, match="actor method failed"):
+        ca.get(c.fail.remote())
+    # actor still alive after an application error
+    assert ca.get(c.read.remote()) == 0
+
+
+def test_two_actors_isolated(ca_cluster_module):
+    a = Counter.remote()
+    b = Counter.remote(100)
+    ca.get([a.inc.remote(), b.inc.remote()])
+    assert ca.get(a.read.remote()) == 1
+    assert ca.get(b.read.remote()) == 101
+    assert ca.get(a.pid.remote()) != ca.get(b.pid.remote())
+
+
+def test_named_actor(ca_cluster_module):
+    Counter.options(name="counter-x").remote(7)
+    h = ca.get_actor("counter-x")
+    assert ca.get(h.read.remote()) == 7
+    with pytest.raises(ValueError):
+        Counter.options(name="counter-x").remote()
+
+
+def test_actor_handle_in_task(ca_cluster_module):
+    c = Counter.remote()
+
+    @ca.remote
+    def bump(handle, times):
+        import cluster_anywhere_tpu as ca2
+
+        for _ in range(times):
+            ca2.get(handle.inc.remote())
+        return True
+
+    ca.get(bump.remote(c, 5))
+    assert ca.get(c.read.remote()) == 5
+
+
+def test_kill_actor(ca_cluster_module):
+    c = Counter.remote()
+    assert ca.get(c.inc.remote()) == 1
+    ca.kill(c)
+    time.sleep(0.3)
+    with pytest.raises(ca.ActorDiedError):
+        ca.get(c.read.remote())
+
+
+def test_actor_restart(ca_cluster_module):
+    @ca.remote
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+        def read(self):
+            return self.n
+
+    f = Flaky.options(max_restarts=2).remote()
+    assert ca.get(f.read.remote()) == 0
+    try:
+        ca.get(f.crash.remote())
+    except ca.CAError:
+        pass
+    # wait for restart, then state is fresh
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            assert ca.get(f.read.remote()) == 0
+            break
+        except ca.CAError:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_actor_no_restart_dies(ca_cluster_module):
+    @ca.remote
+    class Fragile:
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+        def ok(self):
+            return 1
+
+    f = Fragile.remote()
+    with pytest.raises(ca.CAError):
+        ca.get(f.crash.remote())
+    time.sleep(0.3)
+    with pytest.raises(ca.ActorDiedError):
+        ca.get(f.ok.remote())
+
+
+def test_async_actor(ca_cluster_module):
+    @ca.remote
+    class AsyncWorkerActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return x * 2
+
+    a = AsyncWorkerActor.remote()
+    t0 = time.time()
+    refs = [a.work.remote(i) for i in range(10)]
+    assert ca.get(refs) == [2 * i for i in range(10)]
+    # concurrent execution: 10 x 50ms sleeps should overlap
+    assert time.time() - t0 < 1.5
+
+
+def test_exit_actor(ca_cluster_module):
+    @ca.remote
+    class Quitter:
+        def quit(self):
+            ca.exit_actor()
+
+        def ok(self):
+            return 1
+
+    q = Quitter.options(max_restarts=5).remote()
+    with pytest.raises(ca.CAError):
+        ca.get(q.quit.remote())
+    time.sleep(0.5)
+    # exit_actor is a graceful exit: no restart even with budget
+    with pytest.raises(ca.ActorDiedError):
+        ca.get(q.ok.remote())
+
+
+def test_actor_resource_reservation(ca_cluster):
+    # cluster has 4 CPUs; an actor reserving 2 leaves 2
+    @ca.remote
+    class Hog:
+        def ok(self):
+            return 1
+
+    h = Hog.options(num_cpus=2).remote()
+    assert ca.get(h.ok.remote()) == 1
+    avail = ca.available_resources()
+    assert avail["CPU"] <= 2.0

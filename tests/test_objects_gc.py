@@ -1,0 +1,81 @@
+"""Object lifecycle: shm GC on ref drop, ownership of task returns, lease
+failure surfacing (regression tests for review findings)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+
+
+def _session_shm_files(info):
+    d = os.path.join("/dev/shm", os.path.basename(info["session_dir"]))
+    return os.listdir(d) if os.path.isdir(d) else []
+
+
+def test_put_object_gc_after_ref_drop(ca_cluster):
+    info = ca_cluster
+    ref = ca.put(np.ones(1_000_000))
+    ca.get(ref)
+    assert len(_session_shm_files(info)) == 1
+    del ref
+    deadline = time.time() + 5
+    while time.time() < deadline and _session_shm_files(info):
+        time.sleep(0.2)
+    assert _session_shm_files(info) == []
+
+
+def test_task_return_gc_after_ref_drop(ca_cluster):
+    info = ca_cluster
+
+    @ca.remote
+    def big():
+        return np.ones(1_000_000)
+
+    ref = big.remote()
+    assert ca.get(ref).shape == (1_000_000,)
+    assert len(_session_shm_files(info)) == 1
+    del ref
+    deadline = time.time() + 5
+    while time.time() < deadline and _session_shm_files(info):
+        time.sleep(0.2)
+    assert _session_shm_files(info) == []
+
+
+def test_removed_pg_lease_error_surfaces(ca_cluster):
+    pg = ca.placement_group([{"CPU": 1}])
+    ca.remove_placement_group(pg)
+
+    @ca.remote
+    def f():
+        return 1
+
+    ref = f.options(placement_group=pg).remote()
+    with pytest.raises(ca.CAError):
+        ca.get(ref, timeout=10)
+
+
+def test_named_actor_reusable_after_init_failure(ca_cluster):
+    @ca.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("nope")
+
+    @ca.remote
+    class Good:
+        def ok(self):
+            return 42
+
+    with pytest.raises(ca.CAError):
+        Bad.options(name="svc").remote()
+    g = Good.options(name="svc").remote()
+    assert ca.get(g.ok.remote()) == 42
+
+
+def test_shm_value_still_readable_while_ref_held(ca_cluster):
+    ref = ca.put(np.arange(500_000))
+    for _ in range(3):
+        out = ca.get(ref)
+        assert out[-1] == 499_999
