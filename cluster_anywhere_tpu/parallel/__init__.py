@@ -1,0 +1,25 @@
+"""First-class parallelism strategies over jax device meshes.
+
+DP/FSDP/TP via GSPMD sharding annotations (mesh.py, sharding.py), PP via
+shard_map ppermute schedules (pipeline.py), SP/CP via ring attention
+(ring_attention.py) and Ulysses all-to-all (ulysses.py), EP via switch-style
+MoE with all-to-all routing (moe.py), plus a reference-parity collective API
+(collectives.py).
+"""
+
+from .mesh import AXES, MeshSpec, auto_spec, local_mesh, make_mesh
+from .sharding import DEFAULT_RULES, P, constraint, logical_to_spec, named_sharding, shard_pytree
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "auto_spec",
+    "local_mesh",
+    "make_mesh",
+    "DEFAULT_RULES",
+    "P",
+    "constraint",
+    "logical_to_spec",
+    "named_sharding",
+    "shard_pytree",
+]

@@ -1,0 +1,235 @@
+"""Collective communication API, mirroring the surface of the reference's
+ray.util.collective (SURVEY.md §2.3: init_collective_group / allreduce /
+allgather / reducescatter / broadcast / send-recv / barrier) with two
+TPU-native backends:
+
+- "xla": in-graph collectives for device tensors — thin wrappers over
+  lax.psum/all_gather/psum_scatter/ppermute for use inside jit/shard_map.
+  On TPU these compile to ICI transfers; this is the fast tensor plane and
+  replaces the reference's NCCL backend.
+- "host": out-of-graph collectives for host (numpy) data between actors —
+  rendezvous through the head's KV store, the Gloo-equivalent control-plane
+  backend.  Used for coordination data, not bulk tensors.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_groups: Dict[str, "HostCollectiveGroup"] = {}
+
+
+# ---------------------------------------------------------------------------
+# host backend (Gloo-equivalent): KV-rendezvous reductions between processes
+# ---------------------------------------------------------------------------
+
+
+class HostCollectiveGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str = "default"):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._seq = 0
+
+    def _ns(self, op: str) -> str:
+        return f"__collective__/{self.group_name}/{self._seq}/{op}"
+
+    def _kv(self):
+        from ..core.worker import global_worker
+
+        return global_worker()
+
+    def _put(self, ns: str, key: str, value: Any):
+        self._kv().head_call("kv_put", ns=ns, key=key, value=pickle.dumps(value))
+
+    def _gather_all(self, ns: str, timeout: float = 60.0) -> List[Any]:
+        w = self._kv()
+        deadline = time.monotonic() + timeout
+        while True:
+            keys = w.head_call("kv_keys", ns=ns)["keys"]
+            if len(keys) >= self.world_size:
+                out = []
+                for r in range(self.world_size):
+                    v = w.head_call("kv_get", ns=ns, key=str(r))["value"]
+                    out.append(pickle.loads(v))
+                return out
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {ns}: only {len(keys)}/{self.world_size} arrived"
+                )
+            time.sleep(0.005)
+
+    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        ns = self._ns("allreduce")
+        self._seq += 1
+        self._put(ns, str(self.rank), np.asarray(tensor))
+        parts = self._gather_all(ns)
+        stack = np.stack(parts)
+        if op == "sum":
+            return stack.sum(axis=0)
+        if op == "max":
+            return stack.max(axis=0)
+        if op == "min":
+            return stack.min(axis=0)
+        if op == "mean":
+            return stack.mean(axis=0)
+        raise ValueError(f"unsupported op {op}")
+
+    def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
+        ns = self._ns("allgather")
+        self._seq += 1
+        self._put(ns, str(self.rank), np.asarray(tensor))
+        return self._gather_all(ns)
+
+    def reducescatter(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        full = self.allreduce(tensor, op)
+        return np.array_split(full, self.world_size)[self.rank]
+
+    def broadcast(self, tensor: Optional[np.ndarray], src_rank: int = 0) -> np.ndarray:
+        ns = self._ns("broadcast")
+        self._seq += 1
+        if self.rank == src_rank:
+            self._put(ns, "0", np.asarray(tensor))
+        w = self._kv()
+        deadline = time.monotonic() + 60.0
+        while True:
+            v = w.head_call("kv_get", ns=ns, key="0")["value"]
+            if v is not None:
+                return pickle.loads(v)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"broadcast {ns} timed out")
+            time.sleep(0.005)
+
+    def barrier(self):
+        self.allreduce(np.zeros(1))
+
+    def send(self, tensor: np.ndarray, dst_rank: int):
+        ns = f"__collective__/{self.group_name}/p2p/{self.rank}->{dst_rank}"
+        self._put(ns, str(self._seq), np.asarray(tensor))
+        self._seq += 1
+
+    def recv(self, src_rank: int, timeout: float = 60.0) -> np.ndarray:
+        ns = f"__collective__/{self.group_name}/p2p/{src_rank}->{self.rank}"
+        w = self._kv()
+        deadline = time.monotonic() + timeout
+        while True:
+            keys = sorted(w.head_call("kv_keys", ns=ns)["keys"], key=int)
+            if keys:
+                key = keys[0]
+                v = w.head_call("kv_get", ns=ns, key=key)["value"]
+                w.head_call("kv_del", ns=ns, key=key)
+                return pickle.loads(v)
+            if time.monotonic() > deadline:
+                raise TimeoutError("recv timed out")
+            time.sleep(0.005)
+
+
+# ---------------------------------------------------------------------------
+# public API (reference-parity surface)
+# ---------------------------------------------------------------------------
+
+
+def init_collective_group(
+    world_size: int, rank: int, backend: str = "host", group_name: str = "default"
+) -> HostCollectiveGroup:
+    if backend not in ("host", "gloo"):
+        raise ValueError(
+            "out-of-graph groups support the 'host' backend; device tensors "
+            "use in-graph xla collectives (cluster_anywhere_tpu.parallel.collectives.xla)"
+        )
+    g = HostCollectiveGroup(world_size, rank, group_name)
+    _groups[group_name] = g
+    return g
+
+
+def get_group(group_name: str = "default") -> HostCollectiveGroup:
+    if group_name not in _groups:
+        raise ValueError(f"collective group {group_name!r} not initialized")
+    return _groups[group_name]
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _groups.pop(group_name, None)
+
+
+def allreduce(tensor, op: str = "sum", group_name: str = "default"):
+    return get_group(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return get_group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, op: str = "sum", group_name: str = "default"):
+    return get_group(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(tensor, src_rank)
+
+
+def barrier(group_name: str = "default"):
+    return get_group(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    return get_group(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return get_group(group_name).recv(src_rank)
+
+
+# ---------------------------------------------------------------------------
+# xla backend: in-graph device collectives (use inside jit / shard_map)
+# ---------------------------------------------------------------------------
+
+
+class xla:
+    """In-graph collectives over mesh axes — the TPU tensor plane."""
+
+    @staticmethod
+    def allreduce(x, axis_name: str):
+        from jax import lax
+
+        return lax.psum(x, axis_name)
+
+    @staticmethod
+    def allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+        from jax import lax
+
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+    @staticmethod
+    def reducescatter(x, axis_name: str, axis: int = 0, tiled: bool = True):
+        from jax import lax
+
+        return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+
+    @staticmethod
+    def broadcast(x, axis_name: str, src_index: int = 0):
+        from jax import lax
+        import jax.numpy as jnp
+
+        idx = lax.axis_index(axis_name)
+        return lax.psum(jnp.where(idx == src_index, x, jnp.zeros_like(x)), axis_name)
+
+    @staticmethod
+    def permute(x, axis_name: str, perm):
+        from jax import lax
+
+        return lax.ppermute(x, axis_name, perm)
+
+    @staticmethod
+    def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool = True):
+        from jax import lax
+
+        return lax.all_to_all(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+        )

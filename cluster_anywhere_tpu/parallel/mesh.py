@@ -1,0 +1,94 @@
+"""Device mesh construction with canonical parallelism axes.
+
+The reference leaves parallelism strategy to external libraries (SURVEY.md
+§2.3: Ray supplies placement groups + collectives and defers DP/TP/PP/SP/EP
+to torch/vLLM/DeepSpeed).  Here the strategies are first-class: every model
+and train step in this framework is expressed over a `jax.sharding.Mesh` with
+the canonical axis names below, and XLA compiles the collectives onto ICI.
+
+Axes (size 1 = disabled, always present so PartitionSpecs are stable):
+  dp    data parallel (gradient allreduce)
+  fsdp  fully-sharded data parallel (params/opt-state sharded, allgather at use)
+  pp    pipeline parallel (stage-partitioned layers, ppermute microbatches)
+  tp    tensor parallel (matmul-sharded, allreduce/allgather activations)
+  sp    sequence/context parallel (ring attention / Ulysses all-to-all)
+  ep    expert parallel (MoE experts sharded, all-to-all token routing)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+from typing import Optional, Sequence
+
+AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for f in fields(self):
+            n *= getattr(self, f.name)
+        return n
+
+    def axis_sizes(self):
+        return tuple(getattr(self, a) for a in AXES)
+
+    def __str__(self):
+        return "x".join(f"{a}{getattr(self, a)}" for a in AXES if getattr(self, a) > 1) or "single"
+
+
+def make_mesh(spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = None, **axes):
+    """Build a Mesh over `devices` (default: all) shaped by `spec`.
+
+    Axis ordering follows AXES with dp outermost — adjacent mesh dims map to
+    adjacent devices, so the innermost axes (tp/sp/ep, which carry the most
+    collective traffic) land on nearest-neighbour ICI links.
+    """
+    import jax
+    import numpy as np
+
+    if spec is None:
+        spec = MeshSpec(**axes)
+    elif axes:
+        raise ValueError("pass either a MeshSpec or axis kwargs, not both")
+    if devices is None:
+        devices = jax.devices()
+    if spec.size != len(devices):
+        raise ValueError(
+            f"mesh spec {spec} needs {spec.size} devices, got {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(spec.axis_sizes())
+    return jax.sharding.Mesh(arr, AXES)
+
+
+def auto_spec(
+    n_devices: int,
+    tp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    fsdp: int = 1,
+) -> MeshSpec:
+    """Fill the dp axis with whatever is left after the explicit axes."""
+    used = tp * pp * sp * ep * fsdp
+    if n_devices % used != 0:
+        raise ValueError(f"{n_devices} devices not divisible by tp*pp*sp*ep*fsdp={used}")
+    return MeshSpec(dp=n_devices // used, fsdp=fsdp, pp=pp, tp=tp, sp=sp, ep=ep)
+
+
+def local_mesh(**axes):
+    """Mesh over this process's local devices (single-host)."""
+    import jax
+
+    return make_mesh(devices=jax.local_devices(), **axes) if axes else make_mesh(
+        MeshSpec(dp=len(jax.local_devices()))
+    )

@@ -1,0 +1,92 @@
+"""Expert parallelism: switch-style Mixture-of-Experts FFN with capacity-based
+top-1 routing and all-to-all token exchange over the 'ep' mesh axis.
+
+Dispatch/combine are expressed as one-hot einsums (MXU-friendly, static
+shapes — no gather/scatter), the standard TPU MoE formulation.  Experts'
+weights are sharded over 'ep'; tokens travel to their expert's device via
+`lax.all_to_all` and return after the expert FFN.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEOutput(NamedTuple):
+    out: jax.Array
+    aux_loss: jax.Array  # load-balancing loss (Switch Transformer style)
+
+
+def moe_ffn(
+    x: jax.Array,  # [N_local_tokens, E]
+    router_w: jax.Array,  # [E, n_experts] (replicated)
+    w_in: jax.Array,  # [local_experts, E, F]
+    w_out: jax.Array,  # [local_experts, F, E]
+    *,
+    axis_name: str = "ep",
+    capacity_factor: float = 1.25,
+) -> MoEOutput:
+    """Call inside shard_map (manual over `axis_name`)."""
+    ep = lax.psum(1, axis_name)
+    n_local, e_model = x.shape
+    local_experts = w_in.shape[0]
+    n_experts = ep * local_experts
+
+    logits = x @ router_w  # [N, n_experts]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # top-1
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]  # [N]
+
+    capacity = int(max(1, (n_local * capacity_factor) // n_experts + 1))
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=x.dtype)  # [N, X]
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # position within expert
+    keep = (pos < capacity) & (onehot > 0)
+    pos_clamped = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_clamped, capacity, dtype=x.dtype) * keep.astype(x.dtype)[
+        :, :, None
+    ]
+    # dispatch tensor [N, X, C]
+    dispatch = onehot[:, :, None] * pos_onehot
+    combine = dispatch * gate[:, None, None]
+
+    # route tokens: [X, C, E] -> all_to_all over experts' owner devices
+    expert_in = jnp.einsum("nxc,ne->xce", dispatch, x)
+    expert_in = expert_in.reshape(ep, local_experts, capacity, e_model)
+    # each device receives, for its local experts, the token slots from every
+    # source device: [ep_src, local_experts, C, E]
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    expert_in = expert_in.transpose(1, 0, 2, 3).reshape(
+        local_experts, ep * capacity, e_model
+    )
+
+    h = jax.nn.silu(jnp.einsum("xne,xef->xnf", expert_in, w_in))
+    expert_out = jnp.einsum("xnf,xfe->xne", h, w_out)
+
+    # route back
+    expert_out = expert_out.reshape(local_experts, ep, capacity, e_model).transpose(
+        1, 0, 2, 3
+    )
+    expert_out = lax.all_to_all(expert_out, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    expert_out = expert_out.reshape(n_experts, capacity, e_model)
+    out = jnp.einsum("nxc,xce->ne", combine, expert_out)
+
+    # load-balance aux loss: fraction routed * mean prob, summed over experts
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac * mean_prob) * n_experts
+    return MoEOutput(out, aux)
+
+
+def init_moe_params(key, e_model: int, f_hidden: int, n_experts: int, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = (2.0 / e_model) ** 0.5
+    scale_out = (2.0 / f_hidden) ** 0.5
+    return {
+        "router": jax.random.normal(k1, (e_model, n_experts), dtype) * 0.02,
+        "w_in": jax.random.normal(k2, (n_experts, e_model, f_hidden), dtype) * scale_in,
+        "w_out": jax.random.normal(k3, (n_experts, f_hidden, e_model), dtype) * scale_out,
+    }

@@ -1,0 +1,130 @@
+"""Ring attention: exact attention over sequence shards with O(T/sp) memory
+per device and compute/communication overlap.
+
+The reference provides no sequence parallelism (SURVEY.md §5: "SP/CP not
+implemented in-tree"); this module is part of closing that gap TPU-natively.
+Each device holds a sequence shard of Q, K, V.  K/V blocks rotate around the
+'sp' mesh axis via `lax.ppermute` while every device accumulates its Q-shard's
+attention with streaming (flash-style) softmax: running max `m`, normalizer
+`l`, and un-normalized output `o` are updated per block, so the full [T, T]
+score matrix never materializes.  The loop is a `lax.scan` of pure jax ops —
+differentiable by construction, and on TPU each block's inner attention can
+dispatch to the Pallas flash kernel (ops.attention).
+
+Usage inside shard_map (manual over 'sp'; see tests/test_parallel.py):
+    out = ring_attention(q, k, v, axis_name="sp", causal=True)
+with q, k, v shaped [batch, seq_shard, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, scale, mask, m_prev, l_prev, o_prev):
+    """One streaming-softmax accumulation step.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; mask: [Tq, Tk] bool (True=keep)
+    m, l: [B, H, Tq]; o: [B, Tq, H, D]
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)  # [B, H, Tq]
+    m_new = jnp.maximum(m_prev, m_blk)
+    # guard fully-masked rows: keep exp() finite
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)  # [B, H, Tq]
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o_prev * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a ring of sequence shards (call inside shard_map).
+
+    Shapes (per device): q, k, v: [B, T_local, H, D] -> out [B, T_local, H, D].
+    For GQA repeat K/V heads to H before calling.
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    m0 = jnp.full((b, h, t_local), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), dtype=jnp.float32)
+    o0 = jnp.zeros((b, t_local, h, d), dtype=jnp.float32)
+
+    q32 = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    local_pos = jnp.arange(t_local)
+
+    def step(carry, step_idx):
+        k_blk, v_blk, m, l, o = carry
+        # the block arriving at step s originated at device (my_idx - s) mod n
+        src = (my_idx - step_idx) % n
+        if causal:
+            q_pos = my_idx * t_local + local_pos  # global query positions
+            k_pos = src * t_local + local_pos
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        m, l, o = _block_attention(
+            q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+            scale, mask, m, l, o,
+        )
+        # rotate k/v to the next device; skip the final (wasted) rotation
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, o), None
+
+    (_, _, m, l, o), _ = lax.scan(step, (k, v, m0, l0, o0), jnp.arange(n))
+    # final normalization; fully-masked rows (l==0) return 0
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True):
+    """Convenience wrapper: shard_map over the sp axis of `mesh` with
+    [batch, seq, heads, dim] inputs sharded on seq."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, causal=True, scale=None):
+    """Dense reference for testing: [B, T, H, D] -> [B, T, H, D]."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

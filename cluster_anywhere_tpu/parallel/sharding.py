@@ -1,0 +1,70 @@
+"""Sharding rules: logical array axes -> mesh axes.
+
+Parameters and activations are annotated with *logical* axis names
+("batch", "seq", "embed", "heads", "mlp", "vocab", "layers", "experts"); a
+rule table maps each to a mesh axis (or None = replicated).  This is the
+GSPMD workflow: annotate, let XLA insert collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+# default logical->mesh rules for transformer training
+DEFAULT_RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
+    "batch": ("dp", "fsdp"),  # batch sharded over both data axes
+    "seq": "sp",
+    "embed": None,
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": None,  # pp handled by stage stacking, not GSPMD
+    "experts": "ep",
+    "expert_mlp": "tp",
+    # fsdp param sharding: applied to the largest axis of each weight
+    "fsdp_shard": "fsdp",
+}
+
+
+def logical_to_spec(
+    logical: Sequence[Optional[str]], rules: Optional[Dict] = None
+) -> PartitionSpec:
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    out = []
+    for name in logical:
+        if name is None:
+            out.append(None)
+        else:
+            out.append(rules.get(name))
+    # trim trailing Nones for canonical specs
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def named_sharding(mesh, *logical, rules=None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical, rules))
+
+
+def shard_pytree(tree: Any, specs: Any, mesh):
+    """device_put a pytree according to a matching pytree of PartitionSpecs."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def constraint(x, mesh, *logical, rules=None):
+    """with_sharding_constraint using logical names (inside jit)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, logical_to_spec(logical, rules))
+    )
