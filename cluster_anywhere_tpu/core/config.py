@@ -39,7 +39,7 @@ class CAConfig:
     # --- scheduler / leases ---
     max_leases_per_shape: int = 64  # cap on concurrently held leases per resource shape
     lease_idle_timeout_s: float = 1.0  # return leases idle longer than this
-    max_inflight_per_lease: int = 4  # pipelined task pushes per leased worker
+    max_inflight_per_lease: int = 16  # pipelined task pushes per leased worker
     worker_prestart: bool = True
     scheduler_spread_threshold: float = 0.5  # hybrid policy: pack below, spread above
 

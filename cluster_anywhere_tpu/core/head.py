@@ -234,12 +234,23 @@ class Head:
         return "tpu" if shape.get("TPU") else "cpu"
 
     def _ensure_pool(self):
-        """Prestart/grow each pool when demand outstrips idle workers."""
+        """Prestart/grow each pool when demand outstrips idle workers.
+
+        Demand is capped by what the node's free resources could actually
+        grant — queued lease requests beyond resource capacity must not spawn
+        processes (they'd sit idle and thrash the CPU starting up)."""
         n_alive = sum(1 for w in self.workers.values() if w.state != "dead")
         for pool in ("cpu", "tpu"):
-            want = sum(
-                1 for r in self.pending_leases if self._pool_key(r.shape) == pool
-            ) - len(self.idle_workers[pool])
+            demand = 0
+            sim_avail = dict(self.avail)
+            for r in self.pending_leases:
+                if self._pool_key(r.shape) == pool and (
+                    r.pg_id or self._fits(sim_avail, r.shape)
+                ):
+                    demand += 1
+                    if not r.pg_id:
+                        self._take(sim_avail, r.shape)
+            want = demand - len(self.idle_workers[pool])
             want -= sum(
                 1
                 for w in self.workers.values()

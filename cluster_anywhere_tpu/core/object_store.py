@@ -81,28 +81,37 @@ class MemoryStore:
     def wait_ready(self, oids: List[ObjectID], num_returns: int, timeout: Optional[float]) -> Tuple[List[ObjectID], List[ObjectID]]:
         """Block until num_returns of oids are ready (or timeout). Returns
         (ready, not_ready) preserving input order — `wait()` semantics of the
-        reference (python/ray/_private/worker.py:2868)."""
+        reference (python/ray/_private/worker.py:2868).
+
+        Re-checks only the still-pending subset on each wakeup so waiting on N
+        objects is O(N) total, not O(N^2)."""
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
+            pending = [
+                o for o in oids if (e := self._entries.get(o)) is None or e.state == "pending"
+            ]
             while True:
-                ready = [o for o in oids if (e := self._entries.get(o)) and e.state != "pending"]
-                if len(ready) >= num_returns:
-                    ready_set = set(ready[:num_returns])
-                    # preserve order, cap at num_returns
-                    ready_list, rest = [], []
-                    for o in oids:
-                        if o in ready_set and len(ready_list) < num_returns:
-                            ready_list.append(o)
-                        else:
-                            rest.append(o)
-                    return ready_list, rest
+                if len(oids) - len(pending) >= num_returns:
+                    break
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    ready_set = set(ready)
-                    return [o for o in oids if o in ready_set], [o for o in oids if o not in ready_set]
+                    break
                 self._cv.wait(remaining if remaining is None or remaining < 0.25 else 0.25)
+                pending = [
+                    o
+                    for o in pending
+                    if (e := self._entries.get(o)) is None or e.state == "pending"
+                ]
+            pending_set = set(pending)
+            ready_list, rest = [], []
+            for o in oids:
+                if o not in pending_set and len(ready_list) < num_returns:
+                    ready_list.append(o)
+                else:
+                    rest.append(o)
+            return ready_list, rest
 
     def delete(self, oid: ObjectID):
         with self._cv:

@@ -30,6 +30,18 @@ from .config import get_config
 _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 31
 
+# The event loop holds only weak references to tasks; anything fire-and-forget
+# must be pinned here or it can be garbage-collected mid-execution (observed:
+# silently vanishing task submissions under load).
+_background_tasks: set = set()
+
+
+def spawn_bg(coro) -> asyncio.Task:
+    task = asyncio.ensure_future(coro)
+    _background_tasks.add(task)
+    task.add_done_callback(_background_tasks.discard)
+    return task
+
 
 class RpcChaos:
     """Counts down per-method failure budgets from config.testing_rpc_failure."""
@@ -197,7 +209,7 @@ class Server:
                 # in frame-arrival order (FIFO loop scheduling), which
                 # preserves per-caller actor-call ordering up to the executor
                 # queue.
-                asyncio.ensure_future(self._dispatch(state, msg, writer))
+                spawn_bg(self._dispatch(state, msg, writer))
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:

@@ -30,17 +30,42 @@ def _align(n: int) -> int:
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
-    """Returns (pickle_bytes, out_of_band_buffers)."""
+    """Returns (pickle_bytes, out_of_band_buffers).
+
+    Plain pickle first (5-10x faster); cloudpickle only for values plain
+    pickle can't handle (lambdas, local classes) — mirroring the reference's
+    split between inline serialization and cloudpickled definitions."""
     buffers: List[pickle.PickleBuffer] = []
-    data = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    try:
+        data = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+        # plain pickle serializes __main__-defined classes/functions BY
+        # REFERENCE, which a worker process (different __main__) cannot
+        # resolve; cloudpickle serializes them by value.  The module name is
+        # embedded in the stream, so scan for it (false positives only cost
+        # the slower path).
+        if b"__main__" in data:
+            raise pickle.PicklingError("references __main__")
+    except (pickle.PicklingError, AttributeError, TypeError):
+        buffers.clear()
+        data = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
     return data, buffers
 
 def deserialize(data: bytes, buffers: List[Any]) -> Any:
     return pickle.loads(data, buffers=buffers)
 
 
+_PACKED_NONE: bytes | None = None
+
+
 def pack(value: Any) -> bytes:
     """Serialize into a single contiguous blob (inline path)."""
+    global _PACKED_NONE
+    if value is None:
+        if _PACKED_NONE is None:
+            data, _ = serialize(None)
+            header = msgpack.packb({"p": data, "l": []}, use_bin_type=True)
+            _PACKED_NONE = len(header).to_bytes(4, "big") + header
+        return _PACKED_NONE
     data, buffers = serialize(value)
     raws = [b.raw() for b in buffers]
     header = msgpack.packb(

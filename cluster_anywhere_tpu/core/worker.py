@@ -35,7 +35,7 @@ from .function_manager import FunctionManager
 from .ids import ActorID, JobID, ObjectID, TaskID, _Counter
 from .object_ref import DeviceRef, ObjectRef
 from .object_store import MemoryStore, ShmObjectStore, _Entry
-from .protocol import Connection, connect_unix
+from .protocol import Connection, connect_unix, spawn_bg
 from .reference_counter import ReferenceCounter
 
 _global_worker: Optional["Worker"] = None
@@ -145,7 +145,7 @@ class LeasePool:
                 < self.max_leases
             ):
                 self.requests_outstanding += 1
-                asyncio.ensure_future(self._request_lease())
+                spawn_bg(self._request_lease())
             fut = asyncio.get_running_loop().create_future()
             self.waiters.append(fut)
             await fut  # raises if the lease request failed terminally
@@ -234,6 +234,7 @@ class Worker:
         self._task_counter = _Counter()
         self.head: Optional[Connection] = None
         self._conns: Dict[str, Connection] = {}
+        self._connecting: Dict[str, asyncio.Future] = {}
         self._lease_pools: Dict[tuple, LeasePool] = {}
         self._actor_addr_cache: Dict[str, Tuple[str, int]] = {}  # aid -> (addr, incarnation)
         self.node_id: Optional[str] = None
@@ -242,6 +243,11 @@ class Worker:
         self.device_objects: Dict[bytes, Any] = {}
         self.current_task_id: Optional[TaskID] = None
         self.current_actor_id: Optional[ActorID] = None
+        # submission pump: user threads enqueue coroutine factories here; one
+        # threadsafe wakeup drains many submissions (hot-path amortization)
+        self._submit_queue: deque = deque()
+        self._submit_wakeup_pending = False
+        self._submit_lock = threading.Lock()
         self._stopped = False
         self._external_loop = loop is not None
         if loop is None:
@@ -266,9 +272,41 @@ class Worker:
 
     def spawn_coro(self, coro):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        fut.add_done_callback(self._report_task_exc)
+        return fut
 
-        def _report(f):
-            exc = f.exception()
+    def _pump_submit(self, coro_factory):
+        """Enqueue a submission coroutine with one amortized loop wakeup."""
+        with self._submit_lock:
+            self._submit_queue.append(coro_factory)
+            if self._submit_wakeup_pending:
+                return
+            self._submit_wakeup_pending = True
+        try:
+            self.loop.call_soon_threadsafe(self._drain_submit_queue)
+        except RuntimeError:
+            # loop closed (shutdown): drop the queued submission and surface
+            # the error instead of hanging a future get()
+            with self._submit_lock:
+                self._submit_queue.clear()
+                self._submit_wakeup_pending = False
+            raise RuntimeError("cannot submit work: runtime is shut down")
+
+    def _drain_submit_queue(self):
+        with self._submit_lock:
+            items = list(self._submit_queue)
+            self._submit_queue.clear()
+            self._submit_wakeup_pending = False
+        for factory in items:
+            task = spawn_bg(factory())
+            task.add_done_callback(self._report_task_exc)
+
+    @staticmethod
+    def _report_task_exc(task):
+        """Done-callback for fire-and-forget submissions (asyncio tasks and
+        concurrent futures alike)."""
+        if not task.cancelled():
+            exc = task.exception()
             if exc is not None:
                 import traceback
 
@@ -277,9 +315,6 @@ class Worker:
                     + "".join(traceback.format_exception(exc)),
                     flush=True,
                 )
-
-        fut.add_done_callback(_report)
-        return fut
 
     # ------------------------------------------------------------- bootstrap
     def connect(self):
@@ -295,7 +330,7 @@ class Worker:
             )
             self.node_id = reply["node_id"]
             self.total_resources = reply["resources"]
-            asyncio.ensure_future(self._housekeeping())
+            spawn_bg(self._housekeeping())
 
         self.run_coro(_connect(), timeout=30)
 
@@ -311,7 +346,7 @@ class Worker:
         )
         self.node_id = reply["node_id"]
         self.total_resources = reply["resources"]
-        asyncio.ensure_future(self._housekeeping())
+        spawn_bg(self._housekeeping())
 
     async def _on_push(self, msg):
         if msg.get("m") == "pub" and msg.get("ch") == "actors":
@@ -349,11 +384,27 @@ class Worker:
             pass
 
     async def conn_to(self, addr: str) -> Connection:
+        """One connection per peer.  Concurrent first-callers share a single
+        connect (a stampede would create several sockets and destroy
+        per-caller actor-call ordering across them)."""
         conn = self._conns.get(addr)
-        if conn is None or conn.closed:
+        if conn is not None and not conn.closed:
+            return conn
+        pending = self._connecting.get(addr)
+        if pending is not None:
+            return await pending
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._connecting[addr] = fut
+        try:
             conn = await connect_unix(addr)
             self._conns[addr] = conn
-        return conn
+            fut.set_result(conn)
+            return conn
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            del self._connecting[addr]
 
     # ------------------------------------------------------------------ put
     def put(self, value: Any) -> ObjectRef:
@@ -547,6 +598,8 @@ class Worker:
         return {"v": serialization.pack(value)}
 
     async def _build_args(self, args: Sequence[Any], kwargs: Dict[str, Any]):
+        if not args and not kwargs:
+            return [], {}
         specs = [await self._build_arg(a) for a in args]
         kwspecs = {k: await self._build_arg(v) for k, v in kwargs.items()}
         return specs, kwspecs
@@ -561,7 +614,9 @@ class Worker:
             self.reference_counter.add_owned(oid)
         refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
         fn_id, blob = self.fn_manager.export(fn)
-        self.spawn_coro(self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids))
+        self._pump_submit(
+            lambda: self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+        )
         return refs
 
     def _shape_of(self, opts) -> Dict[str, float]:
@@ -713,8 +768,8 @@ class Worker:
             self.memory_store.mark_pending(oid)
             self.reference_counter.add_owned(oid)
         refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
-        self.spawn_coro(
-            self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
+        self._pump_submit(
+            lambda: self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
         )
         return refs
 

@@ -25,7 +25,7 @@ from .config import CAConfig, set_config
 from .errors import TaskCancelledError, TaskError
 from .ids import ActorID, ObjectID, TaskID
 from .object_ref import ObjectRef
-from .protocol import Server
+from .protocol import Server, spawn_bg
 from .worker import Worker, _device_spec, _is_device_value, set_global_worker
 
 
@@ -126,19 +126,23 @@ class WorkerProcess:
         return [{"e": blob} for _ in range(num_returns)]
 
     # --------------------------------------------------------------- execute
-    def _exec_sync(self, fn, msg, task_id: bytes, actor_id: Optional[str]):
-        """Arg resolution + user code, both inside the executor job so that
+    def _exec_sync(self, fn, msg, task_id: bytes, actor_id: Optional[str]) -> List[dict]:
+        """Arg resolution + user code + result packaging in ONE executor job:
         per-caller actor-call ordering is preserved end-to-end (frames are
-        submitted to the executor in arrival order)."""
+        submitted to the executor in arrival order) and the hot path pays a
+        single thread hop."""
         args, kwargs = self._resolve_args(msg["args"], msg.get("kwargs"))
         w = self.worker
         w.current_task_id = TaskID(task_id)
         if actor_id:
             w.current_actor_id = ActorID.from_hex(actor_id)
         try:
-            return fn(*args, **kwargs)
+            value = fn(*args, **kwargs)
         finally:
             w.current_task_id = None
+        return self._package_results(
+            task_id, msg.get("num_returns", 1), value, msg.get("owner", "")
+        )
 
     async def _execute(self, msg, is_actor_call: bool) -> List[dict]:
         num_returns = msg.get("num_returns", 1)
@@ -153,25 +157,23 @@ class WorkerProcess:
                         None, self._resolve_args, msg["args"], msg.get("kwargs")
                     )
                     value = await method(*args, **kwargs)
-                else:
-                    value = await self.loop.run_in_executor(
-                        self.executor, self._exec_sync, method, msg, task_id, msg["actor_id"]
+                    return await self.loop.run_in_executor(
+                        None,
+                        self._package_results,
+                        task_id,
+                        num_returns,
+                        value,
+                        msg.get("owner", ""),
                     )
-            else:
-                fn = self.worker.fn_manager.get(msg["fn_id"])
-                if fn is None:
-                    reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
-                    fn = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
-                value = await self.loop.run_in_executor(
-                    self.executor, self._exec_sync, fn, msg, task_id, None
+                return await self.loop.run_in_executor(
+                    self.executor, self._exec_sync, method, msg, task_id, msg["actor_id"]
                 )
+            fn = self.worker.fn_manager.get(msg["fn_id"])
+            if fn is None:
+                reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
+                fn = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
             return await self.loop.run_in_executor(
-                None,
-                self._package_results,
-                task_id,
-                num_returns,
-                value,
-                msg.get("owner", ""),
+                self.executor, self._exec_sync, fn, msg, task_id, None
             )
         except SystemExit:
             self._exiting = True
@@ -278,7 +280,7 @@ class WorkerProcess:
         set_global_worker(self.worker)
         await self.server.start()
         await self.worker.connect_async()
-        asyncio.ensure_future(self._heartbeat_loop())
+        spawn_bg(self._heartbeat_loop())
         # park forever; the head kills us at job teardown
         await asyncio.Event().wait()
 

@@ -1,0 +1,21 @@
+from .transformer import (
+    TransformerConfig,
+    cross_entropy_loss,
+    forward,
+    init_params,
+    make_loss_fn,
+    make_train_step,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "param_specs",
+    "shard_params",
+    "cross_entropy_loss",
+    "make_loss_fn",
+    "make_train_step",
+]

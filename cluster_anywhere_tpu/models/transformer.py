@@ -1,0 +1,329 @@
+"""Flagship decoder-only transformer (LLaMA-style), TPU-first.
+
+Design points (vs. the reference, which delegates all modeling to torch):
+- pure-pytree params + functional forward: jit/grad/vmap compose freely
+- layers stacked on a leading axis and iterated with `lax.scan` — one block
+  gets compiled once regardless of depth (compile-time O(1) in layers)
+- every parallelism axis is native: DP/FSDP/TP via GSPMD param/activation
+  shardings (parallel.sharding), PP via the shard_map pipeline schedule
+  (parallel.pipeline), SP via ring attention or Ulysses (parallel.ring_attention,
+  parallel.ulysses) under a partial-manual shard_map over {'pp','sp'}
+- bfloat16 activations, fp32 params/optimizer, RoPE, GQA, SwiGLU, RMSNorm
+
+The model is the `entry()` / `dryrun_multichip()` flagship in
+__graft_entry__.py and the subject of bench.py's training benchmark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.pipeline import pipeline_apply
+from ..parallel.ring_attention import reference_attention, ring_attention
+from ..parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_head: int = 64
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "auto"  # dense | ring | ulysses | auto
+    pp: int = 1
+    sp: int = 1
+    num_microbatches: int = 1
+    remat: bool = False
+
+    @property
+    def layers_per_stage(self) -> int:
+        if self.n_layers % self.pp != 0:
+            raise ValueError(f"n_layers {self.n_layers} not divisible by pp {self.pp}")
+        return self.n_layers // self.pp
+
+    def resolved_attn(self) -> str:
+        if self.attn_impl != "auto":
+            return self.attn_impl
+        return "ring" if self.sp > 1 else "dense"
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def _init_block(key, cfg: TransformerConfig):
+    e, h, kv, d, f = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.d_head, cfg.d_ff
+    ks = jax.random.split(key, 7)
+    s = lambda fan_in: fan_in ** -0.5
+    pd = cfg.param_dtype
+    return {
+        "ln1": jnp.ones((e,), pd),
+        "wq": jax.random.normal(ks[0], (e, h * d), pd) * s(e),
+        "wk": jax.random.normal(ks[1], (e, kv * d), pd) * s(e),
+        "wv": jax.random.normal(ks[2], (e, kv * d), pd) * s(e),
+        "wo": jax.random.normal(ks[3], (h * d, e), pd) * s(h * d),
+        "ln2": jnp.ones((e,), pd),
+        "w_gate": jax.random.normal(ks[4], (e, f), pd) * s(e),
+        "w_up": jax.random.normal(ks[5], (e, f), pd) * s(e),
+        "w_down": jax.random.normal(ks[6], (f, e), pd) * s(f),
+    }
+
+
+def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
+    k_embed, k_blocks, k_head = jax.random.split(key, 3)
+    block_keys = jax.random.split(k_blocks, cfg.n_layers)
+    blocks = jax.vmap(lambda k: _init_block(k, cfg))(block_keys)
+    if cfg.pp > 1:
+        # restack [L, ...] -> [pp, L/pp, ...] for stage sharding
+        blocks = jax.tree_util.tree_map(
+            lambda x: x.reshape(cfg.pp, cfg.layers_per_stage, *x.shape[1:]), blocks
+        )
+    return {
+        "embed": jax.random.normal(k_embed, (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        * 0.02,
+        "blocks": blocks,
+        "ln_f": jnp.ones((cfg.d_model,), cfg.param_dtype),
+        "lm_head": jax.random.normal(k_head, (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+        * cfg.d_model ** -0.5,
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs: tp shards head/ff/vocab dims, fsdp shards the other
+    matmul dim, pp shards the stage axis of stacked blocks."""
+    lead = ("pp", None) if cfg.pp > 1 else (None,)
+
+    def blk(*spec):
+        return P(*lead, *spec)
+
+    return {
+        "embed": P("fsdp", "tp"),
+        "blocks": {
+            "ln1": blk(None),
+            "wq": blk("fsdp", "tp"),
+            "wk": blk("fsdp", "tp"),
+            "wv": blk("fsdp", "tp"),
+            "wo": blk("tp", "fsdp"),
+            "ln2": blk(None),
+            "w_gate": blk("fsdp", "tp"),
+            "w_up": blk("fsdp", "tp"),
+            "w_down": blk("tp", "fsdp"),
+        },
+        "ln_f": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def shard_params(params, cfg: TransformerConfig, mesh):
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x, w, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps).astype(x.dtype)) * w.astype(x.dtype)
+
+
+def _rope(q, k, positions, cfg: TransformerConfig):
+    """Rotary embeddings; q,k: [B, T, H, D], positions: [T] global positions."""
+    d = cfg.d_head
+    freqs = cfg.rope_theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x2 * cos + x1 * sin
+        return jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+
+    return rot(q.astype(jnp.float32)).astype(q.dtype), rot(k.astype(jnp.float32)).astype(
+        k.dtype
+    )
+
+
+def _attention(q, k, v, cfg: TransformerConfig, sp_manual: bool):
+    impl = cfg.resolved_attn()
+    if impl == "ring" and sp_manual:
+        return ring_attention(q, k, v, axis_name="sp", causal=True)
+    if impl == "ulysses" and sp_manual:
+        return ulysses_attention(q, k, v, axis_name="sp", causal=True)
+    return reference_attention(q, k, v, causal=True)
+
+
+def _block_forward(bp, x, cfg: TransformerConfig, sp_manual: bool):
+    """One transformer block. x: [B, T_local, E]."""
+    b, t, e = x.shape
+    h, kv, d = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    dt = x.dtype
+
+    y = _rms_norm(x, bp["ln1"])
+    q = (y @ bp["wq"].astype(dt)).reshape(b, t, h, d)
+    k = (y @ bp["wk"].astype(dt)).reshape(b, t, kv, d)
+    v = (y @ bp["wv"].astype(dt)).reshape(b, t, kv, d)
+
+    if sp_manual and cfg.sp > 1:
+        offset = lax.axis_index("sp") * t
+    else:
+        offset = 0
+    positions = offset + jnp.arange(t)
+    q, k = _rope(q, k, positions, cfg)
+
+    if kv != h:  # GQA: repeat kv heads
+        rep = h // kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    attn = _attention(q, k, v, cfg, sp_manual).reshape(b, t, h * d)
+    x = x + attn @ bp["wo"].astype(dt)
+
+    y = _rms_norm(x, bp["ln2"])
+    gated = jax.nn.silu(y @ bp["w_gate"].astype(dt)) * (y @ bp["w_up"].astype(dt))
+    x = x + gated @ bp["w_down"].astype(dt)
+    return x
+
+
+def _stage_forward(stage_blocks, x, cfg: TransformerConfig, sp_manual: bool):
+    """Scan over this stage's layers. stage_blocks leaves: [L_stage, ...]."""
+    block = functools.partial(_block_forward, cfg=cfg, sp_manual=sp_manual)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def body(x, bp):
+        return block(bp, x), None
+
+    x, _ = lax.scan(body, x, stage_blocks)
+    return x
+
+
+def forward(params, ids, cfg: TransformerConfig, mesh=None) -> jax.Array:
+    """ids: [B, T] int32 -> logits [B, T, V]."""
+    x = params["embed"].astype(cfg.dtype)[ids]  # [B, T, E]
+    manual_axes = set()
+    if cfg.pp > 1:
+        manual_axes.add("pp")
+    if cfg.sp > 1 and cfg.resolved_attn() in ("ring", "ulysses"):
+        manual_axes.add("sp")
+
+    if manual_axes:
+        if mesh is None:
+            raise ValueError("mesh required for pp/sp execution")
+        x = _apply_blocks_manual(params["blocks"], x, cfg, mesh, frozenset(manual_axes))
+    else:
+        x = _stage_forward(params["blocks"], x, cfg, sp_manual=False)
+
+    x = _rms_norm(x, params["ln_f"])
+    return x @ params["lm_head"].astype(cfg.dtype)
+
+
+def _apply_blocks_manual(blocks, x, cfg: TransformerConfig, mesh, manual_axes):
+    """Run the block stack under shard_map, manual over {'pp','sp'} (subset),
+    GSPMD-auto over dp/fsdp/tp."""
+    sp_manual = "sp" in manual_axes
+    pp_manual = "pp" in manual_axes
+
+    def inner(blocks_local, x_local):
+        if pp_manual:
+            my_blocks = jax.tree_util.tree_map(lambda p: p[0], blocks_local)
+            stage = functools.partial(
+                _stage_forward, cfg=cfg, sp_manual=sp_manual
+            )
+            return pipeline_apply(
+                lambda bp, a: stage(bp, a),
+                my_blocks,
+                x_local,
+                axis_name="pp",
+                num_microbatches=cfg.num_microbatches,
+            )
+        return _stage_forward(blocks_local, x_local, cfg=cfg, sp_manual=sp_manual)
+
+    block_specs = jax.tree_util.tree_map(
+        lambda _: P("pp") if pp_manual else P(), blocks
+    )
+    x_spec = P(None, "sp", None) if sp_manual else P()
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(block_specs, x_spec),
+        out_specs=x_spec,
+        axis_names=frozenset(manual_axes),
+        check_vma=False,
+    )(blocks, x)
+
+
+# ---------------------------------------------------------------------------
+# loss / train step
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy_loss(logits, targets, mask=None):
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def make_loss_fn(cfg: TransformerConfig, mesh=None):
+    def loss_fn(params, batch):
+        ids = batch["ids"]  # [B, T+1]
+        logits = forward(params, ids[:, :-1], cfg, mesh)
+        return cross_entropy_loss(logits, ids[:, 1:])
+
+    return loss_fn
+
+
+def make_train_step(cfg: TransformerConfig, mesh, optimizer=None, learning_rate=3e-4):
+    """Returns (train_step, init_state). train_step is jittable:
+    (params, opt_state, batch) -> (params, opt_state, loss)."""
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.adamw(learning_rate, weight_decay=0.01)
+    loss_fn = make_loss_fn(cfg, mesh)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_state(key):
+        params = init_params(key, cfg)
+        params = shard_params(params, cfg, mesh)
+        opt_state = optimizer.init(params)  # inherits param shardings
+        return params, opt_state
+
+    return train_step, init_state
+
+
+def make_batch_sharding(cfg: TransformerConfig, mesh):
+    """Input batch sharding: batch over (dp, fsdp), sequence over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp" if cfg.sp > 1 else None))
