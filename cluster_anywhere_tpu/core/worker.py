@@ -270,11 +270,6 @@ class Worker:
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
-    def spawn_coro(self, coro):
-        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
-        fut.add_done_callback(self._report_task_exc)
-        return fut
-
     def _pump_submit(self, coro_factory):
         """Enqueue a submission coroutine with one amortized loop wakeup."""
         with self._submit_lock:

@@ -148,6 +148,37 @@ def init_collective_group(
     return g
 
 
+def create_collective_group(
+    actors,
+    world_size: int,
+    ranks: List[int],
+    backend: str = "host",
+    group_name: str = "default",
+):
+    """Declarative setup (reference: util/collective/collective.py:151
+    create_collective_group): tells each actor to init_collective_group with
+    its rank.  Actors must define
+    `collective_init(self, world_size, rank, backend, group_name)` that calls
+    `init_collective_group` (mixin: CollectiveActorMixin)."""
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must have equal length")
+    from ..core import api as ca
+
+    refs = [
+        a.collective_init.remote(world_size, r, backend, group_name)
+        for a, r in zip(actors, ranks)
+    ]
+    ca.get(refs)
+
+
+class CollectiveActorMixin:
+    """Inherit in an actor class to make it usable with create_collective_group."""
+
+    def collective_init(self, world_size, rank, backend="host", group_name="default"):
+        init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+        return rank
+
+
 def get_group(group_name: str = "default") -> HostCollectiveGroup:
     if group_name not in _groups:
         raise ValueError(f"collective group {group_name!r} not initialized")

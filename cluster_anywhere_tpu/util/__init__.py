@@ -1,0 +1,9 @@
+"""Utility APIs layered on the core (analogue of the reference's
+python/ray/util/: ActorPool at util/actor_pool.py, Queue at util/queue.py,
+inspect_serializability at util/check_serialize.py)."""
+
+from .actor_pool import ActorPool
+from .check_serialize import inspect_serializability
+from .queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full", "inspect_serializability"]

@@ -1,0 +1,95 @@
+"""Tests for the util tier: ActorPool, Queue, inspect_serializability
+(modeled on the reference's python/ray/tests/test_actor_pool.py and
+test_queue.py)."""
+
+import threading
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.util import ActorPool, Empty, Full, Queue, inspect_serializability
+
+
+@ca.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+
+def test_actor_pool_map_ordered(ca_cluster_module):
+    pool = ActorPool([_Doubler.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert out == [2 * i for i in range(10)]
+
+
+def test_actor_pool_map_unordered(ca_cluster_module):
+    pool = ActorPool([_Doubler.remote() for _ in range(3)])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(10)))
+    assert sorted(out) == [2 * i for i in range(10)]
+
+
+def test_actor_pool_submit_get_next(ca_cluster_module):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)
+    assert pool.get_next() == 2
+    assert pool.get_next() == 4
+    assert not pool.has_next()
+
+
+def test_actor_pool_push_pop(ca_cluster_module):
+    pool = ActorPool([_Doubler.remote()])
+    a = pool.pop_idle()
+    assert a is not None
+    assert pool.pop_idle() is None
+    pool.push(a)
+    assert pool.has_free()
+
+
+def test_queue_basic(ca_cluster_module):
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.full()
+    with pytest.raises(Full):
+        q.put("c", block=False)
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.05)
+    q.shutdown()
+
+
+def test_queue_producer_consumer(ca_cluster_module):
+    q = Queue()
+    got = []
+
+    def consume():
+        for _ in range(20):
+            got.append(q.get(timeout=10))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    q.put_nowait_batch(list(range(20)))
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert got == list(range(20))
+    q.shutdown()
+
+
+def test_inspect_serializability():
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def bad():
+        return lock
+
+    ok, failures = inspect_serializability(bad)
+    assert not ok
+    assert any("lock" == f.name for f in failures)
