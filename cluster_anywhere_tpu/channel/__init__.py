@@ -1,0 +1,28 @@
+"""Typed single-writer / multi-reader channels (analogue of the reference's
+ray.experimental.channel: shared_memory_channel.py:151 Channel,
+BufferedSharedMemoryChannel:534, CompositeChannel:648, IntraProcessChannel),
+backed by versioned shared-memory segments instead of mutable plasma objects
+(reference C++ experimental_mutable_object_manager.h:49).
+
+These are the zero-RPC transport under compiled DAGs: a writer publishes a new
+version in place; readers ack.  Device (jax.Array) payloads cross processes by
+host staging; the in-graph ICI path (parallel/) is the TPU fast plane.
+"""
+
+from .shm_channel import (
+    BufferedShmChannel,
+    ChannelClosedError,
+    ChannelInterface,
+    CompositeChannel,
+    IntraProcessChannel,
+    ShmChannel,
+)
+
+__all__ = [
+    "ChannelInterface",
+    "ShmChannel",
+    "BufferedShmChannel",
+    "IntraProcessChannel",
+    "CompositeChannel",
+    "ChannelClosedError",
+]

@@ -1,0 +1,314 @@
+"""Versioned shared-memory channels.
+
+Wire format of a slot (all u64 little-endian, 8-byte aligned):
+
+    [magic][version][payload_len][flags][num_readers][ack_0]...[ack_{R-1}] payload...
+
+Protocol (single writer, R registered readers):
+  - writer waits until every ack == version, serializes the value into the
+    payload area, then publishes by storing version+1;
+  - reader r waits until version > ack_r, deserializes, stores ack_r = version.
+An 8-byte aligned store through mmap is effectively atomic on the platforms we
+target (x86-64/ARM64), and the version store is the release point — payload is
+written before version advances, matching the reference's seal-then-notify
+semantics (plasma mutable objects, experimental_mutable_object_manager.h:49).
+
+Backing storage is a plain /dev/shm file mmap'd by writer and readers (same
+mechanism as core/object_store.py), placed inside the session's shm directory
+so stale-session sweeping reclaims it.
+
+Oversized payloads spill to the distributed object store and the channel
+carries only the ObjectRef (the reference resizes its backing store;
+spill-through keeps the segment bounded instead).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import queue as _queue
+import struct
+import time
+import uuid
+from typing import Any, List, Optional
+
+_MAGIC = 0x00CA_C4A9
+_U64 = struct.Struct("<Q")
+_FLAG_CLOSED = 1
+_SPILL_BIT = 1 << 63  # payload_len high bit: payload is a spilled ObjectRef
+
+_DEFAULT_BUFFER = 8 * 1024 * 1024
+_POLL_S = 20e-6
+
+
+class ChannelClosedError(Exception):
+    """Raised by read/write when the channel has been shut down."""
+
+
+class ChannelInterface:
+    def write(self, value: Any, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+    def close(self):
+        raise NotImplementedError
+
+
+def _now():
+    return time.monotonic()
+
+
+def _chan_dir() -> str:
+    """Channel files live under the session's /dev/shm dir so a crashed
+    session's sweep (core/api.py:_sweep_stale_sessions) reclaims them."""
+    from ..core.worker import try_global_worker
+
+    w = try_global_worker()
+    if w is not None and getattr(w, "session_dir", None):
+        d = os.path.join("/dev/shm", os.path.basename(w.session_dir))
+        os.makedirs(d, exist_ok=True)
+        return d
+    return "/dev/shm"
+
+
+class ShmChannel(ChannelInterface):
+    """Single-slot channel. Create once (driver side), open by spec elsewhere."""
+
+    def __init__(
+        self,
+        num_readers: int = 1,
+        buffer_size: int = _DEFAULT_BUFFER,
+        *,
+        path: Optional[str] = None,
+    ):
+        self.num_readers = num_readers
+        self.header_size = 8 * (5 + num_readers)
+        self.reader_index = 0
+        self._created = path is None
+        if path is None:
+            path = os.path.join(_chan_dir(), f"chan_{uuid.uuid4().hex[:16]}")
+            size = buffer_size + self.header_size
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self._init_header()
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(fd).st_size
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+        self.path = path
+        self.capacity = len(self._mm) - self.header_size
+
+    # -- u64 accessors ------------------------------------------------------
+
+    def _get(self, idx: int) -> int:
+        return _U64.unpack_from(self._mm, 8 * idx)[0]
+
+    def _set(self, idx: int, v: int):
+        _U64.pack_into(self._mm, 8 * idx, v)
+
+    def _init_header(self):
+        self._set(0, _MAGIC)
+        for i in range(1, 5 + self.num_readers):
+            self._set(i, 0)
+        self._set(4, self.num_readers)
+
+    @property
+    def version(self) -> int:
+        return self._get(1)
+
+    def spec(self) -> dict:
+        return {"kind": "shm", "path": self.path, "num_readers": self.num_readers}
+
+    @classmethod
+    def open(cls, spec: dict, reader_index: int = 0) -> "ShmChannel":
+        ch = cls(num_readers=spec["num_readers"], path=spec["path"])
+        ch.reader_index = reader_index
+        return ch
+
+    # -- core protocol ------------------------------------------------------
+
+    def _write_payload(self, payload: bytes, spilled: bool, deadline):
+        want = self.version
+        while any(self._get(5 + r) != want for r in range(self.num_readers)):
+            if self._get(3) & _FLAG_CLOSED:
+                raise ChannelClosedError
+            if deadline is not None and _now() > deadline:
+                raise TimeoutError("channel write timed out waiting for readers")
+            time.sleep(_POLL_S)
+        self._mm[self.header_size : self.header_size + len(payload)] = payload
+        self._set(2, len(payload) | (_SPILL_BIT if spilled else 0))
+        self._set(1, want + 1)  # publish
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        from ..core.serialization import pack
+
+        deadline = None if timeout is None else _now() + timeout
+        payload = pack(value)
+        spilled = False
+        ref = None
+        if len(payload) > self.capacity:
+            from ..core import api as ca
+
+            ref = ca.put(value)
+            payload, spilled = pack(ref), True
+        self._write_payload(payload, spilled, deadline)
+        if spilled:
+            # _write_payload waited for all acks of the previous version, so
+            # the prior spilled object (if any) has been consumed — safe to
+            # drop its ref and keep the new one alive until the next write
+            self._last_spill = ref
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        from ..core.serialization import unpack
+
+        deadline = None if timeout is None else _now() + timeout
+        my_ack = self._get(5 + self.reader_index)
+        while self.version == my_ack:
+            if self._get(3) & _FLAG_CLOSED:
+                raise ChannelClosedError
+            if deadline is not None and _now() > deadline:
+                raise TimeoutError("channel read timed out")
+            time.sleep(_POLL_S)
+        ln = self._get(2)
+        spilled = bool(ln & _SPILL_BIT)
+        ln &= ~_SPILL_BIT
+        value = unpack(bytes(self._mm[self.header_size : self.header_size + ln]))
+        self._set(5 + self.reader_index, self.version)
+        if spilled:
+            from ..core import api as ca
+
+            value = ca.get(value)
+        return value
+
+    def close(self):
+        self._set(3, _FLAG_CLOSED)
+
+    def release(self):
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+        if self._created:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def __reduce__(self):
+        raise TypeError("ShmChannel is not serializable; pass spec() and open()")
+
+
+class BufferedShmChannel(ChannelInterface):
+    """N-slot channel for pipelined execution (reference:
+    BufferedSharedMemoryChannel, shared_memory_channel.py:534).  Writer and
+    each reader advance through slots round-robin, so up to N writes can be
+    in flight before the writer blocks on reader acks."""
+
+    def __init__(
+        self,
+        num_readers: int = 1,
+        num_buffers: int = 2,
+        buffer_size: int = _DEFAULT_BUFFER,
+    ):
+        self._chans = [ShmChannel(num_readers, buffer_size) for _ in range(num_buffers)]
+        self._wseq = 0
+        self._rseq = 0
+
+    def spec(self) -> dict:
+        return {"kind": "buffered", "specs": [c.spec() for c in self._chans]}
+
+    @classmethod
+    def open(cls, spec: dict, reader_index: int = 0) -> "BufferedShmChannel":
+        ch = cls.__new__(cls)
+        ch._chans = [ShmChannel.open(s, reader_index) for s in spec["specs"]]
+        ch._wseq = 0
+        ch._rseq = 0
+        return ch
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        self._chans[self._wseq % len(self._chans)].write(value, timeout)
+        self._wseq += 1
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        v = self._chans[self._rseq % len(self._chans)].read(timeout)
+        self._rseq += 1
+        return v
+
+    def close(self):
+        for c in self._chans:
+            c.close()
+
+    def release(self):
+        for c in self._chans:
+            c.release()
+
+
+def open_channel(spec: dict, reader_index: int = 0) -> ChannelInterface:
+    if spec["kind"] == "shm":
+        return ShmChannel.open(spec, reader_index)
+    if spec["kind"] == "buffered":
+        return BufferedShmChannel.open(spec, reader_index)
+    raise ValueError(f"unknown channel kind {spec['kind']!r}")
+
+
+class IntraProcessChannel(ChannelInterface):
+    """Same-process channel (reference: intra_process_channel.py)."""
+
+    def __init__(self, maxsize: int = 1):
+        self._q: _queue.Queue = _queue.Queue(maxsize=maxsize)
+        self._closed = False
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        if self._closed:
+            raise ChannelClosedError
+        self._q.put(value, timeout=timeout)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        remaining = timeout
+        while True:
+            try:
+                return self._q.get(timeout=0.05 if remaining is None else min(remaining, 0.05))
+            except _queue.Empty:
+                if self._closed:
+                    raise ChannelClosedError from None
+                if remaining is not None:
+                    remaining -= 0.05
+                    if remaining <= 0:
+                        raise TimeoutError("channel read timed out") from None
+
+    def close(self):
+        self._closed = True
+
+
+class CompositeChannel(ChannelInterface):
+    """Picks the cheapest transport per reader (reference:
+    shared_memory_channel.py:648): intra-process queue for readers in the
+    writer's process, shm for readers in other processes on the node."""
+
+    def __init__(self, local_channel: Optional[IntraProcessChannel], remote: Optional[ShmChannel]):
+        self._local = local_channel
+        self._remote = remote
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        if self._local is not None:
+            self._local.write(value, timeout)
+        if self._remote is not None:
+            self._remote.write(value, timeout)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        src = self._local if self._local is not None else self._remote
+        return src.read(timeout)
+
+    def close(self):
+        for c in (self._local, self._remote):
+            if c is not None:
+                c.close()

@@ -41,6 +41,13 @@ class ActorMethod:
             self._method_name, args, kwargs, {"num_returns": self._num_returns}
         )
 
+    def bind(self, *args, **kwargs):
+        """Create a DAG node from this actor method (reference:
+        dag/class_node.py; enables compiled graphs)."""
+        from ..dag.node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, num_returns)
 

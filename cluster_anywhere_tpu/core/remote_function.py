@@ -76,6 +76,12 @@ class RemoteFunction:
         refs = w.submit_task(self._function, args, kwargs, _normalize_pg(opts))
         return refs[0] if opts.get("num_returns", 1) == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Create a task DAG node (reference: dag/function_node.py)."""
+        from ..dag.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._function.__name__!r} cannot be called directly; "

@@ -151,7 +151,17 @@ class WorkerProcess:
             if is_actor_call:
                 if self.actor is None or self.actor.actor_id != msg["actor_id"]:
                     raise TaskError(f"actor {msg.get('actor_id')} not hosted here")
-                method = getattr(self.actor.instance, msg["method"])
+                if msg["method"] == "__ca_exec__":
+                    # built-in escape hatch: first arg is a function applied to
+                    # the actor instance (used by compiled DAG loops; analogue
+                    # of the reference's __ray_call__)
+                    inst = self.actor.instance
+
+                    def method(fn, *a, **kw):
+                        return fn(inst, *a, **kw)
+
+                else:
+                    method = getattr(self.actor.instance, msg["method"])
                 if asyncio.iscoroutinefunction(method):
                     args, kwargs = await self.loop.run_in_executor(
                         None, self._resolve_args, msg["args"], msg.get("kwargs")

@@ -1,0 +1,375 @@
+"""CompiledDAG: turn a DAG of actor-method nodes into per-actor executable
+loops wired with shared-memory channels (analogue of the reference's
+dag/compiled_dag_node.py:767 CompiledDAG + :446 ExecutableTask; hot-path
+semantics per §3.6 of SURVEY.md — the driver leaves the per-call RPC loop).
+
+Compilation:
+  - every compute node must be a ClassMethodNode (actor-owned);
+  - edges between different processes become BufferedShmChannels
+    (num_buffers = max_inflight_executions, giving pipelined backpressure);
+  - same-actor edges pass values in memory within a tick;
+  - the driver writes one input channel per execute() and reads the output
+    channels; errors are forwarded through the graph as _DagError payloads.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..channel.shm_channel import (
+    BufferedShmChannel,
+    ChannelClosedError,
+    open_channel,
+)
+from .node import (
+    ClassMethodNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_DEFAULT_TIMEOUT = 300.0
+
+
+class _DagError:
+    """An execution error traveling through channels."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+        self.tb = traceback.format_exc()
+
+    def raise_(self):
+        raise self.exc
+
+
+def _extract_input(input_payload, key):
+    """input_payload is (args tuple, kwargs dict)."""
+    args, kwargs = input_payload
+    if key is None:
+        if not kwargs and len(args) == 1:
+            return args[0]
+        return (args, kwargs) if kwargs else tuple(args)
+    if isinstance(key, int):
+        return args[key]
+    if key in kwargs:
+        return kwargs[key]
+    raise KeyError(key)
+
+
+def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple[dict, int]],
+                    writer_specs: Dict[int, dict], timeout: float):
+    """Runs inside the actor (via the __ca_exec__ builtin): loop until the
+    input side closes, executing this actor's nodes each tick."""
+    readers = {nid: open_channel(spec, ridx) for nid, (spec, ridx) in reader_specs.items()}
+    writers = {nid: open_channel(spec) for nid, spec in writer_specs.items()}
+    ticks = 0
+    try:
+        while True:
+            tick_vals: Dict[int, Any] = {}
+
+            def chan_val(nid):
+                # block without deadline: teardown closes the channel to wake us.
+                # Reads are lazy and in topo order — an eager prefetch of all
+                # input channels could deadlock on cyclic actor placements
+                # (A.n1 -> B.n2 -> A.n3 would have A wait on n2 before writing n1)
+                if nid not in tick_vals:
+                    tick_vals[nid] = readers[nid].read(None)
+                return tick_vals[nid]
+
+            err: Optional[_DagError] = None
+            closed = False
+            for op in program:
+                def resolve(spec):
+                    kind, ref = spec
+                    if kind == "const":
+                        return ref
+                    if kind == "chan":
+                        v = chan_val(ref)
+                        return v
+                    if kind == "local":
+                        return tick_vals[ref]
+                    if kind == "input":
+                        return _extract_input(chan_val(ref[0]), ref[1])
+                    raise ValueError(kind)
+
+                if err is None:
+                    try:
+                        args = [resolve(s) for s in op["args"]]
+                        kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
+                        bad = next((a for a in args + list(kwargs.values())
+                                    if isinstance(a, _DagError)), None)
+                        if bad is not None:
+                            result = bad
+                        else:
+                            result = getattr(instance, op["method"])(*args, **kwargs)
+                    except ChannelClosedError:
+                        closed = True
+                        break
+                    except BaseException as e:  # noqa: BLE001 — forwarded to driver
+                        result = _DagError(e)
+                        err = result
+                else:
+                    result = err
+                tick_vals[op["node_id"]] = result
+                if op["node_id"] in writers:
+                    try:
+                        writers[op["node_id"]].write(result, timeout)
+                    except ChannelClosedError:
+                        closed = True
+                        break
+            if closed:
+                break
+            ticks += 1
+    finally:
+        for w in writers.values():
+            w.close()
+    return {"ticks": ticks}
+
+
+class CompiledDAGRef:
+    """Future for one execute() (reference: CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = None):
+        if self._consumed:
+            raise ValueError("CompiledDAGRef.get() may only be called once")
+        self._consumed = True
+        return self._dag._read_result(self._seq, timeout)
+
+    def __repr__(self):
+        return f"CompiledDAGRef(seq={self._seq})"
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, max_inflight_executions: int = 2,
+                 buffer_size: Optional[int] = None):
+        self._root = root
+        self._max_inflight = max(1, max_inflight_executions)
+        self._buffer_size = buffer_size or 8 * 1024 * 1024
+        self._timeout = _DEFAULT_TIMEOUT
+        self._torn_down = False
+        self._exec_seq = 0
+        self._read_seq = 0
+        self._result_cache: Dict[int, Any] = {}
+        self._compile()
+
+    # ------------------------------------------------------------------ build
+
+    def _compile(self):
+        nodes = self._root._walk()
+        self._input_node: Optional[InputNode] = None
+        compute: List[ClassMethodNode] = []
+        output_leaves: List[DAGNode] = []
+        root = self._root
+        if isinstance(root, MultiOutputNode):
+            output_leaves = list(root._upstream())
+        else:
+            output_leaves = [root]
+        for n in nodes:
+            if isinstance(n, InputNode):
+                if self._input_node is not None and n is not self._input_node:
+                    raise ValueError("compiled DAGs support a single InputNode")
+                self._input_node = n
+            elif isinstance(n, (InputAttributeNode, MultiOutputNode)):
+                pass
+            elif isinstance(n, ClassMethodNode):
+                compute.append(n)
+            else:
+                raise TypeError(
+                    f"compiled DAGs require actor-method nodes; got {n._label()} "
+                    "(tasks run via DAGNode.execute())"
+                )
+        for leaf in output_leaves:
+            if not isinstance(leaf, ClassMethodNode):
+                raise TypeError("compiled DAG outputs must be actor-method nodes")
+
+        # node -> owning actor key
+        def owner(n: ClassMethodNode):
+            return n._actor.actor_id.hex()
+
+        actors: Dict[str, List[ClassMethodNode]] = {}
+        handles: Dict[str, Any] = {}
+        for n in compute:
+            actors.setdefault(owner(n), []).append(n)
+            handles[owner(n)] = n._actor
+        if not actors:
+            raise ValueError("compiled DAG has no actor-method nodes")
+
+        # which (producer node, consumer actor) edges cross processes, and
+        # whether the driver consumes the producer
+        consumers: Dict[int, set] = {}  # producer node_id -> set of actor keys ("<driver>" for driver)
+        input_consumers: set = set()
+
+        def record_edge(dep: DAGNode, consumer_key: str):
+            if isinstance(dep, (InputNode, InputAttributeNode)):
+                input_consumers.add(consumer_key)
+            elif isinstance(dep, ClassMethodNode):
+                if owner(dep) != consumer_key:
+                    consumers.setdefault(dep._id, set()).add(consumer_key)
+            elif isinstance(dep, MultiOutputNode):
+                raise TypeError("MultiOutputNode must be the DAG root")
+
+        for n in compute:
+            for dep in n._upstream():
+                record_edge(dep, owner(n))
+        for leaf in output_leaves:
+            consumers.setdefault(leaf._id, set()).add("<driver>")
+
+        if self._input_node is None and input_consumers:
+            raise ValueError("InputAttributeNode without InputNode")
+
+        # allocate channels; assign reader indices deterministically
+        self._channels: Dict[int, BufferedShmChannel] = {}
+        reader_index: Dict[Tuple[int, str], int] = {}
+        INPUT_ID = -1
+        if self._input_node is not None:
+            if not input_consumers:
+                raise ValueError("InputNode is never consumed")
+            chan = BufferedShmChannel(
+                num_readers=len(input_consumers),
+                num_buffers=self._max_inflight,
+                buffer_size=self._buffer_size,
+            )
+            self._channels[INPUT_ID] = chan
+            for i, key in enumerate(sorted(input_consumers)):
+                reader_index[(INPUT_ID, key)] = i
+        for nid, cons in consumers.items():
+            chan = BufferedShmChannel(
+                num_readers=len(cons),
+                num_buffers=self._max_inflight,
+                buffer_size=self._buffer_size,
+            )
+            self._channels[nid] = chan
+            for i, key in enumerate(sorted(cons)):
+                reader_index[(nid, key)] = i
+
+        # per-actor programs in global topo order
+        self._loop_refs = []
+        self._handles = handles
+        for key, handle in handles.items():
+            program = []
+            reader_specs: Dict[int, Tuple[dict, int]] = {}
+            writer_specs: Dict[int, dict] = {}
+            for n in compute:
+                if owner(n) != key:
+                    continue
+
+                def arg_spec(dep):
+                    if isinstance(dep, InputNode):
+                        reader_specs[INPUT_ID] = (
+                            self._channels[INPUT_ID].spec(),
+                            reader_index[(INPUT_ID, key)],
+                        )
+                        return ("input", (INPUT_ID, None))
+                    if isinstance(dep, InputAttributeNode):
+                        reader_specs[INPUT_ID] = (
+                            self._channels[INPUT_ID].spec(),
+                            reader_index[(INPUT_ID, key)],
+                        )
+                        return ("input", (INPUT_ID, dep._key))
+                    if isinstance(dep, ClassMethodNode):
+                        if owner(dep) == key:
+                            return ("local", dep._id)
+                        reader_specs[dep._id] = (
+                            self._channels[dep._id].spec(),
+                            reader_index[(dep._id, key)],
+                        )
+                        return ("chan", dep._id)
+                    return ("const", dep)
+
+                program.append(
+                    {
+                        "node_id": n._id,
+                        "method": n._method_name,
+                        "args": [
+                            arg_spec(a) if isinstance(a, DAGNode) else ("const", a)
+                            for a in n._bound_args
+                        ],
+                        "kwargs": {
+                            k: arg_spec(v) if isinstance(v, DAGNode) else ("const", v)
+                            for k, v in n._bound_kwargs.items()
+                        },
+                    }
+                )
+                if n._id in self._channels:
+                    writer_specs[n._id] = self._channels[n._id].spec()
+            from ..core.actor import ActorMethod
+
+            ref = ActorMethod(handle, "__ca_exec__").remote(
+                _dag_actor_loop, program, reader_specs, writer_specs, self._timeout
+            )
+            self._loop_refs.append(ref)
+
+        # driver-side reader handles for outputs
+        self._driver_readers = {}
+        for leaf in output_leaves:
+            spec = self._channels[leaf._id].spec()
+            self._driver_readers[leaf._id] = open_channel(
+                spec, reader_index[(leaf._id, "<driver>")]
+            )
+        self._output_leaves = output_leaves
+        self._multi_output = isinstance(root, MultiOutputNode)
+        self._INPUT_ID = INPUT_ID
+
+    # ---------------------------------------------------------------- execute
+
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG has been torn down")
+        if self._input_node is not None:
+            self._channels[self._INPUT_ID].write((tuple(args), kwargs), self._timeout)
+        ref = CompiledDAGRef(self, self._exec_seq)
+        self._exec_seq += 1
+        return ref
+
+    def _read_result(self, seq: int, timeout: Optional[float]):
+        while self._read_seq <= seq:
+            outs = [
+                self._driver_readers[leaf._id].read(timeout or self._timeout)
+                for leaf in self._output_leaves
+            ]
+            self._result_cache[self._read_seq] = outs
+            self._read_seq += 1
+        outs = self._result_cache.pop(seq)
+        for o in outs:
+            if isinstance(o, _DagError):
+                o.raise_()
+        return outs if self._multi_output else outs[0]
+
+    # ---------------------------------------------------------------- teardown
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for chan in self._channels.values():
+            try:
+                chan.close()
+            except Exception:
+                pass
+        from ..core import api as ca
+
+        try:
+            ca.wait(self._loop_refs, num_returns=len(self._loop_refs), timeout=10)
+        except Exception:
+            pass
+        for chan in self._channels.values():
+            try:
+                chan.release()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+    def visualize(self) -> str:
+        return self._root.visualize()

@@ -162,6 +162,10 @@ def create_collective_group(
     `init_collective_group` (mixin: CollectiveActorMixin)."""
     if len(actors) != len(ranks):
         raise ValueError("actors and ranks must have equal length")
+    if len(set(ranks)) != len(ranks) or not all(0 <= r < world_size for r in ranks):
+        raise ValueError(
+            f"ranks must be unique and in [0, {world_size}); got {ranks}"
+        )
     from ..core import api as ca
 
     refs = [

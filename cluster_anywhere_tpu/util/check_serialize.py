@@ -5,7 +5,7 @@ closure/attributes to locate the members that fail to pickle."""
 from __future__ import annotations
 
 import inspect
-from typing import Any, Set, Tuple
+from typing import Any, Tuple
 
 from ..core.serialization import pack as dumps
 
@@ -20,15 +20,20 @@ class FailureTuple:
         return f"FailureTuple(obj={self.name!r}, parent={self.parent!r})"
 
 
-def _check(obj: Any, name: str, parent: Any, failures: list, seen: Set[int], depth: int):
-    if id(obj) in seen or depth > 3:
+def _check(obj: Any, name: str, parent: Any, failures: list, seen: dict, depth: int):
+    if id(obj) in seen:
+        # cached verdict: a shared unserializable leaf fails every parent that
+        # reaches it (its FailureTuple was recorded on the first walk)
+        return seen[id(obj)]
+    if depth > 3:
         return True
-    seen.add(id(obj))
+    seen[id(obj)] = True  # provisional; cycles count as ok
     try:
         dumps(obj)
         return True
     except Exception:
         pass
+    seen[id(obj)] = False
     found_inner = False
     # descend into closures and attributes to find the leaf cause
     if inspect.isfunction(obj) and obj.__closure__:
@@ -54,5 +59,5 @@ def inspect_serializability(obj: Any, name: str = None) -> Tuple[bool, list]:
     non-serializable members found."""
     name = name or getattr(obj, "__name__", repr(obj)[:40])
     failures: list = []
-    ok = _check(obj, name, None, failures, set(), 0)
+    ok = _check(obj, name, None, failures, {}, 0)
     return ok, failures

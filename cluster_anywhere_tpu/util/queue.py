@@ -88,12 +88,11 @@ class Queue:
 
     def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
         deadline = None if timeout is None else time.monotonic() + timeout
-        shipped = False
         while True:
             # only ship the payload when the queue has room — while full, poll
             # with the cheap full() call instead of re-serializing the item
-            if shipped or not ca.get(self.actor.full.remote()):
-                shipped = True
+            # (unbounded queues skip the probe: put_nowait cannot fail)
+            if self.maxsize <= 0 or not ca.get(self.actor.full.remote()):
                 if ca.get(self.actor.put_nowait.remote(item)):
                     return
             if not block:

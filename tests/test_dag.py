@@ -1,0 +1,199 @@
+"""Compiled graph + channel tests (modeled on the reference's
+python/ray/tests/test_channel.py and dag tests)."""
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.channel import IntraProcessChannel, ShmChannel
+from cluster_anywhere_tpu.channel.shm_channel import ChannelClosedError
+from cluster_anywhere_tpu.dag import InputNode, MultiOutputNode
+
+
+# --------------------------------------------------------------------------
+# channels
+# --------------------------------------------------------------------------
+
+
+def test_shm_channel_same_process(ca_cluster_module):
+    ch = ShmChannel(num_readers=1, buffer_size=1024)
+    reader = ShmChannel.open(ch.spec(), reader_index=0)
+    ch.write({"x": 1})
+    assert reader.read(timeout=5) == {"x": 1}
+    ch.write([1, 2, 3])
+    assert reader.read(timeout=5) == [1, 2, 3]
+    ch.close()
+    with pytest.raises(ChannelClosedError):
+        reader.read(timeout=5)
+    ch.release()
+
+
+def test_shm_channel_spill_large_payload(ca_cluster_module):
+    ch = ShmChannel(num_readers=1, buffer_size=1024)
+    reader = ShmChannel.open(ch.spec(), reader_index=0)
+    big = np.arange(100_000, dtype=np.int64)
+    ch.write(big)  # >1KB → spills through the object store
+    got = reader.read(timeout=30)
+    np.testing.assert_array_equal(got, big)
+    ch.release()
+
+
+def test_shm_channel_backpressure(ca_cluster_module):
+    ch = ShmChannel(num_readers=1, buffer_size=1024)
+    reader = ShmChannel.open(ch.spec(), reader_index=0)
+    ch.write(1)
+    with pytest.raises(TimeoutError):
+        ch.write(2, timeout=0.1)  # reader hasn't acked
+    assert reader.read(timeout=5) == 1
+    ch.write(2, timeout=5)
+    assert reader.read(timeout=5) == 2
+    ch.release()
+
+
+def test_intra_process_channel():
+    ch = IntraProcessChannel()
+    ch.write("v")
+    assert ch.read(timeout=1) == "v"
+    ch.close()
+    with pytest.raises(ChannelClosedError):
+        ch.read(timeout=1)
+
+
+# --------------------------------------------------------------------------
+# DAG API
+# --------------------------------------------------------------------------
+
+
+@ca.remote
+def _add(a, b):
+    return a + b
+
+
+@ca.remote
+class _Calc:
+    def __init__(self, bias=0):
+        self.bias = bias
+        self.calls = 0
+
+    def inc(self, x):
+        self.calls += 1
+        return x + 1 + self.bias
+
+    def mul(self, x, y):
+        return x * y
+
+    def boom(self, x):
+        raise ValueError("boom")
+
+    def num_calls(self):
+        return self.calls
+
+
+def test_dag_eager_task_graph(ca_cluster_module):
+    with InputNode() as inp:
+        a = _add.bind(inp, 10)
+        b = _add.bind(a, 5)
+    ref = b.execute(1)
+    assert ca.get(ref) == 16
+
+
+def test_dag_eager_actor_graph(ca_cluster_module):
+    actor = _Calc.remote()
+    with InputNode() as inp:
+        out = actor.inc.bind(inp)
+    assert ca.get(out.execute(41)) == 42
+
+
+def test_dag_visualize(ca_cluster_module):
+    actor = _Calc.remote()
+    with InputNode() as inp:
+        out = actor.inc.bind(inp)
+    viz = out.visualize()
+    assert "Input" in viz and "inc" in viz
+
+
+def test_compiled_dag_single_actor(ca_cluster_module):
+    actor = _Calc.remote()
+    with InputNode() as inp:
+        out = actor.inc.bind(inp)
+    dag = out.experimental_compile()
+    try:
+        for i in range(5):
+            assert dag.execute(i).get(timeout=30) == i + 1
+    finally:
+        dag.teardown()
+    # actor serves normal calls again after teardown
+    assert ca.get(actor.num_calls.remote()) == 5
+
+
+def test_compiled_dag_two_actor_chain(ca_cluster_module):
+    a = _Calc.remote()
+    b = _Calc.remote(bias=100)
+    with InputNode() as inp:
+        x = a.inc.bind(inp)
+        y = b.inc.bind(x)
+    dag = y.experimental_compile()
+    try:
+        assert dag.execute(0).get(timeout=30) == 102  # (0+1) + 1 + 100
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_multi_output(ca_cluster_module):
+    a = _Calc.remote()
+    b = _Calc.remote()
+    with InputNode() as inp:
+        x = a.inc.bind(inp)
+        y = b.inc.bind(inp)
+    dag = MultiOutputNode([x, y]).experimental_compile()
+    try:
+        assert dag.execute(1).get(timeout=30) == [2, 2]
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_input_attributes(ca_cluster_module):
+    a = _Calc.remote()
+    with InputNode() as inp:
+        out = a.mul.bind(inp[0], inp.k)
+    dag = out.experimental_compile()
+    try:
+        assert dag.execute(3, k=4).get(timeout=30) == 12
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_pipelined_executes(ca_cluster_module):
+    actor = _Calc.remote()
+    with InputNode() as inp:
+        out = actor.inc.bind(inp)
+    dag = out.experimental_compile(max_inflight_executions=3)
+    try:
+        refs = [dag.execute(i) for i in range(3)]
+        assert [r.get(timeout=30) for r in refs] == [1, 2, 3]
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_error_propagation(ca_cluster_module):
+    a = _Calc.remote()
+    b = _Calc.remote()
+    with InputNode() as inp:
+        x = a.boom.bind(inp)
+        y = b.inc.bind(x)
+    dag = y.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            dag.execute(1).get(timeout=30)
+        # the dag survives an error and keeps executing
+        with pytest.raises(ValueError, match="boom"):
+            dag.execute(2).get(timeout=30)
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_rejects_task_nodes(ca_cluster_module):
+    with InputNode() as inp:
+        out = _add.bind(inp, 1)
+    with pytest.raises(TypeError, match="actor-method"):
+        out.experimental_compile()
