@@ -1,0 +1,72 @@
+"""cluster_anywhere_tpu.train: distributed training on the actor runtime.
+
+Same capability surface as the reference's Ray Train (v1 trainer API +
+v2 controller/scaling/failure policies), TPU-first: the framework backend
+is JAX — single-host needs no process-group bootstrap (a Mesh over local
+chips suffices), multi-host bootstraps `jax.distributed`.
+
+In-loop API (inside train_loop_per_worker):
+    from cluster_anywhere_tpu import train
+    train.report(metrics, checkpoint=...)
+    train.get_checkpoint(); train.get_dataset_shard("train")
+    train.get_context().get_world_rank()
+"""
+
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (
+    BackendConfig,
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
+from .controller import (
+    ElasticScalingPolicy,
+    FailureDecision,
+    FailurePolicy,
+    FixedScalingPolicy,
+    Result,
+    ScalingPolicy,
+    TrainController,
+)
+from .session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    make_temp_checkpoint_dir,
+    report,
+)
+from .trainer import DataParallelTrainer, JaxTrainer
+from .worker_group import TrainWorker, WorkerGroup
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointManager",
+    "CheckpointConfig",
+    "BackendConfig",
+    "JaxConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainingFailedError",
+    "Result",
+    "TrainController",
+    "ScalingPolicy",
+    "FixedScalingPolicy",
+    "ElasticScalingPolicy",
+    "FailurePolicy",
+    "FailureDecision",
+    "TrainContext",
+    "report",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "make_temp_checkpoint_dir",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "TrainWorker",
+    "WorkerGroup",
+]

@@ -1,0 +1,90 @@
+"""Framework backends: per-worker process-group setup hooks.
+
+Analogue of the reference's `_TorchBackend` (train/torch/config.py:66-153,
+which calls torch.distributed.init_process_group) — except the TPU-native
+backend wires up JAX: rank env vars always; `jax.distributed.initialize`
+when the config asks for a true multi-host runtime (TPU pod / multi-proc
+CPU). Single-host JAX needs no collective bootstrap at all: a Mesh over
+locally visible chips is enough, XLA emits the ICI collectives.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from .config import BackendConfig, JaxConfig
+
+if TYPE_CHECKING:
+    from .worker_group import WorkerGroup
+
+
+class Backend:
+    """No-op base backend."""
+
+    def on_start(self, worker_group: "WorkerGroup", backend_config: BackendConfig):
+        pass
+
+    def on_training_start(
+        self, worker_group: "WorkerGroup", backend_config: BackendConfig
+    ):
+        pass
+
+    def on_shutdown(self, worker_group: "WorkerGroup", backend_config: BackendConfig):
+        pass
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int):
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group: "WorkerGroup", backend_config: JaxConfig):
+        n = worker_group.num_workers
+        local_ranks = worker_group.local_ranks()
+        node_ranks = worker_group.node_ranks()
+        coordinator = None
+        if backend_config.init_jax_distributed:
+            port = backend_config.coordinator_port or worker_group.execute_single(
+                0, _free_port
+            )
+            host = worker_group.node_infos[0]["hostname"]
+            coordinator = f"{host}:{port}"
+
+        import cluster_anywhere_tpu as ca
+
+        refs = []
+        for rank, w in enumerate(worker_group.workers):
+            env = {
+                "CA_WORLD_SIZE": str(n),
+                "CA_WORLD_RANK": str(rank),
+                "CA_LOCAL_RANK": str(local_ranks[rank]),
+                "CA_NODE_RANK": str(node_ranks[rank]),
+            }
+            if coordinator:
+                env["CA_COORDINATOR"] = coordinator
+            refs.append(w.set_env.remote(env))
+        ca.get(refs)
+
+        if coordinator:
+            ca.get(
+                [
+                    w.execute.remote(_init_jax_distributed, coordinator, n, rank)
+                    for rank, w in enumerate(worker_group.workers)
+                ]
+            )
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

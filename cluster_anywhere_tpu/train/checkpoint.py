@@ -1,0 +1,171 @@
+"""Checkpoint: a directory of files, referenced by path.
+
+Analogue of the reference's `ray.train.Checkpoint`
+(python/ray/train/_checkpoint.py) and `_CheckpointManager`
+(train/_internal/checkpoint_manager.py: keep-K by score attribute).
+
+TPU-first notes: model state is a JAX pytree; `save_pytree`/`load_pytree`
+store it with numpy .npz + a structure pickle so checkpoints are
+host-portable and never require the saving mesh to reload (arrays are
+fetched to host with `jax.device_get`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+import uuid
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import CheckpointConfig
+
+_METADATA_FILE = ".ca_checkpoint_metadata.json"
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy checkpoint contents into `path` (default: a temp dir)."""
+        dest = path or os.path.join(
+            tempfile.gettempdir(), f"ca_ckpt_{uuid.uuid4().hex[:8]}"
+        )
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        """Context manager yielding a local directory with the contents.
+        Local-fs checkpoints are yielded in place (no copy)."""
+        yield self.path
+
+    # -- metadata --------------------------------------------------------
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def set_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f)
+
+    # -- pytree helpers (TPU-first) --------------------------------------
+    def save_pytree(self, tree: Any, name: str = "state") -> None:
+        """Store a JAX/numpy pytree: leaves as .npz, structure pickled."""
+        import numpy as np
+
+        try:
+            import jax
+
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            leaves = [np.asarray(jax.device_get(x)) for x in leaves]
+        except ImportError:  # numpy-only environments
+            leaves, treedef = [np.asarray(tree)], None
+        np.savez(
+            os.path.join(self.path, f"{name}.npz"),
+            **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)},
+        )
+        with open(os.path.join(self.path, f"{name}.treedef.pkl"), "wb") as f:
+            pickle.dump(treedef, f)
+
+    def load_pytree(self, name: str = "state") -> Any:
+        import numpy as np
+
+        with np.load(os.path.join(self.path, f"{name}.npz")) as z:
+            leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+        with open(os.path.join(self.path, f"{name}.treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        if treedef is None:
+            return leaves[0]
+        import jax
+
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+@dataclass
+class _TrackedCheckpoint:
+    checkpoint: Checkpoint
+    index: int
+    metrics: Dict[str, Any] = field(default_factory=dict)
+
+
+class CheckpointManager:
+    """Registers reported checkpoints, retains the top-K by the configured
+    score attribute, deletes evicted checkpoint directories."""
+
+    def __init__(self, config: Optional[CheckpointConfig] = None):
+        self.config = config or CheckpointConfig()
+        self._lock = threading.Lock()
+        self._checkpoints: List[_TrackedCheckpoint] = []
+        self._next_index = 0
+
+    def register(
+        self, checkpoint: Checkpoint, metrics: Optional[Dict[str, Any]] = None
+    ) -> _TrackedCheckpoint:
+        with self._lock:
+            tracked = _TrackedCheckpoint(checkpoint, self._next_index, metrics or {})
+            self._next_index += 1
+            self._checkpoints.append(tracked)
+            self._evict_locked()
+            return tracked
+
+    def _score(self, t: _TrackedCheckpoint) -> Tuple[float, int]:
+        attr = self.config.checkpoint_score_attribute
+        sign = 1.0 if self.config.checkpoint_score_order == "max" else -1.0
+        if attr is None or attr not in t.metrics:
+            # fall back to recency so unscored checkpoints behave FIFO
+            return (float("-inf"), t.index)
+        return (sign * float(t.metrics[attr]), t.index)
+
+    def _evict_locked(self):
+        k = self.config.num_to_keep
+        if k is None or len(self._checkpoints) <= k:
+            return
+        latest = self._checkpoints[-1]
+        ranked = sorted(self._checkpoints, key=self._score, reverse=True)
+        keep = ranked[:k]
+        if latest not in keep:  # the latest is always kept for resume
+            keep = keep[: k - 1] + [latest]
+        for t in self._checkpoints:
+            if t not in keep:
+                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+        self._checkpoints = [t for t in self._checkpoints if t in keep]
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._checkpoints:
+                return None
+            return max(self._checkpoints, key=self._score).checkpoint
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._checkpoints:
+                return None
+            return self._checkpoints[-1].checkpoint
+
+    def best_checkpoints(self) -> List[Tuple[Checkpoint, Dict[str, Any]]]:
+        with self._lock:
+            ranked = sorted(self._checkpoints, key=self._score, reverse=True)
+            return [(t.checkpoint, dict(t.metrics)) for t in ranked]

@@ -1,0 +1,118 @@
+"""Train configuration dataclasses.
+
+Analogues of the reference's air/config.py (`RunConfig`/`ScalingConfig`/
+`FailureConfig`, reference python/ray/air/config.py) and
+CheckpointConfig (keep-K by score) — re-stated TPU-first: ScalingConfig
+speaks in workers x chips and an optional mesh spec instead of GPUs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers to launch and what each needs.
+
+    num_workers: number of SPMD training worker processes (one per host in a
+        real TPU pod; the driver assigns consecutive ranks).
+    use_tpu: request one "TPU" resource per worker (plus `chips_per_worker-1`
+        extra) so the scheduler lands workers on TPU hosts.
+    resources_per_worker: extra custom resources per worker.
+    placement_strategy: PACK | SPREAD | STRICT_PACK | STRICT_SPREAD for the
+        placement group that gangs the workers.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 1
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+    # Elastic bounds (Train-v2 style); None disables elasticity.
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
+
+    def bundle(self) -> Dict[str, float]:
+        b: Dict[str, float] = {"CPU": float(self.cpus_per_worker)}
+        if self.use_tpu:
+            b["TPU"] = float(self.chips_per_worker)
+        b.update(self.resources_per_worker)
+        return b
+
+
+@dataclass
+class CheckpointConfig:
+    """Keep-K checkpoint retention (reference train/_internal/checkpoint_manager.py)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclass
+class FailureConfig:
+    """max_failures: worker-group restarts allowed before the run fails.
+    -1 = unlimited (reference air/config.py FailureConfig)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0
+
+    def resolved_storage_path(self) -> str:
+        return os.path.expanduser(
+            self.storage_path or os.environ.get("CA_STORAGE_PATH", "~/ca_results")
+        )
+
+
+@dataclass
+class BackendConfig:
+    """Base class for framework backend configs (reference train/backend/backend.py)."""
+
+    def backend_cls(self):
+        from .backend import Backend
+
+        return Backend
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """JAX backend: optionally bootstrap `jax.distributed` across the worker
+    group (multi-host TPU pods); on a single host it only exports rank env
+    vars and lets each worker use its locally-visible chips.
+    """
+
+    init_jax_distributed: bool = False
+    coordinator_port: int = 0  # 0 = pick a free port on rank-0's node
+
+    def backend_cls(self):
+        from .backend import JaxBackend
+
+        return JaxBackend
+
+
+@dataclass
+class TrainingFailedError(Exception):
+    """Raised by trainer.fit() when training failed after exhausting retries."""
+
+    message: str = ""
+    worker_errors: Any = None
+
+    def __str__(self):
+        return self.message or "training failed"

@@ -1,0 +1,215 @@
+"""TrainController: the v2-style run loop with pluggable scaling + failure
+policies (analogue of reference train/v2/_internal/execution/controller/
+controller.py:91).
+
+State machine per attempt:
+  INIT -> STARTING (worker group up, backend bootstrapped)
+       -> RUNNING  (polling worker reports)
+       -> FINISHED | ERRORED
+On worker failure, FailurePolicy decides RETRY (rebuild the group, resume
+from the latest registered checkpoint) or RAISE.  ScalingPolicy decides
+the world size of each (re)start — ElasticScalingPolicy shrinks to what
+the cluster can actually place, enabling elastic training.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+from .backend_executor import BackendExecutor
+from .checkpoint import Checkpoint, CheckpointManager
+from .config import (
+    BackendConfig,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
+
+
+class RunAttemptStatus(Enum):
+    INIT = "INIT"
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    ERRORED = "ERRORED"
+
+
+@dataclass
+class Result:
+    """What fit() returns (reference air Result)."""
+
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    path: Optional[str] = None
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    best_checkpoints: List[Any] = field(default_factory=list)
+
+
+class ScalingPolicy:
+    def target_num_workers(self, scaling_config: ScalingConfig, attempt: int) -> int:
+        return scaling_config.num_workers
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    pass
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Shrink the group to what the cluster can place, within
+    [min_workers, max_workers]."""
+
+    def target_num_workers(self, scaling_config: ScalingConfig, attempt: int) -> int:
+        import cluster_anywhere_tpu as ca
+
+        lo = scaling_config.min_workers or 1
+        hi = scaling_config.max_workers or scaling_config.num_workers
+        bundle = scaling_config.bundle()
+        avail = ca.available_resources()
+        fit = hi
+        for key, per in bundle.items():
+            if per > 0:
+                fit = min(fit, int(avail.get(key, 0) // per))
+        return max(lo, min(hi, fit))
+
+
+class FailureDecision(Enum):
+    RETRY = "RETRY"
+    RAISE = "RAISE"
+
+
+class FailurePolicy:
+    def __init__(self, max_failures: int = 0):
+        self.max_failures = max_failures
+
+    def decide(self, failure_count: int, error: str) -> FailureDecision:
+        if self.max_failures < 0 or failure_count <= self.max_failures:
+            return FailureDecision.RETRY
+        return FailureDecision.RAISE
+
+
+class TrainController:
+    def __init__(
+        self,
+        train_fn: Callable,
+        train_fn_config: Optional[Dict[str, Any]],
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+        backend_config: BackendConfig,
+        datasets: Optional[Dict[str, Any]] = None,
+        experiment_name: Optional[str] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        scaling_policy: Optional[ScalingPolicy] = None,
+        poll_interval_s: float = 0.02,
+    ):
+        self.train_fn = train_fn
+        self.train_fn_config = train_fn_config
+        self.scaling_config = scaling_config
+        self.run_config = run_config
+        self.backend_config = backend_config
+        self.datasets = datasets
+        self.experiment_name = experiment_name or run_config.name or (
+            f"train_run_{int(time.time())}"
+        )
+        self.checkpoint_manager = CheckpointManager(run_config.checkpoint_config)
+        self.failure_policy = FailurePolicy(run_config.failure_config.max_failures)
+        if scaling_policy is None:
+            elastic = (
+                scaling_config.min_workers is not None
+                or scaling_config.max_workers is not None
+            )
+            scaling_policy = ElasticScalingPolicy() if elastic else FixedScalingPolicy()
+        self.scaling_policy = scaling_policy
+        self.poll_interval_s = poll_interval_s
+        self.status = RunAttemptStatus.INIT
+        self._resume_checkpoint = resume_from_checkpoint
+        self._latest_metrics: Dict[str, Any] = {}
+        self._metrics_history: List[Dict[str, Any]] = []
+
+    # -- one attempt -----------------------------------------------------
+    def _run_attempt(self, attempt: int) -> Optional[str]:
+        """Returns None on success, or an error string on worker failure."""
+        n = self.scaling_policy.target_num_workers(self.scaling_config, attempt)
+        executor = BackendExecutor(
+            self.backend_config,
+            self.scaling_config,
+            self.run_config,
+            self.experiment_name,
+        )
+        self.status = RunAttemptStatus.STARTING
+        try:
+            executor.start(num_workers=n)
+            resume = (
+                self.checkpoint_manager.latest_checkpoint or self._resume_checkpoint
+            )
+            executor.start_training(
+                self.train_fn, self.train_fn_config, self.datasets, resume
+            )
+            self.status = RunAttemptStatus.RUNNING
+            while True:
+                try:
+                    polls = executor.poll()
+                except Exception as e:  # a worker actor died mid-poll
+                    return f"worker group failure: {e!r}"
+                self._ingest_reports(polls)
+                errors = [p["error"] for p in polls if p["error"]]
+                if errors:
+                    return errors[0]
+                if all(p["done"] for p in polls):
+                    self.status = RunAttemptStatus.FINISHED
+                    return None
+                time.sleep(self.poll_interval_s)
+        finally:
+            executor.shutdown()
+
+    def _ingest_reports(self, polls: List[Dict[str, Any]]):
+        # rank 0 is authoritative for metrics + checkpoint registration
+        for rank, poll in enumerate(polls):
+            for rep in poll["reports"]:
+                if rank == 0:
+                    self._latest_metrics = rep["metrics"]
+                    self._metrics_history.append(rep["metrics"])
+                    if "checkpoint_path" in rep:
+                        self.checkpoint_manager.register(
+                            Checkpoint(rep["checkpoint_path"]), rep["metrics"]
+                        )
+
+    # -- full run --------------------------------------------------------
+    def run(self) -> Result:
+        failure_count = 0
+        attempt = 0
+        while True:
+            error = self._run_attempt(attempt)
+            attempt += 1
+            if error is None:
+                break
+            failure_count += 1
+            if self.failure_policy.decide(failure_count, error) != FailureDecision.RETRY:
+                self.status = RunAttemptStatus.ERRORED
+                import os
+
+                return Result(
+                    metrics=self._latest_metrics,
+                    checkpoint=self.checkpoint_manager.latest_checkpoint,
+                    path=os.path.join(
+                        self.run_config.resolved_storage_path(), self.experiment_name
+                    ),
+                    error=TrainingFailedError(message=error),
+                    metrics_history=self._metrics_history,
+                    best_checkpoints=self.checkpoint_manager.best_checkpoints(),
+                )
+        import os
+
+        return Result(
+            metrics=self._latest_metrics,
+            checkpoint=self.checkpoint_manager.latest_checkpoint,
+            path=os.path.join(
+                self.run_config.resolved_storage_path(), self.experiment_name
+            ),
+            error=None,
+            metrics_history=self._metrics_history,
+            best_checkpoints=self.checkpoint_manager.best_checkpoints(),
+        )

@@ -1,0 +1,142 @@
+"""Per-worker training session: the in-loop API.
+
+Analogue of the reference's train/_internal/session.py — `train.report`,
+`train.get_checkpoint`, `train.get_dataset_shard`, `train.get_context()`.
+
+The session lives inside a TrainWorker actor. `report()` persists any
+checkpoint to storage (worker-side upload, like the reference's
+StorageContext train/_internal/storage.py) and enqueues the report for
+the driver to poll. By default it does NOT block the training thread.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import uuid
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    world_size: int
+    world_rank: int
+    local_rank: int
+    node_rank: int
+    experiment_name: str
+    storage_path: str
+    trial_dir: str
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+class _Session:
+    def __init__(
+        self,
+        context: TrainContext,
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        resume_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.context = context
+        self.dataset_shards = dataset_shards or {}
+        self.resume_checkpoint = resume_checkpoint
+        self.reports: deque = deque()
+        self.lock = threading.Lock()
+        self.report_seq = 0
+        self.finished = threading.Event()
+
+    def report(
+        self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None
+    ) -> None:
+        entry: Dict[str, Any] = {"metrics": dict(metrics), "seq": self.report_seq}
+        if checkpoint is not None:
+            # Persist into the trial dir so it survives the worker process.
+            # Only rank 0's copy is registered by the driver, but every rank
+            # may pass a checkpoint (they are rank-tagged to avoid collision).
+            dest = os.path.join(
+                self.context.trial_dir,
+                f"checkpoint_{self.report_seq:06d}_rank{self.context.world_rank}",
+            )
+            if os.path.abspath(checkpoint.path) != dest:
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            entry["checkpoint_path"] = dest
+        with self.lock:
+            self.reports.append(entry)
+            self.report_seq += 1
+
+    def drain_reports(self) -> List[Dict[str, Any]]:
+        with self.lock:
+            out = list(self.reports)
+            self.reports.clear()
+            return out
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.resume_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        return self.dataset_shards.get(name)
+
+
+_session_lock = threading.Lock()
+_session: Optional[_Session] = None
+
+
+def _set_session(s: Optional[_Session]):
+    global _session
+    with _session_lock:
+        _session = s
+
+
+def _get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active: this API must be called from inside "
+            "a train_loop_per_worker launched by a Trainer."
+        )
+    return _session
+
+
+# ---- public in-loop API (mirrors `ray.train.*`) -------------------------
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+    _get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return _get_session().get_dataset_shard(name)
+
+
+def get_context() -> TrainContext:
+    return _get_session().context
+
+
+def make_temp_checkpoint_dir() -> str:
+    """A scratch dir for building a checkpoint before report()."""
+    d = os.path.join(
+        _get_session().context.trial_dir, f"_tmp_ckpt_{uuid.uuid4().hex[:8]}"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d

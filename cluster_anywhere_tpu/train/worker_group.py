@@ -1,0 +1,169 @@
+"""WorkerGroup: a gang of TrainWorker actors scheduled via a placement group.
+
+Analogue of the reference's train/_internal/worker_group.py:102 — but the
+worker actor here hosts the training thread AND the session, and the
+driver polls reports instead of using a results queue actor.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import cluster_anywhere_tpu as ca
+
+from .checkpoint import Checkpoint
+from .session import TrainContext, _Session, _set_session
+
+
+class TrainWorker:
+    """Actor hosting one training process' session + train thread."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._session = None
+        self._error: Optional[str] = None
+        self._done = False
+
+    def node_info(self) -> Dict[str, Any]:
+        return {
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "node_id": ca.get_runtime_context().node_id,
+        }
+
+    def free_port(self) -> int:
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def set_env(self, env: Dict[str, str]) -> None:
+        os.environ.update(env)
+
+    def start_training(
+        self,
+        train_fn: Callable,
+        train_fn_config: Optional[Dict[str, Any]],
+        context_kwargs: Dict[str, Any],
+        dataset_shards: Optional[Dict[str, Any]],
+        resume_checkpoint_path: Optional[str],
+    ) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("training already running on this worker")
+        ctx = TrainContext(**context_kwargs)
+        os.makedirs(ctx.trial_dir, exist_ok=True)
+        resume = (
+            Checkpoint(resume_checkpoint_path) if resume_checkpoint_path else None
+        )
+        self._session = _Session(ctx, dataset_shards, resume)
+        self._error = None
+        self._done = False
+        _set_session(self._session)
+
+        def _run():
+            try:
+                if train_fn_config is not None:
+                    train_fn(train_fn_config)
+                else:
+                    train_fn()
+            except BaseException:
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+                self._session.finished.set()
+
+        self._thread = threading.Thread(target=_run, daemon=True, name="ca-train")
+        self._thread.start()
+
+    def poll(self) -> Dict[str, Any]:
+        s = self._session
+        return {
+            "reports": s.drain_reports() if s else [],
+            "done": self._done,
+            "error": self._error,
+        }
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function in the worker process (backend setup)."""
+        return fn(*args, **kwargs)
+
+
+class WorkerGroup:
+    """N TrainWorker actors gang-scheduled through one placement group."""
+
+    def __init__(
+        self,
+        num_workers: int,
+        bundle: Dict[str, float],
+        placement_strategy: str = "PACK",
+        max_restarts: int = 0,
+    ):
+        self.num_workers = num_workers
+        self._pg = ca.placement_group(
+            [dict(bundle) for _ in range(num_workers)], strategy=placement_strategy
+        )
+        self._pg.wait(timeout_seconds=60)
+        cls = ca.remote(TrainWorker)
+        self.workers: List[Any] = [
+            cls.options(
+                max_concurrency=4,
+                max_restarts=max_restarts,
+                placement_group=self._pg,
+                placement_group_bundle_index=i,
+                **{k: v for k, v in bundle.items() if k == "num_cpus"},
+            ).remote()
+            for i in range(num_workers)
+        ]
+        # sorted by node for stable local_rank assignment
+        self.node_infos = ca.get([w.node_info.remote() for w in self.workers])
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ca.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, index: int, fn: Callable, *args, **kwargs) -> Any:
+        return ca.get(self.workers[index].execute.remote(fn, *args, **kwargs))
+
+    def local_ranks(self) -> List[int]:
+        counts: Dict[str, int] = {}
+        ranks = []
+        for info in self.node_infos:
+            nid = info["node_id"]
+            ranks.append(counts.get(nid, 0))
+            counts[nid] = counts.get(nid, 0) + 1
+        return ranks
+
+    def node_ranks(self) -> List[int]:
+        order: Dict[str, int] = {}
+        ranks = []
+        for info in self.node_infos:
+            nid = info["node_id"]
+            if nid not in order:
+                order[nid] = len(order)
+            ranks.append(order[nid])
+        return ranks
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ca.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        try:
+            ca.remove_placement_group(self._pg)
+        except Exception:
+            pass

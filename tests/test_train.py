@@ -1,0 +1,203 @@
+"""Train library tests (model: reference train/tests/test_data_parallel_trainer.py,
+test_checkpoint_manager.py, v2 controller tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu import train
+from cluster_anywhere_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+    DataParallelTrainer,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+    TrainingFailedError,
+)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    d = tmp_path / "ck"
+    d.mkdir()
+    (d / "weights.txt").write_text("hello")
+    ck = Checkpoint.from_directory(str(d))
+    ck.set_metadata({"epoch": 3})
+    out = ck.to_directory(str(tmp_path / "out"))
+    assert open(os.path.join(out, "weights.txt")).read() == "hello"
+    assert Checkpoint(out).get_metadata()["epoch"] == 3
+
+
+def test_checkpoint_pytree(tmp_path):
+    d = tmp_path / "ck"
+    d.mkdir()
+    ck = Checkpoint(str(d))
+    tree = {"w": np.arange(6).reshape(2, 3), "b": np.zeros(3)}
+    ck.save_pytree(tree)
+    loaded = ck.load_pytree()
+    np.testing.assert_array_equal(loaded["w"], tree["w"])
+    np.testing.assert_array_equal(loaded["b"], tree["b"])
+
+
+def test_checkpoint_manager_keep_k(tmp_path):
+    mgr = CheckpointManager(
+        CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc")
+    )
+    paths = []
+    for i, acc in enumerate([0.1, 0.9, 0.5]):
+        p = tmp_path / f"ck{i}"
+        p.mkdir()
+        paths.append(str(p))
+        mgr.register(Checkpoint(str(p)), {"acc": acc})
+    # keep best (0.9) + latest (0.5); 0.1 evicted and deleted
+    kept = [c.path for c, _ in mgr.best_checkpoints()]
+    assert paths[1] in kept and paths[2] in kept and paths[0] not in kept
+    assert not os.path.exists(paths[0])
+    assert mgr.best_checkpoint.path == paths[1]
+    assert mgr.latest_checkpoint.path == paths[2]
+
+
+@pytest.mark.usefixtures("ca_cluster_module")
+class TestTrainer:
+    def test_basic_fit(self, tmp_path):
+        def loop(config):
+            ctx = train.get_context()
+            for epoch in range(config["epochs"]):
+                train.report({"epoch": epoch, "rank": ctx.get_world_rank()})
+
+        result = DataParallelTrainer(
+            loop,
+            train_loop_config={"epochs": 3},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="basic", storage_path=str(tmp_path)),
+        ).fit()
+        assert result.metrics["epoch"] == 2
+        assert result.metrics["rank"] == 0
+        assert len(result.metrics_history) == 3
+
+    def test_world_context_and_dataset_shard(self, tmp_path):
+        def loop():
+            ctx = train.get_context()
+            shard = train.get_dataset_shard("train")
+            train.report(
+                {
+                    "world_size": ctx.get_world_size(),
+                    "rank": ctx.get_world_rank(),
+                    "shard": list(shard),
+                }
+            )
+
+        result = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="shard", storage_path=str(tmp_path)),
+            datasets={"train": [1, 2, 3, 4]},
+        ).fit()
+        assert result.metrics["world_size"] == 2
+        assert result.metrics["shard"] == [1, 3]  # rank 0's strided shard
+
+    def test_checkpoint_save_and_keepk(self, tmp_path):
+        def loop():
+            if train.get_context().get_world_rank() != 0:
+                train.report({"loss": 0.0})
+                return
+            for step in range(3):
+                d = train.make_temp_checkpoint_dir()
+                ck = Checkpoint(d)
+                ck.save_pytree({"step": np.array(step)})
+                train.report({"loss": 1.0 / (step + 1)}, checkpoint=ck)
+
+        result = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="ckpt",
+                storage_path=str(tmp_path),
+                checkpoint_config=CheckpointConfig(
+                    num_to_keep=2,
+                    checkpoint_score_attribute="loss",
+                    checkpoint_score_order="min",
+                ),
+            ),
+        ).fit()
+        assert result.checkpoint is not None
+        assert int(result.checkpoint.load_pytree()["step"]) == 2
+        assert len(result.best_checkpoints) == 2
+
+    def test_failure_retry_resumes_from_checkpoint(self, tmp_path):
+        marker = str(tmp_path / "fail_once")
+
+        def loop(config):
+            start = 0
+            ck = train.get_checkpoint()
+            if ck is not None:
+                start = int(ck.load_pytree()["step"]) + 1
+            for step in range(start, 4):
+                d = train.make_temp_checkpoint_dir()
+                c = Checkpoint(d)
+                c.save_pytree({"step": np.array(step)})
+                train.report({"step": step}, checkpoint=c)
+                if step == 1 and not os.path.exists(config["marker"]):
+                    open(config["marker"], "w").close()
+                    raise RuntimeError("injected failure")
+
+        result = DataParallelTrainer(
+            loop,
+            train_loop_config={"marker": marker},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="retry",
+                storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        ).fit()
+        # resumed at step 2 (after checkpoint for step 1), finished at 3
+        assert result.metrics["step"] == 3
+
+    def test_failure_exhausted_raises(self, tmp_path):
+        def loop():
+            raise ValueError("boom")
+
+        with pytest.raises(TrainingFailedError):
+            DataParallelTrainer(
+                loop,
+                scaling_config=ScalingConfig(num_workers=1),
+                run_config=RunConfig(name="fail", storage_path=str(tmp_path)),
+            ).fit()
+
+    def test_elastic_scaling_shrinks_to_capacity(self, tmp_path):
+        # cluster has 4 CPUs; asking for up to 8 workers of 1 CPU each must
+        # shrink to <= 4 (driver holds none)
+        def loop():
+            train.report({"n": train.get_context().get_world_size()})
+
+        result = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=8, min_workers=1, max_workers=8),
+            run_config=RunConfig(name="elastic", storage_path=str(tmp_path)),
+        ).fit()
+        assert 1 <= result.metrics["n"] <= 4
+
+
+def test_jax_backend_local_mesh(ca_cluster_module, tmp_path):
+    """JaxTrainer on a single host: each worker builds a local device mesh and
+    runs one pjit step — no distributed bootstrap needed."""
+
+    def loop():
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((8, 8))
+        y = jax.jit(lambda a: (a @ a.T).sum())(x)
+        train.report({"y": float(y), "n_dev": len(jax.devices())})
+
+    result = train.JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="jaxlocal", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.metrics["y"] == pytest.approx(512.0)
+    assert result.metrics["n_dev"] >= 1
