@@ -1,6 +1,6 @@
 """Typed single-writer / multi-reader channels (analogue of the reference's
 ray.experimental.channel: shared_memory_channel.py:151 Channel,
-BufferedSharedMemoryChannel:534, CompositeChannel:648, IntraProcessChannel),
+BufferedSharedMemoryChannel:534, IntraProcessChannel),
 backed by versioned shared-memory segments instead of mutable plasma objects
 (reference C++ experimental_mutable_object_manager.h:49).
 
@@ -13,7 +13,6 @@ from .shm_channel import (
     BufferedShmChannel,
     ChannelClosedError,
     ChannelInterface,
-    CompositeChannel,
     IntraProcessChannel,
     ShmChannel,
 )
@@ -23,6 +22,5 @@ __all__ = [
     "ShmChannel",
     "BufferedShmChannel",
     "IntraProcessChannel",
-    "CompositeChannel",
     "ChannelClosedError",
 ]

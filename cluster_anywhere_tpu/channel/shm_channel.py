@@ -106,6 +106,7 @@ class ShmChannel(ChannelInterface):
                 os.close(fd)
         self.path = path
         self.capacity = len(self._mm) - self.header_size
+        self._last_spill = None
 
     # -- u64 accessors ------------------------------------------------------
 
@@ -161,11 +162,11 @@ class ShmChannel(ChannelInterface):
             ref = ca.put(value)
             payload, spilled = pack(ref), True
         self._write_payload(payload, spilled, deadline)
-        if spilled:
-            # _write_payload waited for all acks of the previous version, so
-            # the prior spilled object (if any) has been consumed — safe to
-            # drop its ref and keep the new one alive until the next write
-            self._last_spill = ref
+        # _write_payload waited for all acks of the previous version, and
+        # readers only ack after fetching a spilled payload — so the prior
+        # spilled object (if any) has been consumed.  Drop its ref, and keep
+        # the new one (None for inline writes) alive until the next write.
+        self._last_spill = ref
 
     def read(self, timeout: Optional[float] = None) -> Any:
         from ..core.serialization import unpack
@@ -178,15 +179,18 @@ class ShmChannel(ChannelInterface):
             if deadline is not None and _now() > deadline:
                 raise TimeoutError("channel read timed out")
             time.sleep(_POLL_S)
+        ver = self.version
         ln = self._get(2)
         spilled = bool(ln & _SPILL_BIT)
         ln &= ~_SPILL_BIT
         value = unpack(bytes(self._mm[self.header_size : self.header_size + ln]))
-        self._set(5 + self.reader_index, self.version)
         if spilled:
             from ..core import api as ca
 
+            # fetch BEFORE acking: the ack is what lets the writer's next
+            # write drop its reference to this spilled object
             value = ca.get(value)
+        self._set(5 + self.reader_index, ver)
         return value
 
     def close(self):
@@ -289,26 +293,8 @@ class IntraProcessChannel(ChannelInterface):
         self._closed = True
 
 
-class CompositeChannel(ChannelInterface):
-    """Picks the cheapest transport per reader (reference:
-    shared_memory_channel.py:648): intra-process queue for readers in the
-    writer's process, shm for readers in other processes on the node."""
-
-    def __init__(self, local_channel: Optional[IntraProcessChannel], remote: Optional[ShmChannel]):
-        self._local = local_channel
-        self._remote = remote
-
-    def write(self, value: Any, timeout: Optional[float] = None):
-        if self._local is not None:
-            self._local.write(value, timeout)
-        if self._remote is not None:
-            self._remote.write(value, timeout)
-
-    def read(self, timeout: Optional[float] = None) -> Any:
-        src = self._local if self._local is not None else self._remote
-        return src.read(timeout)
-
-    def close(self):
-        for c in (self._local, self._remote):
-            if c is not None:
-                c.close()
+# NOTE: the reference also has a CompositeChannel (shared_memory_channel.py:648)
+# that picks intra-process vs shm transport per reader.  Here actors and the
+# driver are always separate processes, so shm is always the right transport
+# and no composite selection layer exists; same-actor DAG edges pass values
+# in-memory inside the actor loop instead (dag/compiled.py "local" arg specs).

@@ -68,6 +68,11 @@ class ActorRec:
     death_cause: str = ""
     pg_id: Optional[str] = None
     bundle_index: int = -1
+    # where this incarnation's resources are currently charged:
+    # "pg" (bundle.used) | "node" (self.avail) | None (not charged) — guards
+    # against double-crediting when a PG is removed before the actor's
+    # worker-death event is processed
+    charged: Optional[str] = None
 
 
 @dataclass
@@ -349,10 +354,12 @@ class Head:
                 b = self.pgs[a.pg_id].bundles[a.bundle_index]
                 for k, v in a.resources.items():
                     b.used[k] = b.used.get(k, 0.0) + v
+                a.charged = "pg"
         else:
             ok = self._fits(self.avail, a.resources)
             if ok:
                 self._take(self.avail, a.resources)
+                a.charged = "node"
         if not ok:
             a.state = "dead"
             a.death_cause = "resources unavailable for actor"
@@ -426,13 +433,17 @@ class Head:
         if rec.actor_id:
             a = self.actors.get(rec.actor_id)
             if a is not None and a.state in ("alive", "restarting", "pending"):
-                # return the actor's lifetime resources
-                if a.pg_id and a.pg_id in self.pgs:
-                    b = self.pgs[a.pg_id].bundles[a.bundle_index]
-                    for k, v in a.resources.items():
-                        b.used[k] = b.used.get(k, 0.0) - v
-                else:
+                # return the actor's lifetime resources to wherever they were
+                # charged; a PG-charged actor whose PG is already removed
+                # credits nothing (the reservation went back with the PG)
+                if a.charged == "pg":
+                    if a.pg_id in self.pgs:
+                        b = self.pgs[a.pg_id].bundles[a.bundle_index]
+                        for k, v in a.resources.items():
+                            b.used[k] = b.used.get(k, 0.0) - v
+                elif a.charged == "node":
                     self._give(self.avail, a.resources)
+                a.charged = None
                 if a.max_restarts != 0 and (
                     a.max_restarts < 0 or a.restarts_used < a.max_restarts
                 ):

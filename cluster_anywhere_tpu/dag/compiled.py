@@ -94,6 +94,18 @@ def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple
                         return _extract_input(chan_val(ref[0]), ref[1])
                     raise ValueError(kind)
 
+                def drain_op():
+                    # every channel must be read exactly once per tick or
+                    # readers desynchronize from writers on the next execution
+                    # (chan_val caches, so re-draining already-read args is a
+                    # no-op)
+                    for spec in list(op["args"]) + list(op["kwargs"].values()):
+                        kind, ref = spec
+                        if kind == "chan":
+                            chan_val(ref)
+                        elif kind == "input":
+                            chan_val(ref[0])
+
                 if err is None:
                     try:
                         args = [resolve(s) for s in op["args"]]
@@ -110,7 +122,17 @@ def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple
                     except BaseException as e:  # noqa: BLE001 — forwarded to driver
                         result = _DagError(e)
                         err = result
+                        try:  # arg resolution may have aborted mid-way
+                            drain_op()
+                        except ChannelClosedError:
+                            closed = True
+                            break
                 else:
+                    try:
+                        drain_op()
+                    except ChannelClosedError:
+                        closed = True
+                        break
                     result = err
                 tick_vals[op["node_id"]] = result
                 if op["node_id"] in writers:
@@ -139,8 +161,11 @@ class CompiledDAGRef:
     def get(self, timeout: Optional[float] = None):
         if self._consumed:
             raise ValueError("CompiledDAGRef.get() may only be called once")
+        result = self._dag._read_result(self._seq, timeout)
+        # only mark consumed on success: a TimeoutError leaves the ref
+        # retryable (the DAG's partial-read state keeps channels aligned)
         self._consumed = True
-        return self._dag._read_result(self._seq, timeout)
+        return result
 
     def __repr__(self):
         return f"CompiledDAGRef(seq={self._seq})"
@@ -306,16 +331,24 @@ class CompiledDAG:
             )
             self._loop_refs.append(ref)
 
-        # driver-side reader handles for outputs
+        # driver-side reader handles for outputs; duplicate leaves in a
+        # MultiOutputNode share one channel that is read once per tick
         self._driver_readers = {}
+        self._driver_read_order: List[int] = []
         for leaf in output_leaves:
+            if leaf._id in self._driver_readers:
+                continue
             spec = self._channels[leaf._id].spec()
             self._driver_readers[leaf._id] = open_channel(
                 spec, reader_index[(leaf._id, "<driver>")]
             )
+            self._driver_read_order.append(leaf._id)
         self._output_leaves = output_leaves
         self._multi_output = isinstance(root, MultiOutputNode)
         self._INPUT_ID = INPUT_ID
+        # partially-read tick state (survives a TimeoutError so channel
+        # streams never misalign): node_id -> value for the current tick
+        self._partial_vals: Dict[int, Any] = {}
 
     # ---------------------------------------------------------------- execute
 
@@ -329,11 +362,20 @@ class CompiledDAG:
         return ref
 
     def _read_result(self, seq: int, timeout: Optional[float]):
+        import time as _time
+
+        t = self._timeout if timeout is None else timeout
+        deadline = _time.monotonic() + t
         while self._read_seq <= seq:
-            outs = [
-                self._driver_readers[leaf._id].read(timeout or self._timeout)
-                for leaf in self._output_leaves
-            ]
+            for nid in self._driver_read_order:
+                if nid in self._partial_vals:
+                    continue  # already read before an earlier timeout
+                # clamp to 0 rather than pre-raising: a 0-timeout read still
+                # returns a value that is already published (poll semantics)
+                remaining = max(0.0, deadline - _time.monotonic())
+                self._partial_vals[nid] = self._driver_readers[nid].read(remaining)
+            outs = [self._partial_vals[leaf._id] for leaf in self._output_leaves]
+            self._partial_vals = {}
             self._result_cache[self._read_seq] = outs
             self._read_seq += 1
         outs = self._result_cache.pop(seq)

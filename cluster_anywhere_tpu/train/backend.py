@@ -48,15 +48,15 @@ class JaxBackend(Backend):
         n = worker_group.num_workers
         local_ranks = worker_group.local_ranks()
         node_ranks = worker_group.node_ranks()
+        import cluster_anywhere_tpu as ca
+
         coordinator = None
         if backend_config.init_jax_distributed:
-            port = backend_config.coordinator_port or worker_group.execute_single(
-                0, _free_port
+            port = backend_config.coordinator_port or ca.get(
+                worker_group.workers[0].free_port.remote()
             )
             host = worker_group.node_infos[0]["hostname"]
             coordinator = f"{host}:{port}"
-
-        import cluster_anywhere_tpu as ca
 
         refs = []
         for rank, w in enumerate(worker_group.workers):
@@ -78,13 +78,3 @@ class JaxBackend(Backend):
                     for rank, w in enumerate(worker_group.workers)
                 ]
             )
-
-
-def _free_port() -> int:
-    import socket
-
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port

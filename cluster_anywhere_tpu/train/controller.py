@@ -181,6 +181,7 @@ class TrainController:
     def run(self) -> Result:
         failure_count = 0
         attempt = 0
+        final_error: Optional[BaseException] = None
         while True:
             error = self._run_attempt(attempt)
             attempt += 1
@@ -189,18 +190,8 @@ class TrainController:
             failure_count += 1
             if self.failure_policy.decide(failure_count, error) != FailureDecision.RETRY:
                 self.status = RunAttemptStatus.ERRORED
-                import os
-
-                return Result(
-                    metrics=self._latest_metrics,
-                    checkpoint=self.checkpoint_manager.latest_checkpoint,
-                    path=os.path.join(
-                        self.run_config.resolved_storage_path(), self.experiment_name
-                    ),
-                    error=TrainingFailedError(message=error),
-                    metrics_history=self._metrics_history,
-                    best_checkpoints=self.checkpoint_manager.best_checkpoints(),
-                )
+                final_error = TrainingFailedError(message=error)
+                break
         import os
 
         return Result(
@@ -209,7 +200,7 @@ class TrainController:
             path=os.path.join(
                 self.run_config.resolved_storage_path(), self.experiment_name
             ),
-            error=None,
+            error=final_error,
             metrics_history=self._metrics_history,
             best_checkpoints=self.checkpoint_manager.best_checkpoints(),
         )

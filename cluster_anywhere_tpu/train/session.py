@@ -62,6 +62,11 @@ class _Session:
         self.lock = threading.Lock()
         self.report_seq = 0
         self.finished = threading.Event()
+        # distinguishes checkpoint dirs across retry attempts: report_seq
+        # restarts at 0 in a new session, and a colliding path would let the
+        # driver's keep-K eviction of the old attempt's entry delete the new
+        # attempt's data
+        self.attempt_token = uuid.uuid4().hex[:8]
 
     def report(
         self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None
@@ -73,7 +78,8 @@ class _Session:
             # may pass a checkpoint (they are rank-tagged to avoid collision).
             dest = os.path.join(
                 self.context.trial_dir,
-                f"checkpoint_{self.report_seq:06d}_rank{self.context.world_rank}",
+                f"checkpoint_{self.attempt_token}_{self.report_seq:06d}"
+                f"_rank{self.context.world_rank}",
             )
             if os.path.abspath(checkpoint.path) != dest:
                 os.makedirs(os.path.dirname(dest), exist_ok=True)

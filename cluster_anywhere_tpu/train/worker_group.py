@@ -115,13 +115,18 @@ class WorkerGroup:
         )
         self._pg.wait(timeout_seconds=60)
         cls = ca.remote(TrainWorker)
+        custom = {
+            k: v for k, v in bundle.items() if k not in ("CPU", "TPU", "memory")
+        }
         self.workers: List[Any] = [
             cls.options(
                 max_concurrency=4,
                 max_restarts=max_restarts,
                 placement_group=self._pg,
                 placement_group_bundle_index=i,
-                **{k: v for k, v in bundle.items() if k == "num_cpus"},
+                num_cpus=bundle.get("CPU", 0),
+                num_tpus=bundle.get("TPU", 0),
+                resources=custom,
             ).remote()
             for i in range(num_workers)
         ]

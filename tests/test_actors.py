@@ -189,3 +189,38 @@ def test_actor_resource_reservation(ca_cluster):
     assert ca.get(h.ok.remote()) == 1
     avail = ca.available_resources()
     assert avail["CPU"] <= 2.0
+
+
+def test_resource_conservation_kill_and_remove_pg(ca_cluster):
+    """Killing PG-scheduled actors and removing the PG (in any processing
+    order) must return exactly the reserved resources — regression test for a
+    double-credit when remove_pg raced the actor's worker-death event."""
+    import time
+
+    import cluster_anywhere_tpu as ca
+
+    @ca.remote
+    class A:
+        def ping(self):
+            return 1
+
+    total = ca.cluster_resources()["CPU"]
+    for _ in range(3):
+        pg = ca.placement_group([{"CPU": 1.0}] * 2, strategy="PACK")
+        assert pg.wait(30)
+        actors = [
+            A.options(
+                num_cpus=1, placement_group=pg, placement_group_bundle_index=i
+            ).remote()
+            for i in range(2)
+        ]
+        ca.get([a.ping.remote() for a in actors])
+        for a in actors:
+            ca.kill(a)
+        ca.remove_placement_group(pg)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ca.available_resources().get("CPU") == total:
+            break
+        time.sleep(0.2)
+    assert ca.available_resources().get("CPU") == total

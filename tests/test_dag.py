@@ -85,6 +85,12 @@ class _Calc:
     def boom(self, x):
         raise ValueError("boom")
 
+    def slow_inc(self, x):
+        import time
+
+        time.sleep(0.5)
+        return x + 1
+
     def num_calls(self):
         return self.calls
 
@@ -197,3 +203,55 @@ def test_compiled_dag_rejects_task_nodes(ca_cluster_module):
         out = _add.bind(inp, 1)
     with pytest.raises(TypeError, match="actor-method"):
         out.experimental_compile()
+
+
+def test_compiled_dag_error_then_channel_stays_aligned(ca_cluster_module):
+    """After one op errors on actor B, B's other input channels are still
+    drained that tick — later executions see fresh values, not stale ones."""
+    a = _Calc.remote()
+    b = _Calc.remote()
+    c = _Calc.remote()
+    with InputNode() as inp:
+        x = a.boom.bind(inp)      # b reads from a (error producer)...
+        z = c.inc.bind(inp)       # ...and from c (healthy producer)
+        y = b.mul.bind(x, z)
+    dag = y.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            dag.execute(1).get(timeout=30)
+        with pytest.raises(ValueError, match="boom"):
+            dag.execute(2).get(timeout=30)
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_nonblocking_get_timeout_then_retry(ca_cluster_module):
+    import time
+
+    actor = _Calc.remote()
+    with InputNode() as inp:
+        out = actor.slow_inc.bind(inp)
+    dag = out.experimental_compile()
+    try:
+        ref = dag.execute(5)
+        # timeout=0 must be non-blocking (not fall back to the default)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            ref.get(timeout=0)
+        assert time.monotonic() - t0 < 1.0
+        # ref is retryable after a timeout and returns the right value
+        assert ref.get(timeout=30) == 6
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_duplicate_output_leaves(ca_cluster_module):
+    a = _Calc.remote()
+    with InputNode() as inp:
+        x = a.inc.bind(inp)
+    dag = MultiOutputNode([x, x]).experimental_compile()
+    try:
+        assert dag.execute(1).get(timeout=30) == [2, 2]
+        assert dag.execute(5).get(timeout=30) == [6, 6]
+    finally:
+        dag.teardown()
