@@ -1,0 +1,132 @@
+"""Data library: lazy streaming datasets over the distributed object store
+(analogue of the reference's python/ray/data/ — Dataset, read APIs,
+streaming executor).
+
+    import cluster_anywhere_tpu.data as cad
+    ds = cad.range(1000).map_batches(lambda b: {"x": b["id"] * 2})
+    for batch in ds.iter_batches(batch_size=128):
+        ...
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from .aggregate import AbsMax, AggregateFn, Count, Max, Mean, Min, Quantile, Std, Sum
+from .block import Block, BlockAccessor
+from .dataset import Dataset, MaterializedDataset
+from .datasource import (
+    BinaryDatasource,
+    BlocksDatasource,
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    TextDatasource,
+)
+from .iterator import DataIterator
+from .plan import LogicalPlan, Read
+
+
+def _from_source(source: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset(LogicalPlan([Read(source, parallelism)]))
+
+
+def range(n: int, *, parallelism: int = -1, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    return _from_source(RangeDatasource(n), override_num_blocks or parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1, override_num_blocks=None) -> Dataset:
+    return _from_source(RangeDatasource(n, tuple(shape)), override_num_blocks or parallelism)
+
+
+def from_items(items: Sequence[Any], *, parallelism: int = -1, override_num_blocks=None) -> Dataset:
+    return _from_source(ItemsDatasource(items), override_num_blocks or parallelism)
+
+
+def from_numpy(arr, column: str = "data") -> Dataset:
+    import numpy as np
+
+    from .block import build_block
+
+    arrs = arr if isinstance(arr, list) else [arr]
+    blocks = [build_block({column: np.asarray(a)}) for a in arrs]
+    return _from_source(BlocksDatasource(blocks))
+
+
+def from_pandas(dfs) -> Dataset:
+    import pyarrow as pa
+
+    dfs = dfs if isinstance(dfs, list) else [dfs]
+    blocks = [pa.Table.from_pandas(df, preserve_index=False) for df in dfs]
+    return _from_source(BlocksDatasource(blocks))
+
+
+def from_arrow(tables) -> Dataset:
+    tables = tables if isinstance(tables, list) else [tables]
+    return _from_source(BlocksDatasource(list(tables)))
+
+
+def read_datasource(source: Datasource, *, parallelism: int = -1) -> Dataset:
+    return _from_source(source, parallelism)
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None, parallelism: int = -1, **kw) -> Dataset:
+    return _from_source(ParquetDatasource(paths, columns=columns, **kw), parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _from_source(CSVDatasource(paths, **kw), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _from_source(JSONDatasource(paths, **kw), parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _from_source(TextDatasource(paths, **kw), parallelism)
+
+
+def read_binary_files(paths, *, include_paths: bool = False, parallelism: int = -1) -> Dataset:
+    return _from_source(BinaryDatasource(paths, include_paths=include_paths), parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1, **kw) -> Dataset:
+    return _from_source(NumpyDatasource(paths, **kw), parallelism)
+
+
+__all__ = [
+    "Dataset",
+    "MaterializedDataset",
+    "DataIterator",
+    "Datasource",
+    "ReadTask",
+    "BlockAccessor",
+    "Block",
+    "AggregateFn",
+    "Count",
+    "Sum",
+    "Min",
+    "Max",
+    "Mean",
+    "Std",
+    "AbsMax",
+    "Quantile",
+    "range",
+    "range_tensor",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "from_arrow",
+    "read_datasource",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_text",
+    "read_binary_files",
+    "read_numpy",
+]

@@ -1,0 +1,244 @@
+"""Block representation + accessor.
+
+A *block* is the unit of parallelism in the data library (analogue of the
+reference's python/ray/data/block.py BlockAccessor over Arrow/pandas blocks).
+Two physical layouts:
+
+- ``pyarrow.Table`` — the default for tabular/tensor data; zero-copy column
+  access, cheap slicing/concat, efficient shm transit.
+- ``list`` of arbitrary Python rows — fallback for heterogeneous objects.
+
+``BlockAccessor.for_block`` dispatches on the layout.  All transforms accept
+and return *batches* (dict[str, np.ndarray], pandas.DataFrame, pyarrow.Table,
+or list of rows) and the accessor converts at the edges.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover - pyarrow is available in the image
+    pa = None
+
+Block = Union["pa.Table", List[Any]]
+
+# column name used when the user provides scalar items (mirrors the
+# reference's "item" column for simple datasets)
+ITEM_COL = "item"
+
+
+def _is_tensor_like(col: np.ndarray) -> bool:
+    return isinstance(col, np.ndarray) and col.ndim > 1
+
+
+class _TensorArray:
+    """Minimal fixed-shape tensor column for Arrow tables: stored as a
+    FixedSizeListArray with shape metadata (analogue of the reference's
+    ArrowTensorArray, python/ray/air/util/tensor_extensions/arrow.py)."""
+
+    @staticmethod
+    def to_arrow(col: np.ndarray):
+        flat = np.ascontiguousarray(col).reshape(len(col), -1)
+        inner = pa.array(flat.ravel())
+        fsl = pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+        return fsl, col.shape[1:]
+
+    @staticmethod
+    def from_arrow(arr, shape) -> np.ndarray:
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        values = arr.values.to_numpy(zero_copy_only=False)
+        return values.reshape((len(arr),) + tuple(shape))
+
+
+def build_block(batch: Any) -> Block:
+    """Normalize any supported batch format into a block."""
+    if pa is not None and isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, list):
+        return batch
+    if isinstance(batch, dict):
+        return _table_from_numpy_dict(batch)
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, np.ndarray):
+        return _table_from_numpy_dict({"data": batch})
+    raise TypeError(f"cannot build a block from {type(batch)}")
+
+
+def _table_from_numpy_dict(d: Dict[str, Any]) -> "pa.Table":
+    cols, names, meta = [], [], {}
+    for name, col in d.items():
+        col = np.asarray(col)
+        if _is_tensor_like(col):
+            arr, shape = _TensorArray.to_arrow(col)
+            meta[f"tensor:{name}"] = repr(list(shape))
+            cols.append(arr)
+        elif col.dtype == object:
+            cols.append(pa.array(col.tolist()))
+        else:
+            cols.append(pa.array(col))
+        names.append(name)
+    t = pa.table(dict(zip(names, cols)))
+    if meta:
+        t = t.replace_schema_metadata(
+            {**(t.schema.metadata or {}), **{k.encode(): v.encode() for k, v in meta.items()}}
+        )
+    return t
+
+
+class BlockAccessor:
+    """Uniform view over a block (analogue of ray.data.block.BlockAccessor)."""
+
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # ------------------------------------------------------------- structure
+    def num_rows(self) -> int:
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.num_rows
+        return len(self._block)
+
+    def size_bytes(self) -> int:
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.nbytes
+        try:
+            import sys
+
+            return sum(sys.getsizeof(r) for r in self._block)
+        except Exception:
+            return 0
+
+    def schema(self):
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.schema
+        if self._block:
+            return type(self._block[0])
+        return None
+
+    def slice(self, start: int, end: int) -> Block:
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.slice(start, end - start)
+        return self._block[start:end]
+
+    @staticmethod
+    def concat(blocks: Sequence[Block]) -> Block:
+        blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0] or list(blocks[:1])
+        if not blocks:
+            return []
+        if pa is not None and isinstance(blocks[0], pa.Table):
+            meta = {}
+            for b in blocks:
+                if b.schema.metadata:
+                    meta.update(b.schema.metadata)
+            t = pa.concat_tables(blocks, promote_options="default")
+            if meta:
+                t = t.replace_schema_metadata({**meta, **(t.schema.metadata or {})})
+            return t
+        out: List[Any] = []
+        for b in blocks:
+            out.extend(b)
+        return out
+
+    # ----------------------------------------------------------- conversion
+    def _tensor_shapes(self) -> Dict[str, tuple]:
+        meta = self._block.schema.metadata or {}
+        out = {}
+        for k, v in meta.items():
+            k = k.decode()
+            if k.startswith("tensor:"):
+                out[k[len("tensor:"):]] = tuple(eval(v.decode()))  # noqa: S307 - own metadata
+        return out
+
+    def to_numpy_batch(self) -> Dict[str, np.ndarray]:
+        if pa is not None and isinstance(self._block, pa.Table):
+            shapes = self._tensor_shapes()
+            out = {}
+            for name in self._block.column_names:
+                col = self._block.column(name)
+                if name in shapes:
+                    out[name] = _TensorArray.from_arrow(col, shapes[name])
+                else:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+            return out
+        # list block: rows must be dicts for a columnar view
+        if self._block and isinstance(self._block[0], dict):
+            keys = self._block[0].keys()
+            return {k: np.asarray([r[k] for r in self._block]) for k in keys}
+        return {ITEM_COL: np.asarray(self._block, dtype=object)}
+
+    def to_arrow(self) -> "pa.Table":
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block
+        return _table_from_numpy_dict(self.to_numpy_batch())
+
+    def to_pandas(self):
+        import pandas as pd
+
+        if pa is not None and isinstance(self._block, pa.Table):
+            shapes = self._tensor_shapes()
+            if shapes:
+                batch = self.to_numpy_batch()
+                return pd.DataFrame({k: list(v) if v.ndim > 1 else v for k, v in batch.items()})
+            return self._block.to_pandas()
+        return pd.DataFrame(self.to_numpy_batch())
+
+    def to_batch(self, batch_format: Optional[str]) -> Any:
+        if batch_format in (None, "default", "numpy"):
+            return self.to_numpy_batch()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format == "pyarrow":
+            return self.to_arrow()
+        if batch_format == "rows":
+            return list(self.iter_rows())
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # ------------------------------------------------------------------ rows
+    def iter_rows(self) -> Iterator[Any]:
+        if pa is not None and isinstance(self._block, pa.Table):
+            shapes = self._tensor_shapes()
+            cols = {name: self._block.column(name) for name in self._block.column_names}
+            simple = set(self._block.column_names) == {ITEM_COL} and not shapes
+            np_cols = {
+                name: _TensorArray.from_arrow(c, shapes[name]) if name in shapes else None
+                for name, c in cols.items()
+            }
+            for i in range(self._block.num_rows):
+                row = {}
+                for name, c in cols.items():
+                    if np_cols[name] is not None:
+                        row[name] = np_cols[name][i]
+                    else:
+                        row[name] = c[i].as_py()
+                yield row[ITEM_COL] if simple else row
+        else:
+            yield from self._block
+
+    def select_columns(self, cols: Sequence[str]) -> Block:
+        t = self.to_arrow()
+        return t.select(cols)
+
+    def sample_rows(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        total = self.num_rows()
+        idx = rng.choice(total, size=min(n, total), replace=False)
+        return self.take_indices(np.sort(idx))
+
+    def take_indices(self, idx) -> Block:
+        if pa is not None and isinstance(self._block, pa.Table):
+            return self._block.take(pa.array(np.asarray(idx, dtype=np.int64)))
+        return [self._block[int(i)] for i in idx]

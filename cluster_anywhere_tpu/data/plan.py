@@ -1,0 +1,105 @@
+"""Logical plan: a linear chain of operators over blocks (compact analogue of
+the reference's python/ray/data/_internal/logical/ LogicalPlan + optimizer).
+
+Map-like operators that execute with the same compute strategy are *fused*
+into a single remote task per block by the executor (the reference does this
+in its OperatorFusionRule); all-to-all operators are barriers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .datasource import Datasource
+
+
+class LogicalOp:
+    name: str = "op"
+
+
+@dataclass
+class Read(LogicalOp):
+    datasource: Datasource
+    parallelism: int = -1
+
+    @property
+    def name(self) -> str:
+        return f"Read{self.datasource.name}"
+
+
+@dataclass
+class MapLike(LogicalOp):
+    """map_batches / map / filter / flat_map / column ops.
+
+    fn is either a plain callable (task compute) or a class (actor compute,
+    instantiated `concurrency` times).
+    """
+
+    kind: str
+    fn: Any
+    fn_args: Tuple = ()
+    fn_kwargs: Dict[str, Any] = field(default_factory=dict)
+    fn_constructor_args: Tuple = ()
+    fn_constructor_kwargs: Dict[str, Any] = field(default_factory=dict)
+    batch_size: Optional[int] = None
+    batch_format: Optional[str] = "numpy"
+    concurrency: Optional[int] = None
+    num_cpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    is_actor: bool = False
+
+    @property
+    def name(self) -> str:
+        fn_name = getattr(self.fn, "__name__", type(self.fn).__name__)
+        return f"{self.kind}({fn_name})"
+
+
+@dataclass
+class AllToAll(LogicalOp):
+    kind: str  # repartition | random_shuffle | sort | aggregate
+    options: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.kind
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int
+
+    @property
+    def name(self) -> str:
+        return f"limit({self.n})"
+
+
+@dataclass
+class UnionOp(LogicalOp):
+    others: List["LogicalPlan"]
+    name = "union"
+
+
+@dataclass
+class ZipOp(LogicalOp):
+    other: "LogicalPlan"
+    name = "zip"
+
+
+@dataclass
+class InputData(LogicalOp):
+    """Already-materialized bundles (output of a previous execution)."""
+
+    bundles: List[Any]
+    name = "input"
+
+
+class LogicalPlan:
+    def __init__(self, ops: List[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "LogicalPlan":
+        return LogicalPlan(self.ops + [op])
+
+    def __repr__(self):
+        return " -> ".join(op.name for op in self.ops)
