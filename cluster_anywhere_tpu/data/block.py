@@ -228,10 +228,6 @@ class BlockAccessor:
         else:
             yield from self._block
 
-    def select_columns(self, cols: Sequence[str]) -> Block:
-        t = self.to_arrow()
-        return t.select(cols)
-
     def sample_rows(self, n: int, seed: Optional[int] = None) -> Block:
         rng = np.random.default_rng(seed)
         total = self.num_rows()

@@ -9,10 +9,7 @@ executor with backpressure (python/ray/data/_internal/execution/streaming_execut
 
 from __future__ import annotations
 
-import time
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
-
-import numpy as np
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 from ..core import api as ca
 from .block import Block, BlockAccessor, ITEM_COL
@@ -370,6 +367,19 @@ class Dataset:
         from .aggregate import Std
 
         return self.aggregate(Std(on, ddof=ddof))[f"std({on})"]
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        if not 0 <= fraction <= 1:
+            raise ValueError("fraction must be in [0, 1]")
+
+        def sample(batch):
+            from .block import BlockAccessor, build_block
+
+            acc = BlockAccessor.for_block(build_block(batch))
+            n = max(0, round(acc.num_rows() * fraction))
+            return BlockAccessor.for_block(acc.sample_rows(n, seed)).to_numpy_batch()
+
+        return self.map_batches(sample, batch_format="numpy")
 
     def unique(self, column: str) -> List[Any]:
         rows = self.groupby(column).count().take_all()

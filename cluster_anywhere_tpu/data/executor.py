@@ -388,6 +388,9 @@ class StreamingExecutor:
             t0 = time.monotonic()
             all_bundles = list(stream)
             bundles = [b for b in all_bundles if b.num_rows > 0] or all_bundles[:1]
+            if not bundles:  # upstream yielded nothing at all
+                self.stats.add(op.kind, time.monotonic() - t0, 0, 0)
+                return
             kind = op.kind
             if kind == "repartition":
                 out = self._repartition(bundles, op.options["num_blocks"])

@@ -86,7 +86,10 @@ class DataIterator:
             while acc.num_rows() >= max(buffer_size, batch_size):
                 idx = rng.permutation(acc.num_rows())
                 take, rest = idx[:batch_size], idx[batch_size:]
-                yield BlockAccessor.for_block(acc.take_indices(np.sort(take))).to_batch(
+                # keep the permuted order within the batch (sorting would undo
+                # the shuffle for time-ordered data); the remainder buffer can
+                # stay sorted for cheaper slicing
+                yield BlockAccessor.for_block(acc.take_indices(take)).to_batch(
                     batch_format
                 )
                 buf = acc.take_indices(np.sort(rest))
@@ -99,7 +102,7 @@ class DataIterator:
                 chunk = idx[start : start + batch_size]
                 if len(chunk) < batch_size and drop_last:
                     break
-                yield BlockAccessor.for_block(acc.take_indices(np.sort(chunk))).to_batch(
+                yield BlockAccessor.for_block(acc.take_indices(chunk)).to_batch(
                     batch_format
                 )
                 start += batch_size

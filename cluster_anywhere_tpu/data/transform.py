@@ -5,6 +5,7 @@ python/ray/data/_internal/planner/plan_udf_map_op.py batch/row adapters).
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Dict, Iterator, List
 
 import numpy as np
@@ -67,8 +68,12 @@ def apply_transform(spec: Dict[str, Any], block: Block) -> Iterator[Block]:
             out = fn(batch, *args, **kwargs)
             if out is None:
                 continue
-            if hasattr(out, "__iter__") and not isinstance(out, (dict, list, np.ndarray)):
-                for o in out:  # generator UDF
+            # generator UDFs yield multiple batches; anything else (dict,
+            # DataFrame, Table, ndarray, list of rows) is a single batch
+            if inspect.isgenerator(out) or (
+                hasattr(out, "__next__") and hasattr(out, "__iter__")
+            ):
+                for o in out:
                     yield build_block(o)
             else:
                 yield build_block(out)
