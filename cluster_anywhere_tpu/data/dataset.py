@@ -373,11 +373,27 @@ class Dataset:
             raise ValueError("fraction must be in [0, 1]")
 
         def sample(batch):
+            import zlib
+
+            import numpy as np
+
             from .block import BlockAccessor, build_block
 
             acc = BlockAccessor.for_block(build_block(batch))
             n = max(0, round(acc.num_rows() * fraction))
-            return BlockAccessor.for_block(acc.sample_rows(n, seed)).to_numpy_batch()
+            block_seed = seed
+            if seed is not None:
+                # derive a per-block seed from the data: a single seed would
+                # pick identical row positions in every equal-sized block
+                first = np.asarray(next(iter(batch.values()), np.array([])))
+                raw = (
+                    first.tobytes()[:4096]
+                    if first.dtype != object
+                    else str(first[:16]).encode()
+                )
+                token = zlib.crc32(raw)
+                block_seed = np.random.SeedSequence([seed, token]).generate_state(1)[0]
+            return BlockAccessor.for_block(acc.sample_rows(n, block_seed)).to_numpy_batch()
 
         return self.map_batches(sample, batch_format="numpy")
 

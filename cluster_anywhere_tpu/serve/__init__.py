@@ -1,0 +1,247 @@
+"""cluster_anywhere_tpu.serve: scalable model serving on the actor runtime
+(analogue of the reference's Ray Serve, python/ray/serve/).
+
+    from cluster_anywhere_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Model.bind())
+    assert handle.remote(21).result() == 42
+"""
+
+from __future__ import annotations
+
+import inspect
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ..core import api as ca
+from ..core.actor import get_actor, kill
+from .batching import batch
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .controller import CONTROLLER_NAME, ServeController, get_or_create_controller
+from .multiplex import get_multiplexed_model_id, multiplexed
+from .proxy import ProxyActor, Request
+from .replica import get_request_context
+from .router import DeploymentHandle, DeploymentResponse
+
+PROXY_NAME = "SERVE_PROXY"
+
+
+class Application:
+    """A bound deployment graph node (reference serve/_private/build_app.py)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, func_or_class, name: str, config: DeploymentConfig):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **kw) -> "Deployment":
+        import dataclasses
+
+        name = kw.pop("name", self.name)
+        cfg_kw = {}
+        for f in dataclasses.fields(DeploymentConfig):
+            if f.name in kw:
+                cfg_kw[f.name] = kw.pop(f.name)
+        if "ray_actor_options" in kw:  # reference-compat spelling
+            opts = kw.pop("ray_actor_options")
+            cfg_kw.setdefault("num_cpus", opts.get("num_cpus", self.config.num_cpus))
+        if kw:
+            raise TypeError(f"unknown deployment options: {sorted(kw)}")
+        cfg = dataclasses.replace(self.config, **cfg_kw)
+        return Deployment(self.func_or_class, name, cfg)
+
+
+def deployment(
+    _func_or_class: Optional[Any] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Union[int, str, None] = None,
+    max_ongoing_requests: int = 8,
+    user_config: Optional[Dict[str, Any]] = None,
+    autoscaling_config: Optional[Union[AutoscalingConfig, Dict[str, Any]]] = None,
+    num_cpus: float = 1.0,
+    num_tpus: float = 0.0,
+    resources: Optional[Dict[str, float]] = None,
+    health_check_period_s: float = 2.0,
+    graceful_shutdown_timeout_s: float = 5.0,
+    max_restarts: int = 3,
+):
+    """@serve.deployment decorator (reference serve/api.py deployment)."""
+
+    def deco(func_or_class):
+        if isinstance(autoscaling_config, dict):
+            asc = AutoscalingConfig(**autoscaling_config)
+        else:
+            asc = autoscaling_config
+        n_replicas = num_replicas
+        if n_replicas == "auto":
+            n_replicas = None
+        if n_replicas is None:
+            n_replicas = asc.min_replicas if asc else 1
+        cfg = DeploymentConfig(
+            num_replicas=n_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            autoscaling_config=asc,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources or {},
+            health_check_period_s=health_check_period_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            max_restarts=max_restarts,
+        )
+        return Deployment(
+            func_or_class,
+            name or getattr(func_or_class, "__name__", "deployment"),
+            cfg,
+        )
+
+    if _func_or_class is not None:
+        return deco(_func_or_class)
+    return deco
+
+
+def _collect_deployments(app: Application, out: Dict[str, Application]):
+    """DFS the bind graph; nested Applications in init args become handles."""
+    name = app.deployment.name
+    if name in out and out[name] is not app:
+        raise ValueError(f"duplicate deployment name {name!r} in application")
+    out[name] = app
+    for a in list(app.args) + list(app.kwargs.values()):
+        if isinstance(a, Application):
+            _collect_deployments(a, out)
+
+
+def _resolve_arg(a, app_name: str):
+    if isinstance(a, Application):
+        return {"__ca_serve_handle__": True, "app": app_name, "deployment": a.deployment.name}
+    return a
+
+
+def start(http_options: Optional[HTTPOptions] = None, **kw) -> None:
+    """Start the Serve system actors (controller + HTTP proxy)."""
+    get_or_create_controller()
+    opts = http_options or HTTPOptions(**kw)
+    try:
+        get_actor(PROXY_NAME)
+        return
+    except Exception:
+        pass
+    Proxy = ca.remote(ProxyActor).options(
+        name=PROXY_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=4
+    )
+    h = Proxy.remote(opts.host, opts.port)
+    ca.get(h.ready.remote(), timeout=30)
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: str = "/",
+    _blocking: bool = True,
+    wait_timeout_s: float = 60.0,
+) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress
+    (reference serve/api.py serve.run)."""
+    if not isinstance(app, Application):
+        raise TypeError("serve.run expects Deployment.bind(...)")
+    ctrl = get_or_create_controller()
+    graph: Dict[str, Application] = {}
+    _collect_deployments(app, graph)
+    specs: List[Dict[str, Any]] = []
+    for dname, a in graph.items():
+        args = tuple(_resolve_arg(x, name) for x in a.args)
+        kwargs = {k: _resolve_arg(v, name) for k, v in a.kwargs.items()}
+        specs.append(
+            {
+                "name": dname,
+                "config": pickle.dumps(a.deployment.config),
+                "payload": __import__("cloudpickle").dumps(
+                    (a.deployment.func_or_class, args, kwargs)
+                ),
+            }
+        )
+    ingress = app.deployment.name
+    ca.get(
+        ctrl.deploy_application.remote(name, route_prefix, ingress, specs), timeout=60
+    )
+    if _blocking:
+        ca.get(ctrl.wait_ready.remote(name, wait_timeout_s), timeout=wait_timeout_s + 10)
+    return DeploymentHandle(name, ingress)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    ctrl = get_or_create_controller()
+    info = ca.get(ctrl.get_app_route.remote(name))
+    if not info["ingress"]:
+        raise KeyError(f"no application named {name!r}")
+    return DeploymentHandle(name, info["ingress"])
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(app_name, deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    ctrl = get_or_create_controller()
+    return ca.get(ctrl.status.remote())
+
+def delete(name: str):
+    ctrl = get_or_create_controller()
+    ca.get(ctrl.delete_application.remote(name))
+
+
+def shutdown():
+    try:
+        ctrl = get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ca.get(ctrl.shutdown.remote(), timeout=30)
+    except Exception:
+        pass
+    for actor_name in (PROXY_NAME, CONTROLLER_NAME):
+        try:
+            kill(get_actor(actor_name))
+        except Exception:
+            pass
+
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "start",
+    "delete",
+    "shutdown",
+    "status",
+    "get_app_handle",
+    "get_deployment_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "DeploymentConfig",
+    "AutoscalingConfig",
+    "HTTPOptions",
+    "Request",
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
+    "get_request_context",
+]

@@ -1,0 +1,70 @@
+"""Serve configuration schemas (analogue of python/ray/serve/config.py and
+serve/schema.py — DeploymentConfig, AutoscalingConfig, HTTPOptions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 1.0
+    downscale_delay_s: float = 5.0
+    metrics_interval_s: float = 0.5
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError("need 0 <= min_replicas <= max_replicas, max >= 1")
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    user_config: Optional[Dict[str, Any]] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 2.0
+    graceful_shutdown_timeout_s: float = 5.0
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_restarts: int = 3
+
+    def actor_options(self) -> Dict[str, Any]:
+        opts: Dict[str, Any] = {
+            "num_cpus": self.num_cpus,
+            "max_concurrency": max(2, self.max_ongoing_requests + 2),
+        }
+        if self.num_tpus:
+            opts["num_tpus"] = self.num_tpus
+        if self.resources:
+            opts["resources"] = dict(self.resources)
+        return opts
+
+
+@dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+@dataclass
+class ReplicaInfo:
+    """What routers need to reach one replica."""
+
+    replica_id: str
+    actor_name: str
+    max_ongoing_requests: int
+
+
+@dataclass
+class DeploymentStatus:
+    name: str
+    status: str  # UPDATING | HEALTHY | UNHEALTHY
+    replica_states: Dict[str, int] = field(default_factory=dict)
+    message: str = ""

@@ -1,0 +1,350 @@
+"""ServeController: the reconciliation control loop (analogue of
+python/ray/serve/_private/controller.py ServeController +
+deployment_state.py DeploymentStateManager).
+
+A detached named actor. Holds desired state (applications -> deployments ->
+target replica counts), reconciles actual replica actors toward it on a
+background thread, runs autoscaling from replica queue-length metrics,
+replaces dead replicas, and bumps a version counter per deployment that
+routers poll (the long-poll analogue of serve/_private/long_poll.py).
+
+Threading: all methods are sync and run on the actor's executor pool
+(max_concurrency > 1); the reconcile loop is a dedicated thread. Blocking
+`ca.get` is safe on these threads (the process's IO loop is separate); it
+would deadlock on the loop itself, so nothing here is async.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, List
+
+from ..core import api as ca
+from ..core.actor import get_actor, kill
+from .config import DeploymentConfig, DeploymentStatus
+from .replica import Replica
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentState:
+    def __init__(self, app: str, name: str, deployment_def, init_args, init_kwargs, cfg: DeploymentConfig):
+        self.app = app
+        self.name = name
+        self.deployment_def = deployment_def
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.cfg = cfg
+        self.target = (
+            cfg.autoscaling_config.min_replicas
+            if cfg.autoscaling_config
+            else cfg.num_replicas
+        )
+        self.replicas: Dict[str, Any] = {}  # replica_id -> actor handle
+        self.version = 0
+        self.replica_counter = 0
+        self.status = "UPDATING"
+        self.message = ""
+        self.payload_digest: str = ""
+        self._last_scale_t = 0.0
+
+    def key(self) -> str:
+        return f"{self.app}/{self.name}"
+
+
+class ServeController:
+    def __init__(self):
+        self.apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self.route_prefixes: Dict[str, str] = {}  # app -> route_prefix
+        self.ingress: Dict[str, str] = {}  # app -> ingress deployment name
+        self._lock = threading.RLock()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ deploy API
+    def deploy_application(
+        self,
+        app_name: str,
+        route_prefix: str,
+        ingress: str,
+        deployments: List[Dict[str, Any]],
+    ) -> str:
+        import pickle
+
+        with self._lock:
+            app = self.apps.setdefault(app_name, {})
+            wanted = set()
+            for spec in deployments:
+                name = spec["name"]
+                wanted.add(name)
+                cfg: DeploymentConfig = pickle.loads(spec["config"])
+                d_def, init_args, init_kwargs = pickle.loads(spec["payload"])
+                old = app.get(name)
+                st = _DeploymentState(app_name, name, d_def, init_args, init_kwargs, cfg)
+                st.payload_digest = __import__("hashlib").sha256(spec["payload"]).hexdigest()
+                if old is not None:
+                    st.replica_counter = old.replica_counter
+                    st.version = old.version + 1
+                    if st.payload_digest == getattr(old, "payload_digest", None):
+                        # same code: keep live replicas, push config deltas
+                        st.replicas = old.replicas
+                        if cfg.user_config is not None and old.cfg.user_config != cfg.user_config:
+                            for h in st.replicas.values():
+                                try:
+                                    h.reconfigure.remote(cfg.user_config)
+                                except Exception:
+                                    pass
+                    else:
+                        # code/init-args changed: old replicas must not keep
+                        # serving stale code — replace them
+                        self._teardown_deployment(old)
+                app[name] = st
+            for name in list(app):
+                if name not in wanted:
+                    self._teardown_deployment(app[name])
+                    del app[name]
+            self.route_prefixes[app_name] = route_prefix
+            self.ingress[app_name] = ingress
+        return "ok"
+
+    def wait_ready(self, app_name: str, timeout_s: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                app = dict(self.apps.get(app_name, {}))
+                statuses = {n: (st.status, st.message) for n, st in app.items()}
+            if statuses and all(s == "HEALTHY" for s, _ in statuses.values()):
+                return "ok"
+            for n, (s, msg) in statuses.items():
+                if s == "UNHEALTHY":
+                    raise RuntimeError(f"deployment {app_name}/{n} unhealthy: {msg}")
+            time.sleep(0.05)
+        raise TimeoutError(f"app {app_name!r} not ready after {timeout_s}s")
+
+    def delete_application(self, app_name: str) -> str:
+        with self._lock:
+            app = self.apps.pop(app_name, None)
+            self.route_prefixes.pop(app_name, None)
+            self.ingress.pop(app_name, None)
+        if app:
+            for st in app.values():
+                self._teardown_deployment(st)
+        return "ok"
+
+    def shutdown(self) -> str:
+        with self._lock:
+            apps, self.apps = self.apps, {}
+            self._stopped = True
+        for app in apps.values():
+            for st in app.values():
+                self._teardown_deployment(st)
+        return "ok"
+
+    def _teardown_deployment(self, st: _DeploymentState):
+        for h in st.replicas.values():
+            try:
+                kill(h)
+            except Exception:
+                pass
+        st.replicas.clear()
+
+    # ----------------------------------------------------------- router API
+    def get_deployment_info(self, app: str, deployment: str) -> Dict[str, Any]:
+        with self._lock:
+            st = self._state(app, deployment)
+            return {
+                "version": st.version,
+                "max_ongoing_requests": st.cfg.max_ongoing_requests,
+                "replicas": [
+                    {"replica_id": rid, "actor_name": self._replica_actor_name(st, rid)}
+                    for rid in st.replicas
+                ],
+            }
+
+    def poll_deployment_info(
+        self, app: str, deployment: str, known_version: int, timeout_s: float = 10.0
+    ) -> Dict[str, Any]:
+        """Long-poll: returns when version != known_version or timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                st = self._state(app, deployment)
+                if st.version != known_version:
+                    break
+            time.sleep(0.05)
+        return self.get_deployment_info(app, deployment)
+
+    def get_app_route(self, app: str) -> Dict[str, str]:
+        with self._lock:
+            return {
+                "route_prefix": self.route_prefixes.get(app, "/"),
+                "ingress": self.ingress.get(app, ""),
+            }
+
+    def list_routes(self) -> Dict[str, Dict[str, str]]:
+        with self._lock:
+            return {
+                app: {"route_prefix": self.route_prefixes.get(app, "/"), "ingress": ing}
+                for app, ing in self.ingress.items()
+            }
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for app_name, app in self.apps.items():
+                out[app_name] = {
+                    name: DeploymentStatus(
+                        name=name,
+                        status=st.status,
+                        replica_states={"RUNNING": len(st.replicas)},
+                        message=st.message,
+                    ).__dict__
+                    for name, st in app.items()
+                }
+            return out
+
+    def ping(self) -> str:
+        return "pong"
+
+    def _state(self, app: str, deployment: str) -> _DeploymentState:
+        try:
+            return self.apps[app][deployment]
+        except KeyError:
+            raise KeyError(f"unknown deployment {app}/{deployment}")
+
+    # ------------------------------------------------------------- reconcile
+    def _replica_actor_name(self, st: _DeploymentState, rid: str) -> str:
+        return f"SERVE_REPLICA::{st.app}::{st.name}::{rid}"
+
+    def _reconcile_loop(self):
+        while not self._stopped:
+            try:
+                with self._lock:
+                    states = [
+                        st for app in self.apps.values() for st in app.values()
+                    ]
+                for st in states:
+                    self._reconcile_deployment(st)
+                    self._autoscale(st)
+            except Exception:
+                traceback.print_exc()
+            time.sleep(0.1)
+
+    def _bump_version(self, st: _DeploymentState):
+        with self._lock:
+            st.version += 1
+
+    def _reconcile_deployment(self, st: _DeploymentState):
+        # replace dead replicas
+        dead = []
+        for rid, h in list(st.replicas.items()):
+            try:
+                ca.get(h.check_health.remote(), timeout=30)
+            except Exception:
+                dead.append(rid)
+        for rid in dead:
+            try:
+                kill(st.replicas[rid])
+            except Exception:
+                pass
+            with self._lock:
+                del st.replicas[rid]
+        if dead:
+            self._bump_version(st)
+        changed = False
+        while len(st.replicas) < st.target and not self._stopped:
+            with self._lock:
+                rid = f"r{st.replica_counter}"
+                st.replica_counter += 1
+            Rep = ca.remote(Replica).options(
+                name=self._replica_actor_name(st, rid),
+                max_restarts=st.cfg.max_restarts,
+                **st.cfg.actor_options(),
+            )
+            try:
+                h = Rep.remote(
+                    st.deployment_def,
+                    st.init_args,
+                    st.init_kwargs,
+                    st.cfg.user_config,
+                    rid,
+                )
+                ca.get(h.check_health.remote(), timeout=60)
+            except Exception as e:
+                st.status = "UNHEALTHY"
+                st.message = f"replica start failed: {e!r}"
+                return
+            with self._lock:
+                st.replicas[rid] = h
+            changed = True
+        while len(st.replicas) > st.target:
+            with self._lock:
+                rid = next(iter(st.replicas))
+                h = st.replicas.pop(rid)
+            try:
+                ca.get(h.prepare_shutdown.remote(), timeout=st.cfg.graceful_shutdown_timeout_s)
+            except Exception:
+                pass
+            try:
+                kill(h)
+            except Exception:
+                pass
+            changed = True
+        if changed:
+            self._bump_version(st)
+        st.status = "HEALTHY" if len(st.replicas) == st.target else "UPDATING"
+        if st.status == "HEALTHY":
+            st.message = ""
+
+    def _autoscale(self, st: _DeploymentState):
+        cfg = st.cfg.autoscaling_config
+        if cfg is None or not st.replicas:
+            return
+        lens = []
+        for h in list(st.replicas.values()):
+            try:
+                lens.append(ca.get(h.get_queue_len.remote(), timeout=5))
+            except Exception:
+                pass
+        if not lens:
+            return
+        avg = sum(lens) / len(lens)
+        desired = max(
+            cfg.min_replicas,
+            min(
+                cfg.max_replicas,
+                -(-int(len(lens) * avg) // max(int(cfg.target_ongoing_requests), 1))
+                if avg > 0
+                else cfg.min_replicas,
+            ),
+        )
+        now = time.monotonic()
+        if desired > st.target and now - st._last_scale_t > cfg.upscale_delay_s:
+            st.target = desired
+            st._last_scale_t = now
+        elif desired < st.target and now - st._last_scale_t > cfg.downscale_delay_s:
+            st.target = max(desired, cfg.min_replicas)
+            st._last_scale_t = now
+
+
+def get_or_create_controller():
+    """Get the cluster's controller actor, creating it if needed."""
+    try:
+        return get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    Controller = ca.remote(ServeController).options(
+        name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=16
+    )
+    try:
+        h = Controller.remote()
+        ca.get(h.ping.remote(), timeout=30)
+        return h
+    except Exception:
+        # lost the creation race: someone else made it
+        return get_actor(CONTROLLER_NAME)

@@ -1,0 +1,194 @@
+"""HTTP proxy actor (analogue of python/ray/serve/_private/proxy.py
+HTTPProxy/ProxyActor): a minimal asyncio HTTP/1.1 server that routes requests
+by route prefix to application ingress deployments.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class Request:
+    """What ingress callables receive for HTTP requests (a compact stand-in
+    for the reference's starlette.requests.Request)."""
+
+    def __init__(self, method: str, path: str, query_params: Dict[str, str], headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query_params
+        self.headers = headers
+        self._body = body
+
+    def body(self) -> bytes:
+        return self._body
+
+    def json(self) -> Any:
+        return json.loads(self._body or b"null")
+
+    def text(self) -> str:
+        return self._body.decode("utf-8", "replace")
+
+
+class ProxyActor:
+    def __init__(self, host: str, port: int):
+        from ..core.worker import global_worker
+
+        self.host = host
+        self.port = port
+        self._routes: Dict[str, Any] = {}  # route_prefix -> DeploymentHandle
+        self._routes_lock = threading.Lock()
+        self._loop = global_worker().loop
+        self._server = None
+        self._started = threading.Event()
+        self._start_error: Optional[str] = None
+        fut = asyncio.run_coroutine_threadsafe(self._start_server(), self._loop)
+        fut.result(timeout=30)
+        self._refresher = threading.Thread(
+            target=self._refresh_routes_loop, daemon=True, name="proxy-routes"
+        )
+        self._refresher.start()
+
+    async def _start_server(self):
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+
+    def ready(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------ route sync
+    def _refresh_routes_loop(self):
+        from ..core import api as ca
+        from ..core.actor import get_actor
+        from .controller import CONTROLLER_NAME
+        from .router import DeploymentHandle
+
+        while True:
+            try:
+                ctrl = get_actor(CONTROLLER_NAME)
+                routes = ca.get(ctrl.list_routes.remote(), timeout=10)
+                new = {}
+                for app, info in routes.items():
+                    if info["ingress"]:
+                        new[info["route_prefix"]] = DeploymentHandle(app, info["ingress"])
+                with self._routes_lock:
+                    # keep existing handles (their routers have warm caches)
+                    for prefix, h in new.items():
+                        if prefix not in self._routes or (
+                            self._routes[prefix].app != h.app
+                            or self._routes[prefix].deployment != h.deployment
+                        ):
+                            self._routes[prefix] = h
+                    for prefix in list(self._routes):
+                        if prefix not in new:
+                            del self._routes[prefix]
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+    def _match(self, path: str):
+        with self._routes_lock:
+            best = None
+            for prefix, handle in self._routes.items():
+                norm = prefix.rstrip("/") or ""
+                if path == norm or path.startswith(norm + "/") or prefix == "/":
+                    if best is None or len(prefix) > len(best[0]):
+                        best = (prefix, handle)
+            return best
+
+    # ---------------------------------------------------------- http server
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                asyncio.get_running_loop().create_task(self._dispatch(req, writer))
+                # serialize responses per connection: await via queue-less
+                # approach — handle one request at a time per connection
+                break
+        except Exception:
+            pass
+
+    async def _read_request(self, reader) -> Optional[Request]:
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _ = line.decode("latin1").split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        n = int(headers.get("content-length", 0) or 0)
+        if n:
+            body = await reader.readexactly(n)
+        parsed = urlparse(target)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        return Request(method.upper(), unquote(parsed.path), query, headers, body)
+
+    async def _dispatch(self, req: Request, writer: asyncio.StreamWriter):
+        try:
+            match = self._match(req.path)
+            if match is None:
+                await self._respond(writer, 404, {"error": f"no route for {req.path}"})
+                return
+            _, handle = match
+            loop = asyncio.get_running_loop()
+            # handle.remote() blocks briefly (routing) and result() blocks
+            # until done — run both off the event loop
+            result = await loop.run_in_executor(
+                None, lambda: handle.remote(req).result(timeout_s=60)
+            )
+            await self._respond(writer, 200, result)
+        except Exception as e:
+            traceback.print_exc()
+            await self._respond(writer, 500, {"error": repr(e)})
+
+    async def _respond(self, writer, code: int, payload: Any):
+        try:
+            if isinstance(payload, bytes):
+                body, ctype = payload, "application/octet-stream"
+            elif isinstance(payload, str):
+                body, ctype = payload.encode(), "text/plain; charset=utf-8"
+            else:
+                body, ctype = json.dumps(_json_default(payload)).encode(), "application/json"
+            status = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}.get(
+                code, "OK"
+            )
+            writer.write(
+                f"HTTP/1.1 {code} {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+            writer.close()
+        except Exception:
+            pass
+
+
+def _json_default(obj):
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {k: _json_default(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_default(v) for v in obj]
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return obj

@@ -1,0 +1,260 @@
+"""DeploymentHandle + Router: the request path (analogue of
+python/ray/serve/handle.py DeploymentHandle -> serve/_private/router.py
+Router -> replica_scheduler/pow_2_scheduler.py PowerOfTwoChoicesReplicaScheduler).
+
+The router keeps a local in-flight count per replica and picks the less-loaded
+of two random replicas (power-of-two-choices with locally-observed queue
+lengths), refreshing replica membership from the controller when its cached
+version goes stale.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..core import api as ca
+from ..core.actor import get_actor
+from .controller import CONTROLLER_NAME
+
+_REFRESH_PERIOD_S = 1.0
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference serve/handle.py
+    DeploymentResponse). Wraps a future-of-ObjectRef: routing happens on the
+    router's dispatch thread, so .remote() never blocks — critical inside
+    async replica code, where blocking the event loop would deadlock the
+    process's IO."""
+
+    def __init__(self, ref_future):
+        self._ref_future = ref_future
+
+    def result(self, timeout_s: Optional[float] = None):
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        ref = self._ref_future.result(timeout_s)
+        remain = None if deadline is None else max(0.0, deadline - time.monotonic())
+        return ca.get(ref, timeout=remain)
+
+    def _to_object_ref(self, timeout_s: Optional[float] = 30.0):
+        return self._ref_future.result(timeout_s)
+
+    def __await__(self):
+        import asyncio
+
+        async def _wait():
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, self.result)
+
+        return _wait().__await__()
+
+
+class Router:
+    def __init__(self, app: str, deployment: str):
+        import concurrent.futures
+
+        self.app = app
+        self.deployment = deployment
+        # all blocking work (controller RPCs, backpressure waits) happens on
+        # this thread so handle.remote() stays non-blocking for callers
+        self._dispatch = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-router"
+        )
+        self._lock = threading.Lock()
+        self._replicas: List[Dict[str, str]] = []
+        self._handles: Dict[str, Any] = {}  # replica_id -> actor handle
+        self._inflight: Dict[str, int] = {}
+        self._version = -1
+        self._max_ongoing = 8
+        self._last_refresh = 0.0
+        self._watched: List = []  # [(replica_id, ref)]
+        self._watch_cv = threading.Condition(self._lock)
+        self._watcher: Optional[threading.Thread] = None
+
+    def _controller(self):
+        return get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._replicas and now - self._last_refresh < _REFRESH_PERIOD_S:
+                return
+            self._last_refresh = now
+        info = ca.get(
+            self._controller().get_deployment_info.remote(self.app, self.deployment)
+        )
+        with self._lock:
+            if info["version"] == self._version and self._replicas:
+                return
+            self._version = info["version"]
+            self._max_ongoing = info.get("max_ongoing_requests", 8)
+            self._replicas = info["replicas"]
+            live = {r["replica_id"] for r in self._replicas}
+            self._handles = {k: v for k, v in self._handles.items() if k in live}
+            self._inflight = {
+                k: self._inflight.get(k, 0) for k in live
+            }
+
+    def _handle_for(self, rid: str, actor_name: str):
+        h = self._handles.get(rid)
+        if h is None:
+            h = get_actor(actor_name)
+            self._handles[rid] = h
+        return h
+
+    def _pick(self) -> Optional[Dict[str, str]]:
+        with self._lock:
+            reps = list(self._replicas)
+            if not reps:
+                return None
+            if len(reps) == 1:
+                return reps[0]
+            a, b = random.sample(reps, 2)
+            ia = self._inflight.get(a["replica_id"], 0)
+            ib = self._inflight.get(b["replica_id"], 0)
+            return a if ia <= ib else b
+
+    def route(self, meta: Dict[str, Any], args, kwargs):
+        """Blocking routing + submission; runs on the dispatch thread only.
+        Returns the ObjectRef of the replica call."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            pick = self._pick()
+            if pick is not None:
+                rid = pick["replica_id"]
+                # backpressure: spin briefly if every replica is saturated in
+                # our local view (reference: replica queue-len gating)
+                if self._inflight.get(rid, 0) < self._max_ongoing:
+                    break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no available replica for {self.app}/{self.deployment}"
+                )
+            time.sleep(0.01 if pick is None else 0.001)
+            self._refresh(force=pick is None)
+        h = self._handle_for(rid, pick["actor_name"])
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        try:
+            ref = h.handle_request.remote(meta, *args, **kwargs)
+        except Exception:
+            with self._lock:
+                self._inflight[rid] -= 1
+            raise
+        self._watch_completion(rid, ref)
+        return ref
+
+    def _watch_completion(self, rid: str, ref):
+        """One watcher thread per router drains completions in batches (a
+        thread per request would be far too heavy for the request path)."""
+        with self._watch_cv:
+            self._watched.append((rid, ref))
+            if self._watcher is None:
+                self._watcher = threading.Thread(
+                    target=self._watch_loop, daemon=True, name="serve-router-watch"
+                )
+                self._watcher.start()
+            self._watch_cv.notify()
+
+    def _watch_loop(self):
+        while True:
+            with self._watch_cv:
+                while not self._watched:
+                    self._watch_cv.wait()
+                batch = list(self._watched)
+            refs = [ref for _, ref in batch]
+            ready, _ = ca.wait(refs, num_returns=len(refs), timeout=0.05)
+            if not ready:
+                continue
+            done = set(id(r) for r in ready)
+            with self._watch_cv:
+                still = []
+                for rid, ref in self._watched:
+                    if id(ref) in done:
+                        if rid in self._inflight:
+                            self._inflight[rid] -= 1
+                    else:
+                        still.append((rid, ref))
+                self._watched = still
+
+
+_router_cache: Dict[tuple, Router] = {}
+_router_cache_lock = threading.Lock()
+
+
+def _shared_router(app: str, deployment: str) -> Router:
+    """One router (and dispatch thread) per deployment per process — handle
+    objects are created freely (handle.method.remote()), routers are not."""
+    key = (app, deployment)
+    r = _router_cache.get(key)
+    if r is None:
+        with _router_cache_lock:
+            r = _router_cache.get(key)
+            if r is None:
+                r = Router(app, deployment)
+                _router_cache[key] = r
+    return r
+
+
+class DeploymentHandle:
+    """Serializable handle to a deployment; each process lazily builds its own
+    Router on first use."""
+
+    def __init__(self, app: str, deployment: str, method: str = "__call__", multiplexed_model_id: str = ""):
+        self.app = app
+        self.deployment = deployment
+        self._method = method
+        self._multiplexed_model_id = multiplexed_model_id
+        self._router: Optional[Router] = None
+
+    # serialization: drop the router; the receiving process builds a new one
+    def __getstate__(self):
+        return {
+            "app": self.app,
+            "deployment": self.deployment,
+            "_method": self._method,
+            "_multiplexed_model_id": self._multiplexed_model_id,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._router = None
+
+    def options(
+        self, *, method_name: Optional[str] = None, multiplexed_model_id: Optional[str] = None
+    ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.app,
+            self.deployment,
+            method_name or self._method,
+            multiplexed_model_id
+            if multiplexed_model_id is not None
+            else self._multiplexed_model_id,
+        )
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_") or name in ("app", "deployment"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.app, self.deployment, name, self._multiplexed_model_id)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if self._router is None:
+            self._router = _shared_router(self.app, self.deployment)
+        meta = {
+            "request_id": uuid.uuid4().hex,
+            "method": self._method,
+            "multiplexed_model_id": self._multiplexed_model_id,
+        }
+        fut = self._router._dispatch.submit(self._router.route, meta, args, kwargs)
+        return DeploymentResponse(fut)
+
+    def to_spec(self) -> Dict[str, str]:
+        return {
+            "__ca_serve_handle__": True,
+            "app": self.app,
+            "deployment": self.deployment,
+        }

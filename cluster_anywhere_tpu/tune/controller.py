@@ -67,6 +67,11 @@ class TuneController:
         self._trial_counter = len(self.trials)
         self._searcher_exhausted = False
         os.makedirs(experiment_dir, exist_ok=True)
+        if param_space and restored_trials is None:
+            import cloudpickle
+
+            with open(os.path.join(experiment_dir, "search_space.pkl"), "wb") as f:
+                cloudpickle.dump(param_space, f)
 
     # ------------------------------------------------------------------ loop
     def run(self) -> List[Trial]:
@@ -251,6 +256,8 @@ class TuneController:
             "experiment_name": self.experiment_name,
             "metric": self.metric,
             "mode": self.mode,
+            "num_samples": getattr(self.searcher, "num_samples", None),
+            "seed": getattr(self.searcher, "seed", None),
             "trials": [t.to_json() for t in self.trials],
         }
         path = os.path.join(self.experiment_dir, _STATE_FILE)

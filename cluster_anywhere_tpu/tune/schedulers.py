@@ -50,13 +50,30 @@ class AsyncHyperBandScheduler(TrialScheduler):
         self.grace = grace_period
         self.rf = reduction_factor
         self.max_t = max_t
-        # rung value -> list of recorded metric values
-        self.rungs: Dict[float, List[float]] = defaultdict(list)
-        self._rung_levels = []
-        t = grace_period
-        while t < max_t:
-            self._rung_levels.append(t)
-            t = int(np.ceil(t * reduction_factor))
+        # brackets start their rung ladders at grace * rf^b (late rungs stop
+        # less aggressively — the standard late-bloomer defense); trials are
+        # assigned round-robin
+        self.num_brackets = max(1, brackets)
+        # (bracket, rung value) -> recorded metric values
+        self.rungs: Dict[tuple, List[float]] = defaultdict(list)
+        self._bracket_levels: List[List[int]] = []
+        for b in range(self.num_brackets):
+            levels = []
+            t = int(np.ceil(grace_period * reduction_factor**b))
+            while t < max_t:
+                levels.append(t)
+                t = int(np.ceil(t * reduction_factor))
+            self._bracket_levels.append(levels)
+        self._assign_counter = 0
+        self._trial_bracket: Dict[str, int] = {}
+
+    def _bracket_of(self, trial) -> int:
+        b = self._trial_bracket.get(trial.trial_id)
+        if b is None:
+            b = self._assign_counter % self.num_brackets
+            self._assign_counter += 1
+            self._trial_bracket[trial.trial_id] = b
+        return b
 
     def on_trial_result(self, trial, result) -> str:
         t = result.get(self.time_attr)
@@ -65,12 +82,13 @@ class AsyncHyperBandScheduler(TrialScheduler):
             return CONTINUE
         if t >= self.max_t:
             return STOP
+        bracket = self._bracket_of(trial)
         decision = CONTINUE
-        for rung in self._rung_levels:
+        for rung in self._bracket_levels[bracket]:
             if t < rung or rung in trial.rungs_recorded:
                 continue
             trial.rungs_recorded.add(rung)
-            recorded = self.rungs[rung]
+            recorded = self.rungs[(bracket, rung)]
             sign = 1.0 if self.mode == "max" else -1.0
             recorded.append(sign * float(v))
             if len(recorded) >= self.rf:

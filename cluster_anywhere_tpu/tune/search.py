@@ -40,6 +40,7 @@ class BasicVariantGenerator(Searcher):
 
     def __init__(self, num_samples: int = 1, seed: Optional[int] = None):
         self.num_samples = num_samples
+        self.seed = seed  # persisted so restore() replays the same variants
         self.rng = np.random.default_rng(seed)
         self._variants: Optional[List[Dict[str, Any]]] = None
         self._i = 0

@@ -40,6 +40,7 @@ class Trial:
             "config": _jsonable(self.config),
             "status": self.status,
             "last_result": _jsonable(self.last_result),
+            "metrics_history": _jsonable(self.metrics_history),
             "error": self.error,
             "latest_checkpoint_path": self.latest_checkpoint_path,
             "checkpoint_paths": self.checkpoint_paths,
@@ -51,6 +52,7 @@ class Trial:
         t = cls(d["trial_id"], d["config"], experiment_dir)
         t.status = d["status"]
         t.last_result = d.get("last_result")
+        t.metrics_history = d.get("metrics_history") or []
         t.error = d.get("error")
         t.latest_checkpoint_path = d.get("latest_checkpoint_path")
         t.checkpoint_paths = d.get("checkpoint_paths", [])

@@ -200,15 +200,36 @@ class Tuner:
                 t.status = "PENDING"
                 t.latest_checkpoint_path = None
             trials.append(t)
+        # reconstruct the searcher so not-yet-suggested samples still run:
+        # the variant sequence is deterministic given (space, seed), so
+        # fast-forwarding past len(trials) yields exactly the remainder
+        param_space: Dict[str, Any] = {}
+        search_alg = None
+        space_file = os.path.join(path, "search_space.pkl")
+        if os.path.exists(space_file) and state.get("num_samples"):
+            import cloudpickle
+
+            from .search import BasicVariantGenerator
+
+            with open(space_file, "rb") as f:
+                param_space = cloudpickle.load(f)
+            bv = BasicVariantGenerator(
+                num_samples=state["num_samples"], seed=state.get("seed")
+            )
+            bv.set_search_properties(state.get("metric"), state.get("mode", "max"), param_space)
+            bv._expand()
+            bv._i = min(len(trials), len(bv._variants))
+            search_alg = bv
         tc = TuneConfig(
             metric=state.get("metric"),
             mode=state.get("mode", "max"),
             num_samples=0,
+            search_alg=search_alg,
         )
         rc = RunConfig(name=state.get("experiment_name"))
         return cls(
             trainable,
-            param_space={},
+            param_space=param_space,
             tune_config=tc,
             run_config=rc,
             _restored_trials=trials,

@@ -278,3 +278,36 @@ def test_with_resources_and_parameters(tmp_path):
         run_config=tune.RunConfig(name="res", storage_path=str(tmp_path)),
     ).fit()
     assert grid.get_best_result().metrics["n"] == 1002
+
+
+def test_restore_runs_remaining_samples(tmp_path):
+    # regression: restore of an interrupted experiment must run the samples
+    # the searcher never suggested, not just re-run persisted trials
+    def trainable(config):
+        tune.report({"v": config["x"]})
+
+    from cluster_anywhere_tpu.tune.controller import TuneController
+    from cluster_anywhere_tpu.tune.search import BasicVariantGenerator
+
+    exp_dir = str(tmp_path / "partial")
+    # simulate an interrupted run: controller creates state for only 2 of 5
+    bv = BasicVariantGenerator(num_samples=5, seed=3)
+    ctrl = TuneController(
+        trainable, {"x": tune.uniform(0, 1)},
+        metric="v", mode="max", search_alg=bv, max_concurrent_trials=1,
+        experiment_dir=exp_dir, experiment_name="partial",
+    )
+    # run only until 2 trials complete, then abandon
+    ctrl._maybe_start_trials()
+    while sum(1 for t in ctrl.trials if t.status == "TERMINATED") < 2:
+        ctrl._poll_running([t for t in ctrl.trials if t.status == "RUNNING"])
+        ctrl._maybe_start_trials()
+        time.sleep(0.02)
+    # drop trials that went beyond 2 and persist
+    ctrl.trials = ctrl.trials[:2]
+    ctrl.save_state()
+
+    restored = tune.Tuner.restore(exp_dir, trainable)
+    grid = restored.fit()
+    assert len(grid) == 5  # 2 persisted + 3 remaining samples
+    assert grid.num_errors == 0
