@@ -16,6 +16,7 @@ from .core import errors as exceptions
 from .core.actor import ActorHandle, exit_actor, get_actor, kill
 from .core.api import (
     available_resources,
+    timeline,
     cluster_resources,
     cluster_stats,
     get,
@@ -70,6 +71,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "cluster_stats",
+    "timeline",
     "placement_group",
     "remove_placement_group",
     "placement_group_table",

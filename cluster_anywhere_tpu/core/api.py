@@ -207,5 +207,8 @@ def cluster_stats() -> Dict[str, Any]:
     return global_worker().head_call("stats")["stats"]
 
 
-def timeline() -> List[dict]:
-    return []  # populated by the task-event milestone
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace events of task executions (see util.state.timeline)."""
+    from ..util.state import timeline as _timeline
+
+    return _timeline(filename)

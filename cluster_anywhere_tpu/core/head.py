@@ -158,6 +158,22 @@ class Head:
         }
         self._shutdown = asyncio.Event()
         self._driver_clients: set = set()
+        # observability: task-event ring buffer (GcsTaskManager analogue) and
+        # aggregated user metrics (MetricsAgent analogue)
+        self.task_events: deque = deque(maxlen=50_000)
+        self.metrics: Dict[str, dict] = {}  # name -> {type, desc, data{tags_key: ...}}
+        # structured lifecycle event log (util/event.h analogue): JSONL file
+        self._event_log = open(os.path.join(session_dir, "events.jsonl"), "a", buffering=1)
+
+    def _log_event(self, kind: str, **fields):
+        import json as _json
+
+        try:
+            self._event_log.write(
+                _json.dumps({"ts": time.time(), "event": kind, **fields}) + "\n"
+            )
+        except Exception:
+            pass
 
     # ---------------------------------------------------------------- utils
     def _pub(self, channel: str, data: dict):
@@ -386,6 +402,7 @@ class Head:
             )
             a.state = "alive"
             self.stats["actors_created"] += 1
+            self._log_event("actor_alive", actor_id=a.actor_id, worker_id=a.worker_id)
         except Exception as e:
             a.state = "dead"
             a.death_cause = f"actor __init__ failed: {e!r}"
@@ -406,6 +423,7 @@ class Head:
             return
         prev_state = rec.state
         rec.state = "dead"
+        self._log_event("worker_died", worker_id=rec.worker_id, prev_state=prev_state)
         fut = self._register_waiters.pop(rec.worker_id, None)
         if fut is not None and not fut.done():
             fut.set_result(False)
@@ -452,12 +470,14 @@ class Head:
                     a.state = "restarting"
                     a.addr = None
                     self.stats["actor_restarts"] += 1
+                    self._log_event("actor_restarting", actor_id=a.actor_id, attempt=a.restarts_used)
                     self._pub("actors", self._actor_info(a))
                     await asyncio.sleep(self.config.actor_restart_backoff_s)
                     await self._place_actor(a)
                 else:
                     a.state = "dead"
                     a.death_cause = a.death_cause or "actor worker died"
+                    self._log_event("actor_dead", actor_id=a.actor_id, cause=a.death_cause)
                     self._drop_actor_name(a)
                     self._pub("actors", self._actor_info(a))
         self._service_queue()
@@ -739,6 +759,7 @@ class Head:
         self.pgs[msg["pg_id"]] = PGRec(
             pg_id=msg["pg_id"], bundles=bundles, strategy=msg.get("strategy", "PACK")
         )
+        self._log_event("pg_created", pg_id=msg["pg_id"], bundles=len(bundles))
         reply()
 
     async def _h_remove_pg(self, state, msg, reply, reply_err):
@@ -809,6 +830,69 @@ class Head:
                 for w in self.workers.values()
             ]
         )
+
+    async def _h_task_events(self, state, msg, reply, reply_err):
+        self.task_events.extend(msg.get("events") or [])
+
+    async def _h_list_task_events(self, state, msg, reply, reply_err):
+        events = list(self.task_events)
+        name = msg.get("name")
+        if name:
+            events = [e for e in events if e.get("name") == name]
+        st = msg.get("state")
+        if st:
+            events = [e for e in events if e.get("state") == st]
+        limit = msg.get("limit") or 10_000
+        reply(events=events[-limit:])
+
+    async def _h_list_objects(self, state, msg, reply, reply_err):
+        limit = msg.get("limit") or 10_000
+        out = []
+        for rec in list(self.objects.values())[:limit]:
+            out.append(
+                {
+                    "object_id": rec.oid.hex(),
+                    "size": rec.size,
+                    "owner": rec.owner,
+                    "in_shm": rec.shm_name is not None,
+                    "num_holders": len(rec.holders),
+                }
+            )
+        reply(objects=out)
+
+    async def _h_metrics_report(self, state, msg, reply, reply_err):
+        for m in msg.get("metrics") or []:
+            try:
+                rec = self.metrics.setdefault(
+                    m["name"],
+                    {"type": m["type"], "desc": m.get("desc", ""), "data": {}},
+                )
+                data = rec["data"]
+                key = m["tags_key"]
+                if m["type"] == "counter":
+                    data[key] = data.get(key, 0.0) + m["value"]
+                elif m["type"] == "gauge":
+                    data[key] = m["value"]
+                elif m["type"] == "histogram":
+                    nbuckets = len(m["value"]["buckets"])
+                    cur = data.setdefault(
+                        key, {"buckets": [0] * nbuckets, "sum": 0.0, "count": 0}
+                    )
+                    if len(cur["buckets"]) < nbuckets:
+                        # same name reported with different boundaries (e.g.
+                        # rolling code change): widen rather than IndexError
+                        cur["buckets"].extend([0] * (nbuckets - len(cur["buckets"])))
+                    for i, c in enumerate(m["value"]["buckets"]):
+                        cur["buckets"][i] += c
+                    cur["sum"] += m["value"]["sum"]
+                    cur["count"] += m["value"]["count"]
+                    if len(m["value"]["bounds"]) >= len(cur.get("bounds", [])):
+                        cur["bounds"] = m["value"]["bounds"]
+            except Exception:
+                continue  # one malformed record must not drop the whole batch
+
+    async def _h_metrics_snapshot(self, state, msg, reply, reply_err):
+        reply(metrics=self.metrics)
 
     async def _h_job_stop(self, state, msg, reply, reply_err):
         reply()

@@ -53,6 +53,9 @@ class WorkerProcess:
         )
         self.actor: Optional[ActorContext] = None
         self._exiting = False
+        # task events buffered here, flushed to the head by the heartbeat loop
+        # (analogue of core_worker/task_event_buffer.h -> GcsTaskManager)
+        self._task_events: List[dict] = []
 
     # ----------------------------------------------------------- args/results
     def _resolve_arg(self, spec: dict) -> Any:
@@ -144,9 +147,29 @@ class WorkerProcess:
             task_id, msg.get("num_returns", 1), value, msg.get("owner", "")
         )
 
+    def _record_event(self, task_id: bytes, name: str, kind: str, t0: float, ok: bool):
+        import time as _time
+
+        self._task_events.append(
+            {
+                "task_id": task_id.hex(),
+                "name": name,
+                "type": kind,
+                "worker_id": self.worker_id,
+                "actor_id": self.actor.actor_id if self.actor else None,
+                "state": "FINISHED" if ok else "FAILED",
+                "start": t0,
+                "end": _time.time(),
+            }
+        )
+
     async def _execute(self, msg, is_actor_call: bool) -> List[dict]:
+        import time as _time
+
         num_returns = msg.get("num_returns", 1)
         task_id = msg.get("task_id") or os.urandom(16)
+        t0 = _time.time()
+        ev_name = msg.get("method") if is_actor_call else None
         try:
             if is_actor_call:
                 if self.actor is None or self.actor.actor_id != msg["actor_id"]:
@@ -167,7 +190,7 @@ class WorkerProcess:
                         None, self._resolve_args, msg["args"], msg.get("kwargs")
                     )
                     value = await method(*args, **kwargs)
-                    return await self.loop.run_in_executor(
+                    out = await self.loop.run_in_executor(
                         None,
                         self._package_results,
                         task_id,
@@ -175,16 +198,23 @@ class WorkerProcess:
                         value,
                         msg.get("owner", ""),
                     )
-                return await self.loop.run_in_executor(
+                    self._record_event(task_id, ev_name, "actor_task", t0, True)
+                    return out
+                out = await self.loop.run_in_executor(
                     self.executor, self._exec_sync, method, msg, task_id, msg["actor_id"]
                 )
+                self._record_event(task_id, ev_name, "actor_task", t0, True)
+                return out
             fn = self.worker.fn_manager.get(msg["fn_id"])
             if fn is None:
                 reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
                 fn = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
-            return await self.loop.run_in_executor(
+            ev_name = getattr(fn, "__name__", "task")
+            out = await self.loop.run_in_executor(
                 self.executor, self._exec_sync, fn, msg, task_id, None
             )
+            self._record_event(task_id, ev_name, "task", t0, True)
+            return out
         except SystemExit:
             self._exiting = True
             if self.actor is not None:
@@ -194,6 +224,13 @@ class WorkerProcess:
                     pass
             return self._error_results(num_returns, TaskError("actor exited via exit_actor()"))
         except BaseException as e:
+            self._record_event(
+                task_id,
+                ev_name or "task",
+                "actor_task" if is_actor_call else "task",
+                t0,
+                False,
+            )
             return self._error_results(num_returns, e)
 
     # --------------------------------------------------------------- handlers
@@ -270,9 +307,12 @@ class WorkerProcess:
     async def _heartbeat_loop(self):
         period = self.config.health_check_period_s / 2
         while True:
-            await asyncio.sleep(period)
+            await asyncio.sleep(min(period, 1.0))
             try:
                 self.worker.head.notify("heartbeat", client_id=self.worker_id)
+                if self._task_events:
+                    batch, self._task_events = self._task_events, []
+                    self.worker.head.notify("task_events", events=batch)
             except Exception:
                 pass
 

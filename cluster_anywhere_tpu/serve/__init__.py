@@ -117,19 +117,36 @@ def deployment(
 
 
 def _collect_deployments(app: Application, out: Dict[str, Application]):
-    """DFS the bind graph; nested Applications in init args become handles."""
+    """DFS the bind graph; Applications nested anywhere in init args (also
+    inside lists/tuples/dicts) become handles."""
     name = app.deployment.name
     if name in out and out[name] is not app:
         raise ValueError(f"duplicate deployment name {name!r} in application")
     out[name] = app
+
+    def walk(v):
+        if isinstance(v, Application):
+            _collect_deployments(v, out)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                walk(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                walk(x)
+
     for a in list(app.args) + list(app.kwargs.values()):
-        if isinstance(a, Application):
-            _collect_deployments(a, out)
+        walk(a)
 
 
 def _resolve_arg(a, app_name: str):
     if isinstance(a, Application):
         return {"__ca_serve_handle__": True, "app": app_name, "deployment": a.deployment.name}
+    if isinstance(a, list):
+        return [_resolve_arg(x, app_name) for x in a]
+    if isinstance(a, tuple):
+        return tuple(_resolve_arg(x, app_name) for x in a)
+    if isinstance(a, dict):
+        return {k: _resolve_arg(v, app_name) for k, v in a.items()}
     return a
 
 

@@ -48,6 +48,10 @@ class _DeploymentState:
         self.status = "UPDATING"
         self.message = ""
         self.payload_digest: str = ""
+        # generation disambiguates replica actor names across redeploys;
+        # retired tells a mid-flight reconcile pass to stop touching this state
+        self.generation = 0
+        self.retired = False
         self._last_scale_t = 0.0
 
     def key(self) -> str:
@@ -88,11 +92,14 @@ class ServeController:
                 st = _DeploymentState(app_name, name, d_def, init_args, init_kwargs, cfg)
                 st.payload_digest = __import__("hashlib").sha256(spec["payload"]).hexdigest()
                 if old is not None:
+                    old.retired = True  # a mid-flight reconcile must stop
                     st.replica_counter = old.replica_counter
+                    st.generation = old.generation + 1
                     st.version = old.version + 1
                     if st.payload_digest == getattr(old, "payload_digest", None):
                         # same code: keep live replicas, push config deltas
                         st.replicas = old.replicas
+                        st.generation = old.generation
                         if cfg.user_config is not None and old.cfg.user_config != cfg.user_config:
                             for h in st.replicas.values():
                                 try:
@@ -106,6 +113,7 @@ class ServeController:
                 app[name] = st
             for name in list(app):
                 if name not in wanted:
+                    app[name].retired = True
                     self._teardown_deployment(app[name])
                     del app[name]
             self.route_prefixes[app_name] = route_prefix
@@ -131,6 +139,9 @@ class ServeController:
             app = self.apps.pop(app_name, None)
             self.route_prefixes.pop(app_name, None)
             self.ingress.pop(app_name, None)
+            if app:
+                for st in app.values():
+                    st.retired = True
         if app:
             for st in app.values():
                 self._teardown_deployment(st)
@@ -219,7 +230,9 @@ class ServeController:
 
     # ------------------------------------------------------------- reconcile
     def _replica_actor_name(self, st: _DeploymentState, rid: str) -> str:
-        return f"SERVE_REPLICA::{st.app}::{st.name}::{rid}"
+        # generation-qualified: replicas of a retired deploy can never collide
+        # with the names the replacement state will use
+        return f"SERVE_REPLICA::{st.app}::{st.name}::g{st.generation}::{rid}"
 
     def _reconcile_loop(self):
         while not self._stopped:
@@ -240,6 +253,8 @@ class ServeController:
             st.version += 1
 
     def _reconcile_deployment(self, st: _DeploymentState):
+        if st.retired:
+            return
         # replace dead replicas
         dead = []
         for rid, h in list(st.replicas.items()):
@@ -253,11 +268,11 @@ class ServeController:
             except Exception:
                 pass
             with self._lock:
-                del st.replicas[rid]
+                st.replicas.pop(rid, None)
         if dead:
             self._bump_version(st)
         changed = False
-        while len(st.replicas) < st.target and not self._stopped:
+        while len(st.replicas) < st.target and not self._stopped and not st.retired:
             with self._lock:
                 rid = f"r{st.replica_counter}"
                 st.replica_counter += 1
@@ -278,6 +293,13 @@ class ServeController:
             except Exception as e:
                 st.status = "UNHEALTHY"
                 st.message = f"replica start failed: {e!r}"
+                return
+            if st.retired:
+                # deploy/delete raced with this spawn: don't leak the replica
+                try:
+                    kill(h)
+                except Exception:
+                    pass
                 return
             with self._lock:
                 st.replicas[rid] = h

@@ -33,23 +33,30 @@ def multiplexed(_fn: Optional[Callable] = None, *, max_num_models_per_replica: i
                 self_obj, model_id = args
                 state = getattr(self_obj, attr, None)
                 if state is None:
-                    state = {"cache": OrderedDict(), "lock": asyncio.Lock()}
+                    state = {"cache": OrderedDict(), "locks": {}}
                     setattr(self_obj, attr, state)
             else:
                 (model_id,) = args
                 self_obj = None
                 if not free_state:
-                    free_state.update(cache=OrderedDict(), lock=asyncio.Lock())
+                    free_state.update(cache=OrderedDict(), locks={})
                 state = free_state
             cache = state["cache"]
-            async with state["lock"]:
+            if model_id in cache:  # cache hits never wait behind a load
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            # loads serialize per model id only: a slow load of model B must
+            # not block requests for cached model A or a parallel load of C
+            lock = state["locks"].setdefault(model_id, asyncio.Lock())
+            async with lock:
                 if model_id in cache:
                     cache.move_to_end(model_id)
                     return cache[model_id]
                 model = await (fn(self_obj, model_id) if self_obj is not None else fn(model_id))
                 cache[model_id] = model
                 while len(cache) > max_num_models_per_replica:
-                    cache.popitem(last=False)  # evict LRU; refcount GC cleans up
+                    old_id, _ = cache.popitem(last=False)  # LRU; refcount GC cleans up
+                    state["locks"].pop(old_id, None)
                 return model
 
         wrapper._is_serve_multiplexed = True

@@ -104,15 +104,11 @@ class ProxyActor:
 
     # ---------------------------------------------------------- http server
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        """One request per connection (responses carry Connection: close)."""
         try:
-            while True:
-                req = await self._read_request(reader)
-                if req is None:
-                    break
+            req = await self._read_request(reader)
+            if req is not None:
                 asyncio.get_running_loop().create_task(self._dispatch(req, writer))
-                # serialize responses per connection: await via queue-less
-                # approach — handle one request at a time per connection
-                break
         except Exception:
             pass
 

@@ -46,6 +46,12 @@ class Replica:
         def resolve(v):
             if isinstance(v, dict) and v.get("__ca_serve_handle__"):
                 return DeploymentHandle(v["app"], v["deployment"])
+            if isinstance(v, list):
+                return [resolve(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(resolve(x) for x in v)
+            if isinstance(v, dict):
+                return {k: resolve(x) for k, x in v.items()}
             return v
 
         init_args = tuple(resolve(a) for a in init_args)

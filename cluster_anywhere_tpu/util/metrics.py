@@ -1,0 +1,262 @@
+"""User-defined metrics: Counter / Gauge / Histogram (analogue of the
+reference's python/ray/util/metrics.py over the C++ stats pipeline
+src/ray/stats/metric.h -> MetricsAgent -> Prometheus).
+
+Metrics record locally (lock-free per-process dicts) and a background flusher
+ships deltas to the head, which aggregates across the cluster. Snapshot via
+`get_metrics_snapshot()`; Prometheus exposition text via `prometheus_text()`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+_by_name: Dict[str, "Metric"] = {}
+_flusher_started = False
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+]
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> str:
+    return json.dumps(sorted((tags or {}).items()))
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    t = threading.Thread(target=_flush_loop, daemon=True, name="ca-metrics-flush")
+    t.start()
+
+
+def _flush_loop():
+    while True:
+        time.sleep(1.0)
+        flush_once()
+
+
+def flush_once():
+    """Ship pending deltas to the head (called by the background flusher; also
+    directly from tests for determinism)."""
+    from ..core.worker import try_global_worker
+
+    w = try_global_worker()
+    if w is None or w.head is None or w.head.closed:
+        return
+    batch = []
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        batch.extend(m._drain())
+    if not batch:
+        return
+
+    def _send():
+        try:
+            w.head.notify("metrics_report", metrics=batch)
+        except Exception:
+            pass
+
+    try:
+        w.loop.call_soon_threadsafe(_send)
+    except RuntimeError:
+        pass
+
+
+class Metric:
+    _type = "gauge"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        if not name or not name.replace("_", "a").replace(":", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def _register(self):
+        """Dedup by name: re-constructing a metric (e.g. per task invocation)
+        shares the first instance's state instead of growing the registry and
+        leaking one object per construction."""
+        with _registry_lock:
+            ex = _by_name.get(self.name)
+            if ex is not None and type(ex) is type(self):
+                self._adopt(ex)
+                return
+            _by_name[self.name] = self
+            _registry.append(self)
+        _ensure_flusher()
+
+    def _adopt(self, other: "Metric"):
+        raise NotImplementedError
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        if tags:
+            unknown = set(tags) - set(self.tag_keys) - set(self._default_tags)
+            if self.tag_keys and unknown:
+                raise ValueError(f"undeclared tag keys {sorted(unknown)}")
+            out.update(tags)
+        return out
+
+    def _drain(self) -> List[dict]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    _type = "counter"
+
+    def __init__(self, name, description: str = "", tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._pending: Dict[str, float] = {}
+        self._register()
+
+    def _adopt(self, other):
+        self._lock = other._lock
+        self._pending = other._pending
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc value must be >= 0")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._pending[key] = self._pending.get(key, 0.0) + value
+
+    def _drain(self) -> List[dict]:
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        return [
+            {"name": self.name, "type": "counter", "desc": self.description,
+             "tags_key": k, "value": v}
+            for k, v in pending.items()
+        ]
+
+
+class Gauge(Metric):
+    _type = "gauge"
+
+    def __init__(self, name, description: str = "", tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[str, float] = {}
+        self._dirty: set = set()
+        self._register()
+
+    def _adopt(self, other):
+        self._lock = other._lock
+        self._values = other._values
+        self._dirty = other._dirty
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+            self._dirty.add(key)
+
+    def _drain(self) -> List[dict]:
+        with self._lock:
+            dirty, self._dirty = self._dirty, set()
+            out = [
+                {"name": self.name, "type": "gauge", "desc": self.description,
+                 "tags_key": k, "value": self._values[k]}
+                for k in dirty
+            ]
+        return out
+
+
+class Histogram(Metric):
+    _type = "histogram"
+
+    def __init__(
+        self,
+        name,
+        description: str = "",
+        boundaries: Optional[Sequence[float]] = None,
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self.bounds = list(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        if sorted(self.bounds) != self.bounds:
+            raise ValueError("histogram boundaries must be sorted")
+        self._pending: Dict[str, dict] = {}
+        self._register()
+
+    def _adopt(self, other):
+        self._lock = other._lock
+        self._pending = other._pending
+        self.bounds = other.bounds
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        import bisect
+
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            cur = self._pending.setdefault(
+                key, {"buckets": [0] * (len(self.bounds) + 1), "sum": 0.0, "count": 0}
+            )
+            cur["buckets"][bisect.bisect_left(self.bounds, value)] += 1
+            cur["sum"] += value
+            cur["count"] += 1
+
+    def _drain(self) -> List[dict]:
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        return [
+            {"name": self.name, "type": "histogram", "desc": self.description,
+             "tags_key": k, "value": {**v, "bounds": self.bounds}}
+            for k, v in pending.items()
+        ]
+
+
+# ---------------------------------------------------------------- inspection
+
+
+def get_metrics_snapshot() -> Dict[str, dict]:
+    """Cluster-wide aggregated metrics from the head."""
+    from ..core.worker import global_worker
+
+    flush_once()
+    return global_worker().head_call("metrics_snapshot")["metrics"]
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format of the cluster metrics snapshot."""
+    snap = get_metrics_snapshot()
+    lines: List[str] = []
+    for name, rec in sorted(snap.items()):
+        if rec.get("desc"):
+            lines.append(f"# HELP {name} {rec['desc']}")
+        ptype = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}[
+            rec["type"]
+        ]
+        lines.append(f"# TYPE {name} {ptype}")
+        for key, val in rec["data"].items():
+            tags = dict(json.loads(key))
+            label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+            if rec["type"] in ("counter", "gauge"):
+                lines.append(f"{name}{{{label}}} {val}" if label else f"{name} {val}")
+            else:
+                bounds = val.get("bounds", [])
+                cum = 0
+                for b, c in zip(bounds + ["+Inf"], val["buckets"]):
+                    cum += c
+                    le = f'le="{b}"'
+                    full = f"{label},{le}" if label else le
+                    lines.append(f"{name}_bucket{{{full}}} {cum}")
+                suffix = f"{{{label}}}" if label else ""
+                lines.append(f"{name}_sum{suffix} {val['sum']}")
+                lines.append(f"{name}_count{suffix} {val['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,177 @@
+"""State observability API (analogue of the reference's python/ray/util/state/
+— list_tasks/list_actors/list_objects/list_nodes/list_workers/
+list_placement_groups, summarize_*, get_log, and `timeline` Chrome-trace
+export backed by the head's task-event buffer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from ..core.worker import global_worker
+
+
+def _head(method: str, **kw) -> dict:
+    return global_worker().head_call(method, **kw)
+
+
+# ------------------------------------------------------------------- listing
+
+
+def list_tasks(
+    *,
+    filters: Optional[List[tuple]] = None,
+    limit: int = 10_000,
+) -> List[Dict[str, Any]]:
+    """Finished/failed task executions (the head keeps a 50k ring buffer)."""
+    kw: Dict[str, Any] = {"limit": limit}
+    for f in filters or []:
+        key, op, value = f
+        if op != "=":
+            raise ValueError("only '=' filters are supported")
+        if key in ("name", "state"):
+            kw[key] = value
+    events = _head("list_task_events", **kw)["events"]
+    out = []
+    for e in events:
+        out.append(
+            {
+                "task_id": e["task_id"],
+                "name": e["name"],
+                "type": e["type"].upper(),
+                "state": e["state"],
+                "worker_id": e["worker_id"],
+                "actor_id": e.get("actor_id"),
+                "start_time_ms": e["start"] * 1000,
+                "end_time_ms": e["end"] * 1000,
+                "duration_ms": (e["end"] - e["start"]) * 1000,
+            }
+        )
+    return out
+
+
+def list_actors(*, limit: int = 10_000) -> List[Dict[str, Any]]:
+    return _head("list_actors")["actors"][:limit]
+
+
+def list_workers(*, limit: int = 10_000) -> List[Dict[str, Any]]:
+    return _head("list_workers")["workers"][:limit]
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _head("nodes")["nodes"]
+
+
+def list_objects(*, limit: int = 10_000) -> List[Dict[str, Any]]:
+    return _head("list_objects", limit=limit)["objects"]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _head("list_pgs")["pgs"]
+
+
+# ------------------------------------------------------------------ summary
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Group task executions by (name) with counts per state and latency stats."""
+    tasks = list_tasks()
+    groups: Dict[str, dict] = defaultdict(
+        lambda: {"states": defaultdict(int), "count": 0, "total_ms": 0.0, "max_ms": 0.0}
+    )
+    for t in tasks:
+        g = groups[t["name"]]
+        g["states"][t["state"]] += 1
+        g["count"] += 1
+        g["total_ms"] += t["duration_ms"]
+        g["max_ms"] = max(g["max_ms"], t["duration_ms"])
+    return {
+        name: {
+            "count": g["count"],
+            "states": dict(g["states"]),
+            "mean_ms": g["total_ms"] / g["count"] if g["count"] else 0.0,
+            "max_ms": g["max_ms"],
+        }
+        for name, g in groups.items()
+    }
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = defaultdict(int)
+    for a in list_actors():
+        counts[a["state"]] += 1
+    return dict(counts)
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects()
+    return {
+        "total_objects": len(objs),
+        "total_size_bytes": sum(o["size"] for o in objs),
+        "in_shm": sum(1 for o in objs if o["in_shm"]),
+    }
+
+
+# ------------------------------------------------------------------ timeline
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace (chrome://tracing / perfetto) events of task executions
+    (analogue of `ray timeline`, reference scripts/scripts.py timeline)."""
+    tasks = list_tasks()
+    events = []
+    for t in tasks:
+        events.append(
+            {
+                "name": t["name"],
+                "cat": t["type"].lower(),
+                "ph": "X",
+                "ts": t["start_time_ms"] * 1000,  # chrome trace wants us
+                "dur": t["duration_ms"] * 1000,
+                "pid": "cluster",
+                "tid": t["worker_id"],
+                "args": {
+                    "task_id": t["task_id"],
+                    "state": t["state"],
+                    "actor_id": t["actor_id"],
+                },
+            }
+        )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+# ----------------------------------------------------------------------- logs
+
+
+def get_log(worker_id: Optional[str] = None, tail: int = 200) -> str:
+    """Read a worker's (or the head's) captured stdout/stderr log."""
+    w = global_worker()
+    name = f"{worker_id}.log" if worker_id else "head.log"
+    path = os.path.join(w.session_dir, name)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no log at {path}")
+    with open(path, "rb") as f:
+        data = f.read().decode("utf-8", "replace")
+    lines = data.splitlines()
+    return "\n".join(lines[-tail:])
+
+
+__all__ = [
+    "list_tasks",
+    "list_actors",
+    "list_workers",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "summarize_tasks",
+    "summarize_actors",
+    "summarize_objects",
+    "timeline",
+    "get_log",
+]

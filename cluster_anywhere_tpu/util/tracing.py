@@ -1,0 +1,86 @@
+"""Lightweight distributed tracing (analogue of the reference's
+python/ray/util/tracing/tracing_helper.py, which monkey-patches remote calls
+to emit OpenTelemetry spans).
+
+`enable()` patches RemoteFunction._remote and ActorMethod._remote so every
+submission records a client-side span (submit -> first result ready) into the
+metrics pipeline as a histogram, and execution-side spans already flow through
+the head's task-event buffer (util.state.timeline). `span("name")` is a
+context manager for custom app spans, recorded the same way.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from . import metrics
+
+_enabled = False
+_patch_lock = threading.Lock()
+_submit_hist: Optional[metrics.Histogram] = None
+_span_hist: Optional[metrics.Histogram] = None
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enable():
+    """Idempotently patch task/actor submission to record spans."""
+    global _enabled, _submit_hist, _span_hist
+    with _patch_lock:
+        if _enabled:
+            return
+        _enabled = True
+        _submit_hist = metrics.Histogram(
+            "ca_trace_submit_latency_seconds",
+            "client-side remote() submission latency",
+            tag_keys=("kind", "name"),
+        )
+        _span_hist = metrics.Histogram(
+            "ca_trace_span_seconds", "custom app spans", tag_keys=("name",)
+        )
+
+        from ..core import actor as actor_mod
+        from ..core import remote_function as rf_mod
+
+        orig_task = rf_mod.RemoteFunction._remote
+
+        def traced_task(self, args, kwargs, opts):
+            t0 = time.perf_counter()
+            try:
+                return orig_task(self, args, kwargs, opts)
+            finally:
+                _submit_hist.observe(
+                    time.perf_counter() - t0,
+                    {"kind": "task", "name": getattr(self._function, "__name__", "?")},
+                )
+
+        rf_mod.RemoteFunction._remote = traced_task
+
+        orig_actor = actor_mod.ActorHandle._submit
+
+        def traced_actor(self, method, args, kwargs, opts):
+            t0 = time.perf_counter()
+            try:
+                return orig_actor(self, method, args, kwargs, opts)
+            finally:
+                _submit_hist.observe(
+                    time.perf_counter() - t0, {"kind": "actor", "name": method}
+                )
+
+        actor_mod.ActorHandle._submit = traced_actor
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Record a custom application span into the metrics pipeline."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if _span_hist is not None:
+            _span_hist.observe(time.perf_counter() - t0, {"name": name})

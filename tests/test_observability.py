@@ -1,0 +1,196 @@
+"""State API / metrics / tracing tests (modeled on the reference's
+python/ray/tests/test_state_api.py and test_metrics_agent.py, compressed)."""
+
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.util import metrics, state, tracing
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    ca.init(num_cpus=4)
+    yield
+    ca.shutdown()
+
+
+def _drain_events(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        if predicate(tasks):
+            return tasks
+        time.sleep(0.2)
+    raise AssertionError("task events never arrived")
+
+
+def test_list_tasks_and_summary():
+    @ca.remote
+    def traced_fn(x):
+        return x + 1
+
+    ca.get([traced_fn.remote(i) for i in range(5)])
+
+    tasks = _drain_events(
+        lambda ts: sum(1 for t in ts if t["name"] == "traced_fn") >= 5
+    )
+    mine = [t for t in tasks if t["name"] == "traced_fn"]
+    assert all(t["state"] == "FINISHED" for t in mine)
+    assert all(t["duration_ms"] >= 0 for t in mine)
+    summary = state.summarize_tasks()
+    assert summary["traced_fn"]["count"] >= 5
+    assert summary["traced_fn"]["states"]["FINISHED"] >= 5
+
+
+def test_failed_task_recorded():
+    @ca.remote
+    def boom():
+        raise ValueError("no")
+
+    try:
+        ca.get(boom.remote())
+    except Exception:
+        pass
+    tasks = _drain_events(
+        lambda ts: any(t["name"] == "boom" and t["state"] == "FAILED" for t in ts)
+    )
+    assert any(t["state"] == "FAILED" for t in tasks if t["name"] == "boom")
+
+
+def test_actor_task_events_and_list_actors():
+    @ca.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    ca.get([c.add.remote() for _ in range(3)])
+    tasks = _drain_events(
+        lambda ts: sum(1 for t in ts if t["name"] == "add") >= 3
+    )
+    add_events = [t for t in tasks if t["name"] == "add"]
+    assert all(t["type"] == "ACTOR_TASK" for t in add_events)
+    assert all(t["actor_id"] for t in add_events)
+    actors = state.list_actors()
+    assert any(a["state"] == "alive" for a in actors)
+    assert state.summarize_actors().get("alive", 0) >= 1
+    ca.kill(c)
+
+
+def test_list_nodes_workers_objects():
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    workers = state.list_workers()
+    assert len(workers) >= 1
+    big = ca.put(b"x" * 200_000)
+    objs = state.list_objects()
+    assert any(o["in_shm"] for o in objs)
+    assert state.summarize_objects()["total_objects"] >= 1
+    del big
+
+
+def test_timeline_chrome_trace(tmp_path):
+    @ca.remote
+    def traced2():
+        time.sleep(0.01)
+        return 1
+
+    ca.get([traced2.remote() for _ in range(3)])
+    _drain_events(lambda ts: sum(1 for t in ts if t["name"] == "traced2") >= 3)
+    out = str(tmp_path / "trace.json")
+    events = ca.timeline(out)
+    import json
+    import os
+
+    assert os.path.exists(out)
+    loaded = json.load(open(out))
+    mine = [e for e in loaded if e["name"] == "traced2"]
+    assert len(mine) >= 3
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in mine)
+
+
+def test_counter_gauge_histogram():
+    c = metrics.Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(1, {"route": "/a"})
+    c.inc(2, {"route": "/a"})
+    c.inc(5, {"route": "/b"})
+    g = metrics.Gauge("test_inflight", "inflight")
+    g.set(7)
+    h = metrics.Histogram(
+        "test_latency_seconds", "lat", boundaries=[0.1, 1.0], tag_keys=()
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = metrics.get_metrics_snapshot()
+    assert snap["test_requests_total"]["type"] == "counter"
+    data = snap["test_requests_total"]["data"]
+    import json as _json
+
+    by_tags = {tuple(sorted(dict(_json.loads(k)).items())): v for k, v in data.items()}
+    assert by_tags[(("route", "/a"),)] == 3
+    assert by_tags[(("route", "/b"),)] == 5
+    assert list(snap["test_inflight"]["data"].values()) == [7.0]
+    hist = list(snap["test_latency_seconds"]["data"].values())[0]
+    assert hist["count"] == 3
+    assert hist["buckets"] == [1, 1, 1]
+
+
+def test_metrics_from_workers_aggregate():
+    @ca.remote
+    def work(i):
+        from cluster_anywhere_tpu.util import metrics as m
+
+        c = m.Counter("test_worker_counter", "from workers")
+        c.inc(1)
+        m.flush_once()
+        return i
+
+    ca.get([work.remote(i) for i in range(4)])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        snap = metrics.get_metrics_snapshot()
+        rec = snap.get("test_worker_counter")
+        if rec and sum(rec["data"].values()) >= 4:
+            break
+        time.sleep(0.2)
+    assert sum(snap["test_worker_counter"]["data"].values()) >= 4
+
+
+def test_prometheus_text():
+    metrics.Gauge("test_prom_gauge", "promg").set(3.5)
+    text = metrics.prometheus_text()
+    assert "# TYPE test_prom_gauge gauge" in text
+    assert "test_prom_gauge 3.5" in text
+
+
+def test_tracing_spans():
+    tracing.enable()
+
+    @ca.remote
+    def traced3():
+        return 1
+
+    ca.get(traced3.remote())
+    with tracing.span("my_block"):
+        time.sleep(0.01)
+    snap = metrics.get_metrics_snapshot()
+    sub = snap.get("ca_trace_submit_latency_seconds")
+    assert sub is not None and any(
+        '"task"' in k or "task" in k for k in sub["data"].keys()
+    )
+    spans = snap.get("ca_trace_span_seconds")
+    assert spans is not None and sum(v["count"] for v in spans["data"].values()) >= 1
+
+
+def test_get_log():
+    log = state.get_log()  # head log exists
+    assert isinstance(log, str)
