@@ -1,0 +1,282 @@
+"""Command-line interface (analogue of the reference's python/ray/scripts/
+scripts.py: ray start/stop/status/submit/memory/timeline/summary/logs/
+microbenchmark).
+
+Usage: python -m cluster_anywhere_tpu.cli <command> [...]
+(or the `ca` console script when the package is installed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _connect(args):
+    import cluster_anywhere_tpu as ca
+
+    ca.init(address=getattr(args, "address", None) or "auto")
+    return ca
+
+
+def cmd_start(args):
+    """Start a persistent head (survives driver disconnects) for other
+    drivers/jobs to join via init(address=...)."""
+    import cluster_anywhere_tpu as ca
+
+    os.environ["CA_HEAD_PERSIST"] = "1"
+    info = ca.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    print(f"started cluster at {info['session_dir']}")
+    print(f"resources: {info['resources']}")
+    print("connect with: cluster_anywhere_tpu.init(address='auto')")
+    # detach without stopping the cluster
+    from cluster_anywhere_tpu.core import api as _api
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    global_worker().shutdown(stop_cluster=False)
+    _api._head_proc = None  # leave the head running
+
+
+def cmd_stop(args):
+    import cluster_anywhere_tpu as ca
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    try:
+        ca.init(address=getattr(args, "address", None) or "auto")
+    except ConnectionError as e:
+        print(e)
+        return
+    w = global_worker()
+    print(f"stopping cluster at {w.session_dir}")
+    w.shutdown(stop_cluster=True)
+
+
+def cmd_status(args):
+    ca = _connect(args)
+    total = ca.cluster_resources()
+    avail = ca.available_resources()
+    stats = ca.cluster_stats()
+    print("== cluster status ==")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g} available")
+    for k, v in sorted(stats.items()):
+        print(f"  {k}: {v}")
+    ca.shutdown()
+
+
+def cmd_submit(args):
+    from cluster_anywhere_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient(getattr(args, "address", None) or "auto")
+    entry = " ".join(args.entrypoint)
+    sid = client.submit_job(entrypoint=entry)
+    print(f"submitted {sid}: {entry}")
+    if args.no_wait:
+        return
+    for chunk in client.tail_job_logs(sid):
+        sys.stdout.write(chunk)
+        sys.stdout.flush()
+    status = client.get_job_status(sid)
+    print(f"\njob {sid} {status}")
+    sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def cmd_jobs(args):
+    from cluster_anywhere_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient(getattr(args, "address", None) or "auto")
+    for info in client.list_jobs():
+        dur = (info.end_time or time.time()) - info.start_time
+        print(f"{info.submission_id}  {info.status:10s}  {dur:8.1f}s  {info.entrypoint}")
+
+
+def cmd_memory(args):
+    ca = _connect(args)
+    from cluster_anywhere_tpu.util import state
+
+    objs = state.list_objects()
+    print(f"{len(objs)} objects, {sum(o['size'] for o in objs)} bytes")
+    for o in objs[: args.limit]:
+        loc = "shm" if o["in_shm"] else "inline"
+        print(f"  {o['object_id'][:16]}  {o['size']:>12}  {loc:6}  holders={o['num_holders']}")
+    ca.shutdown()
+
+
+def cmd_timeline(args):
+    ca = _connect(args)
+    events = ca.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+    ca.shutdown()
+
+
+def cmd_summary(args):
+    ca = _connect(args)
+    from cluster_anywhere_tpu.util import state
+
+    if args.kind == "tasks":
+        out = state.summarize_tasks()
+    elif args.kind == "actors":
+        out = state.summarize_actors()
+    else:
+        out = state.summarize_objects()
+    print(json.dumps(out, indent=2, default=str))
+    ca.shutdown()
+
+
+def cmd_list(args):
+    ca = _connect(args)
+    from cluster_anywhere_tpu.util import state
+
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "workers": state.list_workers,
+        "nodes": state.list_nodes,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+    }[args.kind]
+    print(json.dumps(fn(), indent=2, default=str))
+    ca.shutdown()
+
+
+def cmd_logs(args):
+    ca = _connect(args)
+    from cluster_anywhere_tpu.util import state
+
+    print(state.get_log(args.worker_id, tail=args.tail))
+    ca.shutdown()
+
+
+def cmd_metrics(args):
+    ca = _connect(args)
+    from cluster_anywhere_tpu.util import metrics
+
+    print(metrics.prometheus_text(), end="")
+    ca.shutdown()
+
+
+def cmd_microbenchmark(args):
+    """Single-node microbenchmarks (reference _private/ray_perf.py main)."""
+    import cluster_anywhere_tpu as ca
+
+    ca.init(num_cpus=args.num_cpus)
+    results = {}
+
+    @ca.remote
+    def nop():
+        return b"ok"
+
+    # warmup
+    ca.get([nop.remote() for _ in range(100)])
+    n = args.n
+    t0 = time.perf_counter()
+    ca.get([nop.remote() for _ in range(n)])
+    results["tasks_per_s"] = n / (time.perf_counter() - t0)
+
+    @ca.remote
+    class A:
+        def m(self):
+            return b"ok"
+
+    actors = [A.remote() for _ in range(4)]
+    ca.get([a.m.remote() for a in actors])
+    t0 = time.perf_counter()
+    ca.get([actors[i % 4].m.remote() for i in range(n)])
+    results["actor_calls_per_s"] = n / (time.perf_counter() - t0)
+
+    import numpy as np
+
+    mb = 64
+    arr = np.random.default_rng(0).bytes(mb * 1024 * 1024)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        ref = ca.put(arr)
+    results["put_gb_s"] = 5 * mb / 1024 / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        ca.get(ref)
+    results["get_gb_s"] = 5 * mb / 1024 / (time.perf_counter() - t0)
+    for k, v in results.items():
+        print(f"{k}: {v:,.1f}")
+    ca.shutdown()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ca", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def addr(sp):
+        sp.add_argument("--address", default=None, help="session dir (default: auto)")
+
+    sp = sub.add_parser("start", help="start a persistent local cluster")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the running cluster")
+    addr(sp)
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resources and stats")
+    addr(sp)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("submit", help="submit a job: ca submit -- python x.py")
+    addr(sp)
+    sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("jobs", help="list submitted jobs")
+    addr(sp)
+    sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("memory", help="object store contents")
+    addr(sp)
+    sp.add_argument("--limit", type=int, default=50)
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("timeline", help="export Chrome trace of task events")
+    addr(sp)
+    sp.add_argument("--output", "-o", default="timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("summary", help="summarize tasks/actors/objects")
+    addr(sp)
+    sp.add_argument("kind", choices=["tasks", "actors", "objects"])
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    addr(sp)
+    sp.add_argument(
+        "kind",
+        choices=["tasks", "actors", "workers", "nodes", "objects", "placement-groups"],
+    )
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("logs", help="read head/worker logs")
+    addr(sp)
+    sp.add_argument("worker_id", nargs="?", default=None)
+    sp.add_argument("--tail", type=int, default=200)
+    sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("metrics", help="Prometheus metrics snapshot")
+    addr(sp)
+    sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("microbenchmark", help="single-node perf microbenchmarks")
+    sp.add_argument("-n", type=int, default=2000)
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    if getattr(args, "entrypoint", None) and args.entrypoint and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

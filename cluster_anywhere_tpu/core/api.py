@@ -59,6 +59,30 @@ def _sweep_stale_sessions(root: str):
             shutil.rmtree(os.path.join("/dev/shm", name), ignore_errors=True)
 
 
+def _find_session(address: str, root: str) -> str:
+    """Resolve `address` to a running session dir ("auto" = newest)."""
+    if address != "auto":
+        if os.path.exists(os.path.join(address, "head.ready")):
+            return address
+        raise ConnectionError(f"no running cluster at {address!r}")
+    candidates = []
+    if os.path.isdir(root):
+        for name in sorted(os.listdir(root), reverse=True):
+            path = os.path.join(root, name)
+            ready = os.path.join(path, "head.ready")
+            if not os.path.exists(ready):
+                continue
+            try:
+                pid = int(open(ready).read().strip())
+                os.kill(pid, 0)
+                candidates.append(path)
+            except (OSError, ValueError):
+                continue
+    if not candidates:
+        raise ConnectionError(f"no running cluster found under {root}")
+    return candidates[0]
+
+
 def init(
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
@@ -66,10 +90,13 @@ def init(
     object_store_memory: Optional[int] = None,
     config: Optional[CAConfig] = None,
     session_dir: Optional[str] = None,
+    address: Optional[str] = None,
     **config_overrides,
 ) -> Dict[str, Any]:
     """Start a local cluster (head + worker pool) and connect this process as
-    the driver.  Mirrors ray.init (python/ray/_private/worker.py:1275)."""
+    the driver — or, with `address=` ("auto" or a session dir), connect to an
+    already-running cluster as an additional driver.
+    Mirrors ray.init (python/ray/_private/worker.py:1275)."""
     global _head_proc, _session_dir
     if is_initialized():
         raise RuntimeError("already initialized; call shutdown() first")
@@ -78,6 +105,31 @@ def init(
         if not hasattr(cfg, k):
             raise ValueError(f"unknown config key {k!r}")
         setattr(cfg, k, v)
+    if address is not None:
+        if any(
+            x is not None
+            for x in (num_cpus, num_tpus, resources, object_store_memory, session_dir)
+        ):
+            raise ValueError(
+                "resource/session arguments have no effect when joining an "
+                "existing cluster via address=; the head's values apply"
+            )
+        set_config(cfg)
+        sdir = _find_session(address, cfg.session_dir_root)
+        _session_dir = sdir
+        w = Worker(
+            mode="driver",
+            session_dir=sdir,
+            head_sock=os.path.join(sdir, "head.sock"),
+            config=cfg,
+        )
+        set_global_worker(w)
+        w.connect()
+        return {
+            "session_dir": sdir,
+            "node_id": w.node_id,
+            "resources": w.total_resources,
+        }
     if object_store_memory is not None:
         cfg.object_store_memory = object_store_memory
     set_config(cfg)
@@ -145,7 +197,9 @@ def shutdown():
     global _head_proc, _session_dir
     w = try_global_worker()
     if w is not None:
-        w.shutdown(stop_cluster=True)
+        # only a driver that spawned the head tears the cluster down; a
+        # driver that joined via address= just disconnects
+        w.shutdown(stop_cluster=_head_proc is not None)
     if _head_proc is not None:
         try:
             _head_proc.wait(timeout=5)

@@ -68,6 +68,7 @@ class ActorRec:
     death_cause: str = ""
     pg_id: Optional[str] = None
     bundle_index: int = -1
+    runtime_env: Optional[dict] = None
     # where this incarnation's resources are currently charged:
     # "pg" (bundle.used) | "node" (self.avail) | None (not charged) — guards
     # against double-crediting when a PG is removed before the actor's
@@ -399,6 +400,7 @@ class Head:
                 init_spec=a.init_spec,
                 max_concurrency=a.max_concurrency,
                 incarnation=a.incarnation,
+                runtime_env=a.runtime_env,
             )
             a.state = "alive"
             self.stats["actors_created"] += 1
@@ -604,6 +606,7 @@ class Head:
             max_concurrency=msg.get("max_concurrency", 1),
             pg_id=msg.get("pg_id"),
             bundle_index=msg.get("bundle_index", -1),
+            runtime_env=msg.get("runtime_env"),
         )
         if a.name:
             if a.name in self.named_actors:
@@ -910,7 +913,7 @@ class Head:
                 await self._on_worker_death(rec)
         elif state.get("role") == "driver":
             self._driver_clients.discard(cid)
-            if not self._driver_clients:
+            if not self._driver_clients and os.environ.get("CA_HEAD_PERSIST") != "1":
                 # last driver gone -> tear down the job (detached actors would
                 # survive in the multi-job milestone)
                 self._shutdown.set()

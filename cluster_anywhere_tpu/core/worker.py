@@ -599,8 +599,29 @@ class Worker:
         kwspecs = {k: await self._build_arg(v) for k, v in kwargs.items()}
         return specs, kwspecs
 
+    def _prepare_runtime_env(self, runtime_env: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Package a runtime_env into wire form, cached per env spec so a
+        working_dir is zipped+uploaded once, not per task. (Packaging does
+        blocking head RPCs: only call from user threads, never the IO loop.
+        Caveat: edits to a working_dir after first use are not re-uploaded
+        within one driver session — matches the reference's upload-once URIs.)
+        """
+        import json as _json
+
+        from . import runtime_env as _re
+
+        key = _json.dumps(runtime_env, sort_keys=True, default=repr)
+        if not hasattr(self, "_runtime_env_cache"):
+            self._runtime_env_cache = {}
+        if key not in self._runtime_env_cache:
+            self._runtime_env_cache[key] = _re.prepare(runtime_env, self)
+        return self._runtime_env_cache[key]
+
     # ---------------------------------------------------------- task submit
     def submit_task(self, fn, args, kwargs, opts: Dict[str, Any]) -> List[ObjectRef]:
+        if opts.get("runtime_env"):
+            opts = dict(opts)
+            opts["runtime_env"] = self._prepare_runtime_env(opts["runtime_env"])
         num_returns = opts.get("num_returns", 1)
         task_id = TaskID.for_normal_task(self.job_id)
         oids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
@@ -668,6 +689,7 @@ class Worker:
                     args=specs,
                     kwargs=kwspecs,
                     num_returns=opts.get("num_returns", 1),
+                    runtime_env=opts.get("runtime_env"),
                     timeout=None,
                 )
             except ConnectionError as e:
@@ -705,6 +727,9 @@ class Worker:
     def create_actor(self, cls, args, kwargs, opts: Dict[str, Any]) -> Tuple[ActorID, str]:
         actor_id = ActorID.of(self.job_id)
         fn_id, blob = self.fn_manager.export(cls)
+        wire_env = None
+        if opts.get("runtime_env"):
+            wire_env = self._prepare_runtime_env(opts["runtime_env"])  # user thread
 
         async def _create():
             if blob is not None:
@@ -729,6 +754,7 @@ class Worker:
                 max_concurrency=opts.get("max_concurrency", 1),
                 pg_id=opts.get("placement_group"),
                 bundle_index=opts.get("placement_group_bundle_index", -1),
+                runtime_env=wire_env,
                 timeout=None,
             )
             return reply

@@ -139,10 +139,18 @@ class WorkerProcess:
         w.current_task_id = TaskID(task_id)
         if actor_id:
             w.current_actor_id = ActorID.from_hex(actor_id)
+        ctx = None
         try:
+            if msg.get("runtime_env"):
+                from .runtime_env import RuntimeEnvContext
+
+                ctx = RuntimeEnvContext(msg["runtime_env"], w)
+                ctx.apply()  # inside try: a partial apply must still restore
             value = fn(*args, **kwargs)
         finally:
             w.current_task_id = None
+            if ctx is not None:
+                ctx.restore()  # pool workers are reused
         return self._package_results(
             task_id, msg.get("num_returns", 1), value, msg.get("owner", "")
         )
@@ -278,6 +286,11 @@ class WorkerProcess:
             )
 
         def _make():
+            if msg.get("runtime_env"):
+                # dedicated actor process: the env applies for its lifetime
+                from .runtime_env import RuntimeEnvContext
+
+                RuntimeEnvContext(msg["runtime_env"], self.worker).apply()
             args, kwargs = self._resolve_args(specs, kwspecs)
             return cls(*args, **kwargs)
 

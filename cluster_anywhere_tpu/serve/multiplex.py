@@ -52,7 +52,15 @@ def multiplexed(_fn: Optional[Callable] = None, *, max_num_models_per_replica: i
                 if model_id in cache:
                     cache.move_to_end(model_id)
                     return cache[model_id]
-                model = await (fn(self_obj, model_id) if self_obj is not None else fn(model_id))
+                try:
+                    model = await (
+                        fn(self_obj, model_id) if self_obj is not None else fn(model_id)
+                    )
+                except BaseException:
+                    # never cached: drop the lock entry too, or a stream of
+                    # failing ids grows the dict forever
+                    state["locks"].pop(model_id, None)
+                    raise
                 cache[model_id] = model
                 while len(cache) > max_num_models_per_replica:
                     old_id, _ = cache.popitem(last=False)  # LRU; refcount GC cleans up

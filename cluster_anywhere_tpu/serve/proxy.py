@@ -105,12 +105,19 @@ class ProxyActor:
     # ---------------------------------------------------------- http server
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         """One request per connection (responses carry Connection: close)."""
+        req = None
         try:
             req = await self._read_request(reader)
-            if req is not None:
-                asyncio.get_running_loop().create_task(self._dispatch(req, writer))
         except Exception:
             pass
+        if req is None:
+            # malformed/empty request: close or the fd leaks per connection
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
+        asyncio.get_running_loop().create_task(self._dispatch(req, writer))
 
     async def _read_request(self, reader) -> Optional[Request]:
         line = await reader.readline()
