@@ -107,6 +107,19 @@ class ShmChannel(ChannelInterface):
         self.path = path
         self.capacity = len(self._mm) - self.header_size
         self._last_spill = None
+        # native futex wait/wake (microsecond wakeups, no spin): fall back to
+        # 20us polling when the native library is unavailable
+        self._fx = None
+        self._addr = 0
+        try:
+            from ..native import build as _nb
+
+            lib = _nb.load()
+            if lib is not None:
+                self._fx = lib
+                self._addr = _nb.buffer_address(self._mm)
+        except Exception:
+            self._fx = None
 
     # -- u64 accessors ------------------------------------------------------
 
@@ -115,6 +128,32 @@ class ShmChannel(ChannelInterface):
 
     def _set(self, idx: int, v: int):
         _U64.pack_into(self._mm, 8 * idx, v)
+
+    def _set_wake(self, idx: int, v: int):
+        """Release-store + wake futex sleepers on this word."""
+        if self._fx is not None:
+            self._fx.ca_store_u64_wake(self._addr + 8 * idx, v)
+        else:
+            self._set(idx, v)
+
+    def _wait_ge(self, idx: int, min_val: int, deadline) -> None:
+        """Block until word[idx] >= min_val, honoring close flag + deadline."""
+        while True:
+            if self._get(idx) >= min_val:
+                return
+            if self._get(3) & _FLAG_CLOSED:
+                raise ChannelClosedError
+            if deadline is not None and _now() > deadline:
+                raise TimeoutError("channel wait timed out")
+            if self._fx is not None:
+                # 50ms slices so close() stays responsive even though the C
+                # loop only watches the value; never overshoot the deadline
+                slice_ns = 50_000_000
+                if deadline is not None:
+                    slice_ns = min(slice_ns, max(1, int((deadline - _now()) * 1e9)))
+                self._fx.ca_wait_u64_ge(self._addr + 8 * idx, min_val, slice_ns)
+            else:
+                time.sleep(_POLL_S)
 
     def _init_header(self):
         self._set(0, _MAGIC)
@@ -139,15 +178,11 @@ class ShmChannel(ChannelInterface):
 
     def _write_payload(self, payload: bytes, spilled: bool, deadline):
         want = self.version
-        while any(self._get(5 + r) != want for r in range(self.num_readers)):
-            if self._get(3) & _FLAG_CLOSED:
-                raise ChannelClosedError
-            if deadline is not None and _now() > deadline:
-                raise TimeoutError("channel write timed out waiting for readers")
-            time.sleep(_POLL_S)
+        for r in range(self.num_readers):
+            self._wait_ge(5 + r, want, deadline)  # acks only ever increase
         self._mm[self.header_size : self.header_size + len(payload)] = payload
         self._set(2, len(payload) | (_SPILL_BIT if spilled else 0))
-        self._set(1, want + 1)  # publish
+        self._set_wake(1, want + 1)  # publish + wake readers
 
     def write(self, value: Any, timeout: Optional[float] = None):
         from ..core.serialization import pack
@@ -173,12 +208,7 @@ class ShmChannel(ChannelInterface):
 
         deadline = None if timeout is None else _now() + timeout
         my_ack = self._get(5 + self.reader_index)
-        while self.version == my_ack:
-            if self._get(3) & _FLAG_CLOSED:
-                raise ChannelClosedError
-            if deadline is not None and _now() > deadline:
-                raise TimeoutError("channel read timed out")
-            time.sleep(_POLL_S)
+        self._wait_ge(1, my_ack + 1, deadline)
         ver = self.version
         ln = self._get(2)
         spilled = bool(ln & _SPILL_BIT)
@@ -190,11 +220,17 @@ class ShmChannel(ChannelInterface):
             # fetch BEFORE acking: the ack is what lets the writer's next
             # write drop its reference to this spilled object
             value = ca.get(value)
-        self._set(5 + self.reader_index, ver)
+        self._set_wake(5 + self.reader_index, ver)
         return value
 
     def close(self):
         self._set(3, _FLAG_CLOSED)
+        if self._fx is not None:
+            # wake WITHOUT storing: a read-modify-store here could roll back a
+            # concurrent publish/ack; sleepers re-check and see the flag
+            self._fx.ca_wake_u64(self._addr + 8)
+            for r in range(self.num_readers):
+                self._fx.ca_wake_u64(self._addr + 8 * (5 + r))
 
     def release(self):
         try:

@@ -72,7 +72,11 @@ def cmd_submit(args):
 
     client = JobSubmissionClient(getattr(args, "address", None) or "auto")
     entry = " ".join(args.entrypoint)
-    sid = client.submit_job(entrypoint=entry)
+    # run in the submitter's cwd so `ca submit -- python x.py` resolves
+    # relative paths the way the user expects
+    sid = client.submit_job(
+        entrypoint=entry, runtime_env={"working_dir": args.working_dir or os.getcwd()}
+    )
     print(f"submitted {sid}: {entry}")
     if args.no_wait:
         return
@@ -227,6 +231,7 @@ def main(argv=None):
     sp = sub.add_parser("submit", help="submit a job: ca submit -- python x.py")
     addr(sp)
     sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("--working-dir", default=None)
     sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
     sp.set_defaults(fn=cmd_submit)
 

@@ -61,26 +61,24 @@ def _sweep_stale_sessions(root: str):
 
 def _find_session(address: str, root: str) -> str:
     """Resolve `address` to a running session dir ("auto" = newest)."""
+    def _alive(path: str) -> bool:
+        try:
+            pid = int(open(os.path.join(path, "head.ready")).read().strip())
+            os.kill(pid, 0)
+            return True
+        except (OSError, ValueError):
+            return False
+
     if address != "auto":
-        if os.path.exists(os.path.join(address, "head.ready")):
+        if _alive(address):
             return address
         raise ConnectionError(f"no running cluster at {address!r}")
-    candidates = []
     if os.path.isdir(root):
         for name in sorted(os.listdir(root), reverse=True):
             path = os.path.join(root, name)
-            ready = os.path.join(path, "head.ready")
-            if not os.path.exists(ready):
-                continue
-            try:
-                pid = int(open(ready).read().strip())
-                os.kill(pid, 0)
-                candidates.append(path)
-            except (OSError, ValueError):
-                continue
-    if not candidates:
-        raise ConnectionError(f"no running cluster found under {root}")
-    return candidates[0]
+            if _alive(path):
+                return path
+    raise ConnectionError(f"no running cluster found under {root}")
 
 
 def init(

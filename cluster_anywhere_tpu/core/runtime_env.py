@@ -27,8 +27,13 @@ _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 
 
 def validate(runtime_env: Dict[str, Any]):
-    allowed = {"env_vars", "working_dir", "py_modules", "config", "pip"}
+    allowed = {"env_vars", "working_dir", "py_modules", "config"}
     unknown = set(runtime_env) - allowed
+    if "pip" in unknown:
+        raise NotImplementedError(
+            "runtime_env 'pip' is not supported in this environment (no package "
+            "installs); vendor dependencies via py_modules or working_dir"
+        )
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
     ev = runtime_env.get("env_vars")

@@ -217,18 +217,34 @@ class JobSubmissionClient:
             return f.read().decode("utf-8", "replace")
 
     def tail_job_logs(self, submission_id: str, poll_s: float = 0.3) -> Iterator[str]:
-        """Yield log chunks until the job reaches a terminal state."""
+        """Yield log chunks until the job reaches a terminal state. Reads only
+        the new bytes each poll (no O(n^2) full-file re-reads)."""
+        from .core.worker import global_worker
+
+        path = os.path.join(
+            global_worker().session_dir, f"job-{submission_id}.log"
+        )
         offset = 0
+
+        def read_new() -> str:
+            nonlocal offset
+            if not os.path.exists(path):
+                return ""
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read()
+            offset += len(data)
+            return data.decode("utf-8", "replace")
+
         while True:
-            text = self.get_job_logs(submission_id)
-            if len(text) > offset:
-                yield text[offset:]
-                offset = len(text)
+            chunk = read_new()
+            if chunk:
+                yield chunk
             status = self.get_job_status(submission_id)
             if status in (SUCCEEDED, FAILED, STOPPED):
-                text = self.get_job_logs(submission_id)
-                if len(text) > offset:
-                    yield text[offset:]
+                chunk = read_new()
+                if chunk:
+                    yield chunk
                 return
             time.sleep(poll_s)
 

@@ -1,0 +1,86 @@
+"""Build + load the native helper library (ca_native.cpp) via ctypes.
+
+Compiled on first use with g++ into native/_build/, cached by source mtime.
+Every consumer degrades gracefully to pure Python when the toolchain or a
+Linux-only primitive is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ca_native.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD_DIR, "libca_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _compile() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _SO + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it if stale/missing. None if unavailable."""
+    global _lib, _failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _failed:
+            return None
+        try:
+            need_build = (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if need_build and not _compile():
+                _failed = True
+                return None
+            lib = ctypes.CDLL(_SO)
+            lib.ca_parallel_copy.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.ca_parallel_copy.restype = None
+            lib.ca_wait_u64_ge.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+            ]
+            lib.ca_wait_u64_ge.restype = ctypes.c_int
+            lib.ca_store_u64_wake.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.ca_store_u64_wake.restype = None
+            lib.ca_wake_u64.argtypes = [ctypes.c_void_p]
+            lib.ca_wake_u64.restype = None
+            lib.ca_load_u64.argtypes = [ctypes.c_void_p]
+            lib.ca_load_u64.restype = ctypes.c_uint64
+            _lib = lib
+            return _lib
+        except OSError:
+            _failed = True
+            return None
+
+
+def buffer_address(buf) -> int:
+    """Base address of a writable buffer (mmap or memoryview)."""
+    c = (ctypes.c_char * len(buf)).from_buffer(buf)
+    return ctypes.addressof(c)
