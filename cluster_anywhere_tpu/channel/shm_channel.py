@@ -146,12 +146,19 @@ class ShmChannel(ChannelInterface):
             if deadline is not None and _now() > deadline:
                 raise TimeoutError("channel wait timed out")
             if self._fx is not None:
-                # 50ms slices so close() stays responsive even though the C
-                # loop only watches the value; never overshoot the deadline
-                slice_ns = 50_000_000
+                # the C wait watches the close-flag word too (a close() wake
+                # returns immediately with rc=2); the slice only bounds how
+                # long we overshoot a deadline set by another writer's clock
+                slice_ns = 500_000_000
                 if deadline is not None:
                     slice_ns = min(slice_ns, max(1, int((deadline - _now()) * 1e9)))
-                self._fx.ca_wait_u64_ge(self._addr + 8 * idx, min_val, slice_ns)
+                self._fx.ca_wait_u64_ge_flag(
+                    self._addr + 8 * idx,
+                    min_val,
+                    self._addr + 8 * 3,  # flags word
+                    _FLAG_CLOSED,
+                    slice_ns,
+                )
             else:
                 time.sleep(_POLL_S)
 

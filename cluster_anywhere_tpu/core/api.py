@@ -64,9 +64,14 @@ def _find_session(address: str, root: str) -> str:
     def _alive(path: str) -> bool:
         try:
             pid = int(open(os.path.join(path, "head.ready")).read().strip())
+        except (OSError, ValueError):
+            return False
+        try:
             os.kill(pid, 0)
             return True
-        except (OSError, ValueError):
+        except PermissionError:
+            return True  # EPERM: process exists, owned by another user
+        except ProcessLookupError:
             return False
 
     if address != "auto":

@@ -221,10 +221,15 @@ class JobSubmissionClient:
         the new bytes each poll (no O(n^2) full-file re-reads)."""
         from .core.worker import global_worker
 
+        import codecs
+
         path = os.path.join(
             global_worker().session_dir, f"job-{submission_id}.log"
         )
         offset = 0
+        # incremental decoder: a multibyte character split across two polls
+        # must not become U+FFFD
+        decoder = codecs.getincrementaldecoder("utf-8")("replace")
 
         def read_new() -> str:
             nonlocal offset
@@ -234,7 +239,7 @@ class JobSubmissionClient:
                 f.seek(offset)
                 data = f.read()
             offset += len(data)
-            return data.decode("utf-8", "replace")
+            return decoder.decode(data)
 
         while True:
             chunk = read_new()

@@ -1,0 +1,31 @@
+"""cluster_anywhere_tpu.llm: batch LLM inference on the Data library
+(analogue of the reference's Ray LLM, python/ray/llm/ — Processor + stages),
+TPU-native: the inference stage runs the flagship transformer's compiled
+KV-cache generate (models/generate.py) inside actor-pool workers.
+
+    from cluster_anywhere_tpu import llm
+    cfg = llm.ProcessorConfig(model=llm.ModelSpec(preset="tiny"), batch_size=8)
+    processor = llm.build_llm_processor(
+        cfg, preprocess=lambda row: {"prompt": row["text"]}
+    )
+    out_ds = processor(cad.from_items([{"text": "hello"}]))
+"""
+
+from .processor import (
+    ByteTokenizer,
+    ModelSpec,
+    Processor,
+    ProcessorConfig,
+    build_llm_processor,
+)
+from .serve_llm import LLMServer, build_llm_deployment
+
+__all__ = [
+    "ProcessorConfig",
+    "ModelSpec",
+    "Processor",
+    "ByteTokenizer",
+    "build_llm_processor",
+    "LLMServer",
+    "build_llm_deployment",
+]

@@ -1,0 +1,38 @@
+"""Flat npz save/load for model param pytrees (checkpoint interchange between
+train and the llm inference stages)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def save_params(params: Any, path: str):
+    os.makedirs(path, exist_ok=True)
+    np.savez(os.path.join(path, "params.npz"), **_flatten(params))
+
+
+def load_params(path: str) -> Dict[str, Any]:
+    import jax.numpy as jnp
+
+    f = np.load(os.path.join(path, "params.npz"))
+    tree: Dict[str, Any] = {}
+    for key in f.files:
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(f[key])
+    return tree

@@ -1,0 +1,186 @@
+"""Batch LLM inference pipeline (analogue of the reference's
+python/ray/llm/_internal/batch/processor/ + stages/: chat template ->
+tokenize -> inference -> detokenize, composed as Data map stages).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Offline byte-level tokenizer (ids: 0=pad, 1=bos, 2=eos, byte b -> b+3).
+    Stands in for HF tokenizers in air-gapped environments; any object with
+    encode/decode can be plugged into ProcessorConfig.tokenizer."""
+
+    vocab_size = 259
+    pad_id, bos_id, eos_id = 0, 1, 2
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [b + 3 for b in text.encode("utf-8")]
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids) -> str:
+        out = bytearray()
+        for i in ids:
+            i = int(i)
+            if i >= 3:
+                out.append(i - 3)
+        return out.decode("utf-8", "replace")
+
+
+@dataclass
+class ModelSpec:
+    """Which flagship-transformer weights to run. Presets init random weights
+    deterministically (seed) — checkpoint loading goes through `params_path`
+    (an orbax/np.savez dir produced by train)."""
+
+    preset: str = "tiny"  # tiny | small | custom
+    params_path: Optional[str] = None
+    seed: int = 0
+    config_overrides: Dict[str, Any] = field(default_factory=dict)
+
+    def transformer_config(self, vocab_size: int):
+        from ..models.transformer import TransformerConfig
+
+        presets = {
+            "tiny": dict(d_model=64, n_layers=2, n_heads=4, n_kv_heads=4, d_head=16, d_ff=128),
+            "small": dict(d_model=256, n_layers=4, n_heads=8, n_kv_heads=8, d_head=32, d_ff=512),
+        }
+        base = presets.get(self.preset, presets["tiny"])
+        base.update(self.config_overrides)
+        return TransformerConfig(vocab_size=vocab_size, **base)
+
+
+@dataclass
+class ProcessorConfig:
+    model: ModelSpec = field(default_factory=ModelSpec)
+    tokenizer: Any = None  # defaults to ByteTokenizer
+    batch_size: int = 8
+    concurrency: int = 1
+    max_prompt_len: int = 64
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    apply_chat_template: bool = False
+    system_prompt: str = ""
+
+
+class _InferenceWorker:
+    """Actor-pool UDF: holds compiled model + params for its lifetime
+    (reference: stages run in vLLM engine actors)."""
+
+    def __init__(self, cfg: ProcessorConfig):
+        import jax
+
+        self.cfg = cfg
+        self.tok = cfg.tokenizer or ByteTokenizer()
+        self.tcfg = cfg.model.transformer_config(self.tok.vocab_size)
+        from ..models.transformer import init_params
+
+        if cfg.model.params_path:
+            from . import _params_io
+
+            self.params = _params_io.load_params(cfg.model.params_path)
+        else:
+            self.params = init_params(jax.random.key(cfg.model.seed), self.tcfg)
+        self._step = 0
+
+    def __call__(
+        self,
+        batch: Dict[str, np.ndarray],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import generate
+
+        cfg = self.cfg
+        max_new_tokens = cfg.max_new_tokens if max_new_tokens is None else max_new_tokens
+        temperature = cfg.temperature if temperature is None else temperature
+        top_k = cfg.top_k if top_k is None else top_k
+        prompts = [str(p) for p in batch["prompt"].tolist()]
+        encoded = [self.tok.encode(p)[: cfg.max_prompt_len] for p in prompts]
+        max_len = max(len(e) for e in encoded)
+        # left-pad to a common length (pad tokens attend but carry position 0;
+        # exactness matters less than static shapes for the tiny presets)
+        ids = np.full((len(encoded), max_len), self.tok.pad_id, np.int32)
+        for i, e in enumerate(encoded):
+            ids[i, max_len - len(e):] = e
+        self._step += 1
+        out = generate(
+            self.params,
+            jnp.asarray(ids),
+            jax.random.key(cfg.model.seed * 1000003 + self._step),
+            cfg=self.tcfg,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+        )
+        out = np.asarray(out)
+        texts = [self.tok.decode(row) for row in out]
+        result = dict(batch)
+        result["generated_tokens"] = out
+        result["generated_text"] = np.asarray(texts, dtype=object)
+        return result
+
+
+class Processor:
+    """Callable dataset -> dataset pipeline."""
+
+    def __init__(
+        self,
+        config: ProcessorConfig,
+        preprocess: Optional[Callable[[dict], dict]] = None,
+        postprocess: Optional[Callable[[dict], dict]] = None,
+    ):
+        self.config = config
+        self.preprocess = preprocess
+        self.postprocess = postprocess
+
+    def __call__(self, dataset):
+        cfg = self.config
+        ds = dataset
+        if self.preprocess is not None:
+            ds = ds.map(self.preprocess)
+        if cfg.apply_chat_template:
+            system = cfg.system_prompt
+
+            def template(row):
+                prompt = row["prompt"] if isinstance(row, dict) else str(row)
+                msgs = row.get("messages") if isinstance(row, dict) else None
+                if msgs:
+                    text = "".join(
+                        f"<|{m['role']}|>{m['content']}" for m in msgs
+                    ) + "<|assistant|>"
+                else:
+                    text = (f"<|system|>{system}" if system else "") + f"<|user|>{prompt}<|assistant|>"
+                out = dict(row)
+                out["prompt"] = text
+                return out
+
+            ds = ds.map(template)
+        ds = ds.map_batches(
+            _InferenceWorker,
+            fn_constructor_args=(cfg,),
+            batch_size=cfg.batch_size,
+            concurrency=cfg.concurrency,
+            batch_format="numpy",
+        )
+        if self.postprocess is not None:
+            ds = ds.map(self.postprocess)
+        return ds
+
+
+def build_llm_processor(
+    config: ProcessorConfig,
+    preprocess: Optional[Callable[[dict], dict]] = None,
+    postprocess: Optional[Callable[[dict], dict]] = None,
+) -> Processor:
+    return Processor(config, preprocess, postprocess)

@@ -1,0 +1,72 @@
+"""serve.llm: online LLM serving deployment (analogue of the reference's
+python/ray/serve/llm.py build_openai_app — compact: one deployment class with
+request batching over the compiled generate path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .processor import ByteTokenizer, ModelSpec, ProcessorConfig, _InferenceWorker
+
+
+class LLMServer:
+    """Serve deployment hosting one model; understands dict and HTTP requests:
+       {"prompt": "...", "max_new_tokens": 16} -> {"generated_text": "..."}"""
+
+    def __init__(self, config: ProcessorConfig):
+        import numpy as np
+
+        self.config = config
+        self.worker = _InferenceWorker(config)
+        self.np = np
+
+    def reconfigure(self, cfg: Dict[str, Any]):
+        if "max_new_tokens" in cfg:
+            self.config.max_new_tokens = int(cfg["max_new_tokens"])
+        if "temperature" in cfg:
+            self.config.temperature = float(cfg["temperature"])
+
+    def __call__(self, request) -> Dict[str, Any]:
+        from ..serve import Request
+
+        if isinstance(request, Request):
+            body = request.json() if request.method == "POST" else dict(request.query_params)
+        else:
+            body = request if isinstance(request, dict) else {"prompt": str(request)}
+        prompt = body.get("prompt", "")
+        batch = {"prompt": self.np.asarray([prompt], dtype=object)}
+        overrides = {}
+        if "max_new_tokens" in body:
+            overrides["max_new_tokens"] = int(body["max_new_tokens"])
+        if "temperature" in body:
+            overrides["temperature"] = float(body["temperature"])
+        if "top_k" in body:
+            overrides["top_k"] = int(body["top_k"])
+        out = self.worker(batch, **overrides)
+        return {
+            "prompt": prompt,
+            "generated_text": str(out["generated_text"][0]),
+            "num_generated_tokens": int(len(out["generated_tokens"][0])),
+        }
+
+
+def build_llm_deployment(
+    config: Optional[ProcessorConfig] = None,
+    *,
+    num_replicas: int = 1,
+    num_tpus: float = 0.0,
+    name: str = "LLMServer",
+):
+    """Returns a bound serve Application for `serve.run`."""
+    from .. import serve
+
+    config = config or ProcessorConfig()
+    dep = serve.deployment(
+        LLMServer,
+        name=name,
+        num_replicas=num_replicas,
+        num_tpus=num_tpus,
+        max_ongoing_requests=4,
+    )
+    return dep.bind(config)

@@ -1,0 +1,184 @@
+"""Autoregressive generation with a KV cache for the flagship transformer
+(the decode path the reference delegates to vLLM; here TPU-native:
+static-shape cache + `lax.scan` decode loop so the whole generate compiles
+into one XLA program).
+
+Cache layout: one stacked pytree over layers —
+    k, v: [L, B, T_max, H_kv, D]
+Decode steps write slot `pos` with `lax.dynamic_update_slice` and attend over
+the full T_max with a position mask (static shapes; no recompilation per
+step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import TransformerConfig, _rms_norm, _rope
+
+
+def _project_qkv(bp, y, cfg: TransformerConfig):
+    b, t, _ = y.shape
+    h, kv, d = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    dt = y.dtype
+    q = (y @ bp["wq"].astype(dt)).reshape(b, t, h, d)
+    k = (y @ bp["wk"].astype(dt)).reshape(b, t, kv, d)
+    v = (y @ bp["wv"].astype(dt)).reshape(b, t, kv, d)
+    return q, k, v
+
+
+def _gqa_repeat(x, cfg: TransformerConfig):
+    if cfg.n_kv_heads != cfg.n_heads:
+        x = jnp.repeat(x, cfg.n_heads // cfg.n_kv_heads, axis=2)
+    return x
+
+
+def _mlp(bp, x, cfg):
+    dt = x.dtype
+    y = _rms_norm(x, bp["ln2"])
+    gated = jax.nn.silu(y @ bp["w_gate"].astype(dt)) * (y @ bp["w_up"].astype(dt))
+    return x + gated @ bp["w_down"].astype(dt)
+
+
+def _masked_attention(q, k_cache, v_cache, valid_len, cfg: TransformerConfig):
+    """q: [B, Tq, H, D]; caches: [B, T_max, H, D]; positions >= valid_len are
+    masked out. For decode Tq == 1."""
+    scale = cfg.d_head ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32))
+    logits = logits * scale
+    t_max = k_cache.shape[1]
+    mask = jnp.arange(t_max)[None, None, None, :] < valid_len
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, t_max: int):
+    shape = (cfg.n_layers, batch, t_max, cfg.n_kv_heads, cfg.d_head)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _block_decode(bp, x, layer_cache, pos, cfg: TransformerConfig):
+    """One block, one token. x: [B, 1, E]; layer_cache: (k,v) [B,Tmax,KV,D]."""
+    k_cache, v_cache = layer_cache
+    y = _rms_norm(x, bp["ln1"])
+    q, k, v = _project_qkv(bp, y, cfg)
+    positions = jnp.array([0]) + pos  # [1]
+    q, k = _rope(q, k, positions, cfg)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    attn = _masked_attention(
+        q, _gqa_repeat(k_cache, cfg), _gqa_repeat(v_cache, cfg), pos + 1, cfg
+    )
+    b = x.shape[0]
+    x = x + attn.reshape(b, 1, -1) @ bp["wo"].astype(x.dtype)
+    return _mlp(bp, x, cfg), (k_cache, v_cache)
+
+
+def _prefill_block(bp, x, pos0, cfg: TransformerConfig, t_max: int):
+    """One block over the whole prompt; returns padded caches [B,Tmax,KV,D]."""
+    b, t, _ = x.shape
+    y = _rms_norm(x, bp["ln1"])
+    q, k, v = _project_qkv(bp, y, cfg)
+    q, k = _rope(q, k, jnp.arange(t), cfg)
+    k_cache = jnp.zeros((b, t_max, cfg.n_kv_heads, cfg.d_head), x.dtype)
+    v_cache = jnp.zeros_like(k_cache)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+    # causal attention within the prompt (q already has full heads; only
+    # k/v need the GQA repeat)
+    qr = q
+    kr = _gqa_repeat(k, cfg)
+    vr = _gqa_repeat(v, cfg)
+    scale = cfg.d_head ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qr.astype(jnp.float32), kr.astype(jnp.float32)) * scale
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    logits = jnp.where(causal[None, None], logits, -1e30)
+    attn = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1).astype(x.dtype), vr
+    ).reshape(b, t, -1)
+    x = x + attn @ bp["wo"].astype(x.dtype)
+    return _mlp(bp, x, cfg), (k_cache, v_cache)
+
+
+def prefill(params, ids, cfg: TransformerConfig, t_max: int):
+    """ids: [B, T_prompt] -> (last-token logits [B, V], cache)."""
+    x = params["embed"].astype(cfg.dtype)[ids]
+
+    def body(x, bp):
+        x, (kc, vc) = _prefill_block(bp, x, 0, cfg, t_max)
+        return x, (kc, vc)
+
+    blocks = params["blocks"]
+    x, (k_all, v_all) = lax.scan(body, x, blocks)
+    x = _rms_norm(x, params["ln_f"])
+    logits = x[:, -1] @ params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), {"k": k_all, "v": v_all}
+
+
+def decode_one(params, cache, token, pos, cfg: TransformerConfig):
+    """token: [B] -> (logits [B, V], updated cache)."""
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B,1,E]
+
+    def body(x, inputs):
+        bp, kc, vc = inputs
+        x, (kc, vc) = _block_decode(bp, x, (kc, vc), pos, cfg)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["ln_f"])
+    logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": k_all, "v": v_all}
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        top = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < top, -1e30, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+)
+def generate(
+    params,
+    prompt_ids,
+    rng,
+    *,
+    cfg: TransformerConfig,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """prompt_ids: [B, T_prompt] int32 -> generated ids [B, max_new_tokens].
+    One compiled program: prefill + a lax.scan of decode steps."""
+    b, t_prompt = prompt_ids.shape
+    t_max = t_prompt + max_new_tokens
+    logits, cache = prefill(params, prompt_ids, cfg, t_max)
+    rngs = jax.random.split(rng, max_new_tokens)
+    first = _sample(logits, rngs[0], temperature, top_k)
+
+    def step(carry, rng_i):
+        token, cache, pos = carry
+        logits, cache = decode_one(params, cache, token, pos, cfg)
+        nxt = _sample(logits, rng_i, temperature, top_k)
+        return (nxt, cache, pos + 1), nxt
+
+    (_, _, _), tokens = lax.scan(
+        step, (first, cache, jnp.int32(t_prompt)), rngs[1:]
+    )
+    # tokens: the N-1 follow-on samples; prepend the prefill sample
+    out = jnp.concatenate([first[None], tokens], axis=0)
+    return out.T  # [B, N]

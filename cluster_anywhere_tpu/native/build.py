@@ -71,6 +71,11 @@ def load() -> Optional[ctypes.CDLL]:
             lib.ca_store_u64_wake.restype = None
             lib.ca_wake_u64.argtypes = [ctypes.c_void_p]
             lib.ca_wake_u64.restype = None
+            lib.ca_wait_u64_ge_flag.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+            ]
+            lib.ca_wait_u64_ge_flag.restype = ctypes.c_int
             lib.ca_load_u64.argtypes = [ctypes.c_void_p]
             lib.ca_load_u64.restype = ctypes.c_uint64
             _lib = lib

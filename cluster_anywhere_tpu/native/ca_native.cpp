@@ -125,6 +125,56 @@ void ca_wake_u64(volatile uint64_t* addr) {
   futex((uint32_t*)addr, FUTEX_WAKE, INT32_MAX, nullptr);
 }
 
+// Like ca_wait_u64_ge, but also watches a flag word: returns 2 as soon as
+// (*flag_addr & flag_mask) != 0 (a close() that wakes this word is observed
+// immediately instead of being re-slept through). 0 = value reached,
+// -1 = timeout.
+int ca_wait_u64_ge_flag(const volatile uint64_t* addr, uint64_t min_val,
+                        const volatile uint64_t* flag_addr, uint64_t flag_mask,
+                        int64_t timeout_ns) {
+  auto* a = reinterpret_cast<const std::atomic<uint64_t>*>(
+      const_cast<const uint64_t*>(addr));
+  auto* fa = reinterpret_cast<const std::atomic<uint64_t>*>(
+      const_cast<const uint64_t*>(flag_addr));
+  struct timespec deadline;
+  if (timeout_ns >= 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += timeout_ns / 1000000000ll;
+    deadline.tv_nsec += timeout_ns % 1000000000ll;
+    if (deadline.tv_nsec >= 1000000000l) {
+      deadline.tv_sec += 1;
+      deadline.tv_nsec -= 1000000000l;
+    }
+  }
+  for (int i = 0; i < 64; i++) {
+    if (a->load(std::memory_order_acquire) >= min_val) return 0;
+    if (fa->load(std::memory_order_acquire) & flag_mask) return 2;
+#if defined(__x86_64__)
+    __builtin_ia32_pause();
+#else
+    std::this_thread::yield();
+#endif
+  }
+  while (true) {
+    uint64_t v = a->load(std::memory_order_acquire);
+    if (v >= min_val) return 0;
+    if (fa->load(std::memory_order_acquire) & flag_mask) return 2;
+    struct timespec ts;
+    const struct timespec* tp = nullptr;
+    if (timeout_ns >= 0) {
+      struct timespec now;
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      int64_t ns = (deadline.tv_sec - now.tv_sec) * 1000000000ll +
+                   (deadline.tv_nsec - now.tv_nsec);
+      if (ns <= 0) return -1;
+      ts.tv_sec = ns / 1000000000ll;
+      ts.tv_nsec = ns % 1000000000ll;
+      tp = &ts;
+    }
+    futex((uint32_t*)addr, FUTEX_WAIT, (uint32_t)v, tp);
+  }
+}
+
 // Plain acquire load (symmetry helper for the Python side).
 uint64_t ca_load_u64(const volatile uint64_t* addr) {
   auto* a = reinterpret_cast<const std::atomic<uint64_t>*>(
