@@ -146,10 +146,11 @@ class ShmChannel(ChannelInterface):
             if deadline is not None and _now() > deadline:
                 raise TimeoutError("channel wait timed out")
             if self._fx is not None:
-                # the C wait watches the close-flag word too (a close() wake
-                # returns immediately with rc=2); the slice only bounds how
-                # long we overshoot a deadline set by another writer's clock
-                slice_ns = 500_000_000
+                # the C wait watches the close-flag word too, so a close()
+                # wake that lands while the waiter is queued returns
+                # immediately with rc=2; the 50ms slice bounds the rare lost
+                # wake (flag set between the waiter's check and FUTEX_WAIT)
+                slice_ns = 50_000_000
                 if deadline is not None:
                     slice_ns = min(slice_ns, max(1, int((deadline - _now()) * 1e9)))
                 self._fx.ca_wait_u64_ge_flag(

@@ -14,6 +14,11 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
     if isinstance(tree, dict):
         for k, v in tree.items():
             out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        # list pytrees (e.g. MLP layer stacks) flatten under numeric keys and
+        # are rebuilt as lists by load_params
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
     else:
         out[prefix.rstrip("/")] = np.asarray(tree)
     return out
@@ -35,4 +40,14 @@ def load_params(path: str) -> Dict[str, Any]:
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         node[parts[-1]] = jnp.asarray(f[key])
-    return tree
+    return _relist(tree)
+
+
+def _relist(node):
+    """Rebuild list pytrees: an all-digit-keyed dict came from a list and must
+    round-trip as one (ordered numerically, not lexically)."""
+    if isinstance(node, dict):
+        if node and all(k.isdigit() for k in node):
+            return [_relist(node[str(i)]) for i in range(len(node))]
+        return {k: _relist(v) for k, v in node.items()}
+    return node

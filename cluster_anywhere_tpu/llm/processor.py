@@ -107,9 +107,9 @@ class _InferenceWorker:
         top_k = cfg.top_k if top_k is None else top_k
         prompts = [str(p) for p in batch["prompt"].tolist()]
         encoded = [self.tok.encode(p)[: cfg.max_prompt_len] for p in prompts]
-        max_len = max(len(e) for e in encoded)
-        # left-pad to a common length (pad tokens attend but carry position 0;
-        # exactness matters less than static shapes for the tiny presets)
+        # left-pad to the FIXED max_prompt_len so every batch hits the same
+        # compiled program (per-batch max length would recompile per shape)
+        max_len = cfg.max_prompt_len
         ids = np.full((len(encoded), max_len), self.tok.pad_id, np.int32)
         for i, e in enumerate(encoded):
             ids[i, max_len - len(e):] = e
@@ -153,7 +153,7 @@ class Processor:
             system = cfg.system_prompt
 
             def template(row):
-                prompt = row["prompt"] if isinstance(row, dict) else str(row)
+                prompt = row.get("prompt", "") if isinstance(row, dict) else str(row)
                 msgs = row.get("messages") if isinstance(row, dict) else None
                 if msgs:
                     text = "".join(

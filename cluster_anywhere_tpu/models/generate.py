@@ -139,19 +139,20 @@ def decode_one(params, cache, token, pos, cfg: TransformerConfig):
     return logits, {"k": k_all, "v": v_all}
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+def _sample(logits, rng, temperature, top_k: int):
+    """temperature is traced (no recompile per request value); top_k stays
+    static (lax.top_k needs a static k). temperature <= 0 means greedy."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temperature, 1e-6)
+    scaled = logits / t
     if top_k > 0:
-        top = lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < top, -1e30, logits)
-    return jax.random.categorical(rng, logits).astype(jnp.int32)
+        top = lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < top, -1e30, scaled)
+    sampled = jax.random.categorical(rng, scaled).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
-)
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k"))
 def generate(
     params,
     prompt_ids,

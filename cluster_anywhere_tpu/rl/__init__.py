@@ -1,0 +1,33 @@
+"""cluster_anywhere_tpu.rl: reinforcement learning on the actor runtime
+(compact analogue of the reference's RLlib, rllib/ — Algorithm/
+AlgorithmConfig, EnvRunner actors, jax Learners; PPO + DQN).
+
+    from cluster_anywhere_tpu import rl
+    algo = rl.AlgorithmConfig("PPO").environment("CartPole-v1").env_runners(2).build()
+    for _ in range(20):
+        result = algo.train()
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .buffer import ReplayBuffer
+from .env import CartPole, Env, VectorEnv, make_env, register_env
+from .env_runner import EnvRunner
+from .learner import DQNLearner, PPOLearner, compute_gae
+from .module import DiscretePolicyModule, QModule
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "Env",
+    "CartPole",
+    "VectorEnv",
+    "make_env",
+    "register_env",
+    "EnvRunner",
+    "PPOLearner",
+    "DQNLearner",
+    "compute_gae",
+    "ReplayBuffer",
+    "DiscretePolicyModule",
+    "QModule",
+]

@@ -1,0 +1,219 @@
+"""Algorithm + AlgorithmConfig: the training driver (analogue of the
+reference's rllib/algorithms/algorithm.py — EnvRunnerGroup sampling in
+parallel actors, a jax Learner updating, weights broadcast each iteration).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import api as ca
+from ..core.actor import kill
+from .env import make_env
+from .env_runner import EnvRunner
+from .learner import DQNLearner, PPOLearner, compute_gae
+from .module import DiscretePolicyModule, QModule
+
+
+class AlgorithmConfig:
+    def __init__(self, algo: str = "PPO"):
+        self.algo = algo
+        self.env: Any = "CartPole-v1"
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_length = 64
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.lr = 3e-4
+        self.hidden = (64, 64)
+        self.seed = 0
+        # ppo
+        self.clip = 0.2
+        self.epochs = 4
+        self.minibatches = 4
+        self.entropy_coeff = 0.01
+        # dqn
+        self.buffer_capacity = 50_000
+        self.train_batch_size = 64
+        self.target_update_freq = 100
+        self.epsilon_decay = 0.99
+        self.min_epsilon = 0.05
+        self.updates_per_iteration = 32
+
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int, num_envs_per_runner: int = 4) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_runner
+        return self
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "Algorithm":
+        return Algorithm(self)
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        probe = make_env(config.env)
+        obs_dim, num_actions = probe.observation_dim, probe.num_actions
+        kind = "policy" if config.algo == "PPO" else "q"
+        module_spec = {
+            "kind": kind,
+            "obs_dim": obs_dim,
+            "num_actions": num_actions,
+            "hidden": config.hidden,
+        }
+        if config.algo == "PPO":
+            self.module = DiscretePolicyModule(obs_dim, num_actions, config.hidden)
+            self.learner = PPOLearner(
+                self.module,
+                lr=config.lr,
+                clip=config.clip,
+                entropy_coeff=config.entropy_coeff,
+                epochs=config.epochs,
+                minibatches=config.minibatches,
+                seed=config.seed,
+            )
+        elif config.algo == "DQN":
+            from .buffer import ReplayBuffer
+
+            self.module = QModule(obs_dim, num_actions, config.hidden)
+            self.learner = DQNLearner(
+                self.module,
+                lr=config.lr,
+                gamma=config.gamma,
+                target_update_freq=config.target_update_freq,
+                seed=config.seed,
+            )
+            self.buffer = ReplayBuffer(config.buffer_capacity, obs_dim, config.seed)
+            self.epsilon = 1.0
+        else:
+            raise ValueError(f"unknown algo {config.algo!r}")
+        # resolve string env names to their creator callable here: the
+        # registry is per-process, so runner actors must receive something
+        # self-contained (cloudpickle ships locally-defined env classes)
+        from .env import _ENV_REGISTRY
+
+        env_spec = config.env
+        if isinstance(env_spec, str):
+            if env_spec not in _ENV_REGISTRY:
+                raise KeyError(f"unknown env {env_spec!r}; register_env() it first")
+            env_spec = _ENV_REGISTRY[env_spec]
+        Runner = ca.remote(EnvRunner)
+        self.runners = [
+            Runner.remote(
+                env_spec,
+                module_spec,
+                num_envs=config.num_envs_per_runner,
+                seed=config.seed + 100 * i,
+                explore="sample" if kind == "policy" else "epsilon",
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self._broadcast()
+        self.iteration = 0
+
+    def _broadcast(self):
+        eps = getattr(self, "epsilon", None)
+        ca.get([r.set_weights.remote(self.learner.get_weights(), eps) for r in self.runners])
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.monotonic()
+        rollouts = ca.get(
+            [r.sample.remote(cfg.rollout_length) for r in self.runners]
+        )
+        metrics: Dict[str, Any] = {}
+        episodes, ep_returns = 0, []
+        for ro in rollouts:
+            m = ro.pop("metrics")
+            episodes += m.get("episodes", 0)
+            if "episode_return_mean" in m:
+                ep_returns.append(m["episode_return_mean"])
+        if cfg.algo == "PPO":
+            advs, rets, batches = [], [], []
+            for ro in rollouts:
+                a, r = compute_gae(ro, cfg.gamma, cfg.lam)
+                obs = ro["obs"].reshape(-1, ro["obs"].shape[-1])
+                batches.append(
+                    {
+                        "obs": obs,
+                        "actions": ro["actions"].reshape(-1),
+                        "logp_old": ro["logp"].reshape(-1),
+                        "advantages": a,
+                        "returns": r,
+                    }
+                )
+            batch = {
+                k: np.concatenate([b[k] for b in batches]) for k in batches[0]
+            }
+            stats = self.learner.update(batch)
+        else:
+            for ro in rollouts:
+                T, N = ro["rewards"].shape
+                obs = ro["obs"]
+                next_obs = np.concatenate([obs[1:], ro["next_obs"][None]], axis=0)
+                self.buffer.add_batch(
+                    obs.reshape(T * N, -1),
+                    ro["actions"].reshape(-1),
+                    ro["rewards"].reshape(-1),
+                    ro["dones"].reshape(-1).astype(np.float32),
+                    next_obs.reshape(T * N, -1),
+                )
+            stats = {}
+            if len(self.buffer) >= cfg.train_batch_size:
+                for _ in range(cfg.updates_per_iteration):
+                    stats = self.learner.update(self.buffer.sample(cfg.train_batch_size))
+            self.epsilon = max(cfg.min_epsilon, self.epsilon * cfg.epsilon_decay)
+        self._broadcast()
+        self.iteration += 1
+        metrics.update(stats)
+        metrics.update(
+            {
+                "training_iteration": self.iteration,
+                "episodes_this_iter": episodes,
+                "env_steps_this_iter": cfg.rollout_length
+                * cfg.num_envs_per_runner
+                * cfg.num_env_runners,
+                "time_this_iter_s": time.monotonic() - t0,
+            }
+        )
+        if ep_returns:
+            metrics["episode_return_mean"] = float(np.mean(ep_returns))
+        return metrics
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        return ca.get(self.runners[0].evaluate.remote(num_episodes))
+
+    # ------------------------------------------------------------ checkpoint
+    def save(self, path: str) -> str:
+        from ..llm import _params_io
+
+        _params_io.save_params({"weights": self.learner.get_weights()}, path)
+        return path
+
+    def load(self, path: str):
+        from ..llm import _params_io
+
+        self.learner.params = _params_io.load_params(path)["weights"]
+        self._broadcast()
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,45 @@
+"""Replay buffer (analogue of rllib/utils/replay_buffers/ — uniform ring
+buffer over flat numpy transitions)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.idx = 0
+        self.size = 0
+        self.rng = np.random.default_rng(seed)
+
+    def add_batch(self, obs, actions, rewards, dones, next_obs):
+        for i in range(len(obs)):
+            j = self.idx
+            self.obs[j] = obs[i]
+            self.actions[j] = actions[i]
+            self.rewards[j] = rewards[i]
+            self.dones[j] = dones[i]
+            self.next_obs[j] = next_obs[i]
+            self.idx = (self.idx + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self.rng.integers(0, self.size, size=batch_size)
+        return {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "dones": self.dones[idx],
+            "next_obs": self.next_obs[idx],
+        }
+
+    def __len__(self):
+        return self.size

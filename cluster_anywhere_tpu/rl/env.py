@@ -1,0 +1,130 @@
+"""Environment API + built-in envs (analogue of the reference's
+rllib/env/ — gymnasium-style step/reset; CartPole implemented in numpy so
+tests run without external deps, vectorized for batched sampling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class Env:
+    """Single environment: gymnasium-style API."""
+
+    observation_dim: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class CartPole(Env):
+    """Classic control CartPole-v1 dynamics (Barto, Sutton, Anderson)."""
+
+    observation_dim = 4
+    num_actions = 2
+    max_steps = 500
+
+    def __init__(self):
+        self.gravity = 9.8
+        self.masscart, self.masspole = 1.0, 0.1
+        self.total_mass = self.masscart + self.masspole
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.rng = np.random.default_rng()
+        self.state = np.zeros(4)
+        self.steps = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.steps = 0
+        return self.state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + self.polemass_length * theta_dot**2 * sintheta) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / self.total_mass)
+        )
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self.steps += 1
+        terminated = bool(
+            abs(x) > self.x_threshold
+            or abs(theta) > self.theta_threshold
+            or self.steps >= self.max_steps
+        )
+        return self.state.astype(np.float32), 1.0, terminated, {}
+
+
+_ENV_REGISTRY: Dict[str, Callable[[], Env]] = {"CartPole-v1": CartPole}
+
+
+def register_env(name: str, creator: Callable[[], Env]):
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(name_or_creator) -> Env:
+    if callable(name_or_creator):
+        return name_or_creator()
+    if name_or_creator in _ENV_REGISTRY:
+        return _ENV_REGISTRY[name_or_creator]()
+    raise KeyError(f"unknown env {name_or_creator!r}; register_env() it first")
+
+
+class VectorEnv:
+    """N independent env copies with auto-reset (reference: vectorized
+    sampling inside SingleAgentEnvRunner)."""
+
+    def __init__(self, name_or_creator, num_envs: int, seed: int = 0):
+        self.envs = [make_env(name_or_creator) for _ in range(num_envs)]
+        self.obs = np.stack([e.reset(seed + i) for i, e in enumerate(self.envs)])
+        self.episode_returns = np.zeros(num_envs)
+        self.completed_returns: list = []
+
+    @property
+    def num_envs(self) -> int:
+        return len(self.envs)
+
+    def step(self, actions: np.ndarray):
+        obs, rewards, dones = [], [], []
+        for i, (e, a) in enumerate(zip(self.envs, actions)):
+            o, r, d, _ = e.step(int(a))
+            self.episode_returns[i] += r
+            if d:
+                self.completed_returns.append(self.episode_returns[i])
+                self.episode_returns[i] = 0.0
+                o = e.reset()
+            obs.append(o)
+            rewards.append(r)
+            dones.append(d)
+        self.obs = np.stack(obs)
+        return self.obs, np.asarray(rewards, np.float32), np.asarray(dones)
+
+    def drain_metrics(self) -> Dict[str, float]:
+        rets = self.completed_returns
+        self.completed_returns = []
+        if not rets:
+            return {"episodes": 0}
+        return {
+            "episodes": len(rets),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+        }

@@ -1,0 +1,125 @@
+"""EnvRunner actor: samples rollouts with the current weights (analogue of
+the reference's rllib/env/single_agent_env_runner.py on the actor runtime).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class EnvRunner:
+    def __init__(
+        self,
+        env_spec,
+        module_spec: Dict[str, Any],
+        num_envs: int = 4,
+        seed: int = 0,
+        explore: str = "sample",  # sample | epsilon
+    ):
+        import jax
+
+        from .env import VectorEnv
+        from .module import DiscretePolicyModule, QModule
+
+        self.env_spec = env_spec
+        self.vec = VectorEnv(env_spec, num_envs, seed)
+        kind = module_spec.get("kind", "policy")
+        if kind == "policy":
+            self.module = DiscretePolicyModule(
+                module_spec["obs_dim"], module_spec["num_actions"],
+                module_spec.get("hidden", (64, 64)),
+            )
+        else:
+            self.module = QModule(
+                module_spec["obs_dim"], module_spec["num_actions"],
+                module_spec.get("hidden", (64, 64)),
+            )
+        self.kind = kind
+        self.params = self.module.init(jax.random.key(seed))
+        self.rng = np.random.default_rng(seed + 1)
+        self.explore = explore
+        self.epsilon = 1.0
+        self._jit_logits = jax.jit(
+            self.module.logits if kind == "policy" else self.module.q_values
+        )
+        self._jit_value = jax.jit(self.module.value) if kind == "policy" else None
+
+    def set_weights(self, params, epsilon: Optional[float] = None):
+        self.params = params
+        if epsilon is not None:
+            self.epsilon = epsilon
+        return "ok"
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps per env. Returns flat [T*N, ...] arrays plus
+        bootstrap values and episode metrics."""
+        import jax.numpy as jnp
+
+        obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
+        for _ in range(num_steps):
+            obs = self.vec.obs
+            out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs)))
+            if self.kind == "policy":
+                z = out - out.max(-1, keepdims=True)
+                p = np.exp(z)
+                p /= p.sum(-1, keepdims=True)
+                if self.explore == "sample":
+                    actions = np.array(
+                        [self.rng.choice(len(pi), p=pi) for pi in p], np.int32
+                    )
+                else:
+                    actions = p.argmax(-1).astype(np.int32)
+                logp = np.log(p[np.arange(len(actions)), actions] + 1e-9)
+                values = np.asarray(self._jit_value(self.params, jnp.asarray(obs)))
+            else:  # epsilon-greedy over q-values
+                greedy = out.argmax(-1)
+                rand = self.rng.integers(0, out.shape[-1], size=len(greedy))
+                mask = self.rng.random(len(greedy)) < self.epsilon
+                actions = np.where(mask, rand, greedy).astype(np.int32)
+                logp = np.zeros(len(actions), np.float32)
+                values = np.zeros(len(actions), np.float32)
+            next_obs, rewards, dones = self.vec.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            done_l.append(dones)
+            logp_l.append(logp)
+            val_l.append(values)
+        # bootstrap value of the final obs (PPO/GAE)
+        if self.kind == "policy":
+            last_values = np.asarray(
+                self._jit_value(self.params, jnp.asarray(self.vec.obs))
+            )
+        else:
+            last_values = np.zeros(self.vec.num_envs, np.float32)
+        return {
+            "obs": np.stack(obs_l),            # [T, N, D]
+            "actions": np.stack(act_l),        # [T, N]
+            "rewards": np.stack(rew_l),        # [T, N]
+            "dones": np.stack(done_l),         # [T, N]
+            "logp": np.stack(logp_l),          # [T, N]
+            "values": np.stack(val_l),         # [T, N]
+            "last_values": last_values,        # [N]
+            "next_obs": self.vec.obs.copy(),   # [N, D]
+            "metrics": self.vec.drain_metrics(),
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        """Greedy episode returns on a fresh env."""
+        import jax.numpy as jnp
+
+        from .env import make_env
+
+        env = make_env(self.env_spec)
+        total = 0.0
+        for ep in range(num_episodes):
+            obs = env.reset(seed=1000 + ep)
+            done, ret = False, 0.0
+            while not done:
+                out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs[None])))
+                obs, r, done, _ = env.step(int(out[0].argmax()))
+                ret += r
+            total += ret
+        return total / num_episodes

@@ -1,0 +1,71 @@
+"""RLModule: the neural networks, pure-pytree + functional (analogue of the
+reference's rllib/core/rl_module/rl_module.py, jax-native instead of torch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, sizes: Sequence[int]) -> list:
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        params.append(
+            {
+                "w": jax.random.normal(k, (fan_in, fan_out)) * (2.0 / fan_in) ** 0.5,
+                "b": jnp.zeros((fan_out,)),
+            }
+        )
+    return params
+
+
+def mlp_forward(params: list, x: jnp.ndarray) -> jnp.ndarray:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class DiscretePolicyModule:
+    """Separate policy and value MLPs over a flat observation."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        kp, kv = jax.random.split(key)
+        return {
+            "pi": init_mlp(kp, (self.obs_dim, *self.hidden, self.num_actions)),
+            "vf": init_mlp(kv, (self.obs_dim, *self.hidden, 1)),
+        }
+
+    @staticmethod
+    def logits(params, obs):
+        return mlp_forward(params["pi"], obs)
+
+    @staticmethod
+    def value(params, obs):
+        return mlp_forward(params["vf"], obs)[..., 0]
+
+
+class QModule:
+    """Q-network (+ the same arch reused for the DQN target net)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        return {"q": init_mlp(key, (self.obs_dim, *self.hidden, self.num_actions))}
+
+    @staticmethod
+    def q_values(params, obs):
+        return mlp_forward(params["q"], obs)

@@ -111,7 +111,10 @@ def _run_to_completion(
     import os as _os
 
     storage.save_status(
-        WorkflowStatus.RUNNING, started_at=time.time(), driver_pid=_os.getpid()
+        WorkflowStatus.RUNNING,
+        started_at=time.time(),
+        driver_pid=_os.getpid(),
+        error=None,  # clear any stale failure from a previous attempt
     )
     try:
         result = _execute(storage, dag, input_args, input_kwargs, max_step_retries)
