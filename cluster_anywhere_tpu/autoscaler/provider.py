@@ -1,0 +1,120 @@
+"""Node providers (analogue of the reference's
+python/ray/autoscaler/node_provider.py NodeProvider + the fake_multi_node
+local provider used in its tests).
+
+A "node" contributes a fixed resource shape to the cluster. The
+LocalNodeProvider launches real worker processes that register with the head
+(the in-process analogue of launching a VM) and credits their capacity via
+the head's update_resources RPC.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class NodeType:
+    name: str
+    resources: Dict[str, float]
+    max_nodes: int = 4
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    node_type: str
+    state: str = "running"  # launching | running | terminated
+    created_at: float = field(default_factory=time.monotonic)
+    resources: Dict[str, float] = field(default_factory=dict)
+    handle: Any = None  # provider-private
+
+
+class NodeProvider:
+    def create_node(self, node_type: NodeType) -> NodeInfo:
+        raise NotImplementedError
+
+    def terminate_node(self, node: NodeInfo) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[NodeInfo]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Launches worker processes against the connected cluster. Each "node"
+    is `workers_per_node` pool worker processes plus a capacity credit."""
+
+    def __init__(self, workers_per_node: Optional[int] = None):
+        from ..core.worker import global_worker
+
+        self.w = global_worker()
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.workers_per_node = workers_per_node
+
+    def _spawn_worker(self, node_id: str, index: int) -> subprocess.Popen:
+        w = self.w
+        wid = f"ext-{node_id}-{index}"
+        addr = os.path.join(w.session_dir, f"{wid}.sock")
+        env = dict(os.environ)
+        env["CA_SESSION_DIR"] = w.session_dir
+        env["CA_HEAD_SOCK"] = w.head_sock
+        env["CA_WORKER_ID"] = wid
+        env["CA_WORKER_SOCK"] = addr
+        env["CA_CONFIG_JSON"] = w.config.to_json()
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        logf = open(os.path.join(w.session_dir, f"{wid}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_anywhere_tpu.core.workerproc"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        logf.close()
+        return proc
+
+    def create_node(self, node_type: NodeType) -> NodeInfo:
+        node_id = uuid.uuid4().hex[:8]
+        n_workers = self.workers_per_node or max(1, int(node_type.resources.get("CPU", 1)))
+        procs = [self._spawn_worker(node_id, i) for i in range(n_workers)]
+        self.w.head_call("update_resources", delta=dict(node_type.resources))
+        info = NodeInfo(
+            node_id=node_id,
+            node_type=node_type.name,
+            resources=dict(node_type.resources),
+            handle=procs,
+        )
+        self.nodes[node_id] = info
+        return info
+
+    def terminate_node(self, node: NodeInfo) -> None:
+        import signal
+
+        if node.state == "terminated":
+            return
+        node.state = "terminated"
+        for p in node.handle or []:
+            try:
+                os.kill(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        # debit the capacity this node contributed
+        if node.resources:
+            delta = {k: -v for k, v in node.resources.items()}
+            self.w.head_call("update_resources", delta=delta)
+        self.nodes.pop(node.node_id, None)
+
+    def non_terminated_nodes(self) -> List[NodeInfo]:
+        return [n for n in self.nodes.values() if n.state != "terminated"]

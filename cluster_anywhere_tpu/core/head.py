@@ -897,6 +897,28 @@ class Head:
     async def _h_metrics_snapshot(self, state, msg, reply, reply_err):
         reply(metrics=self.metrics)
 
+    async def _h_autoscaler_state(self, state, msg, reply, reply_err):
+        """What the autoscaler reconciler consumes (autoscaler.proto analogue):
+        pending demand shapes + current utilization."""
+        reply(
+            pending_demands=[dict(r.shape) for r in self.pending_leases],
+            total=self.total_resources,
+            available=self.avail,
+            idle_workers=sum(len(d) for d in self.idle_workers.values()),
+            n_workers=sum(1 for w in self.workers.values() if w.state != "dead"),
+        )
+
+    async def _h_update_resources(self, state, msg, reply, reply_err):
+        """Autoscaler grows/shrinks node capacity as provider nodes join/leave."""
+        delta = msg.get("delta") or {}
+        for k, v in delta.items():
+            self.total_resources[k] = self.total_resources.get(k, 0.0) + v
+            self.avail[k] = self.avail.get(k, 0.0) + v
+        self.max_workers = int(self.total_resources.get("CPU", 4)) * 4 + 4
+        self._log_event("resources_updated", delta=delta, total=self.total_resources)
+        self._service_queue()
+        reply(total=self.total_resources)
+
     async def _h_job_stop(self, state, msg, reply, reply_err):
         reply()
         self._shutdown.set()
