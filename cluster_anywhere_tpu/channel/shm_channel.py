@@ -28,6 +28,7 @@ import mmap
 import os
 import queue as _queue
 import struct
+import threading as _threading
 import time
 import uuid
 from typing import Any, List, Optional
@@ -107,6 +108,13 @@ class ShmChannel(ChannelInterface):
         self.path = path
         self.capacity = len(self._mm) - self.header_size
         self._last_spill = None
+        # waiter accounting so release() can't unmap the segment while another
+        # thread is blocked in the native futex wait on a raw address inside
+        # it (ADVICE r1: use-after-unmap). _released is process-local (unlike
+        # the shared close flag, which would close the channel for everyone).
+        self._released = False
+        self._waiters = 0
+        self._waiters_lock = _threading.Lock()
         # native futex wait/wake (microsecond wakeups, no spin): fall back to
         # 20us polling when the native library is unavailable
         self._fx = None
@@ -139,6 +147,8 @@ class ShmChannel(ChannelInterface):
     def _wait_ge(self, idx: int, min_val: int, deadline) -> None:
         """Block until word[idx] >= min_val, honoring close flag + deadline."""
         while True:
+            if self._released:
+                raise ChannelClosedError
             if self._get(idx) >= min_val:
                 return
             if self._get(3) & _FLAG_CLOSED:
@@ -192,6 +202,19 @@ class ShmChannel(ChannelInterface):
         self._set(2, len(payload) | (_SPILL_BIT if spilled else 0))
         self._set_wake(1, want + 1)  # publish + wake readers
 
+    def _enter(self):
+        """Mark this thread as touching the segment (native or mmap) so a
+        concurrent release() cannot unmap under it; the whole read()/write()
+        critical section is covered, not just the futex wait."""
+        with self._waiters_lock:
+            if self._released:
+                raise ChannelClosedError
+            self._waiters += 1
+
+    def _exit(self):
+        with self._waiters_lock:
+            self._waiters -= 1
+
     def write(self, value: Any, timeout: Optional[float] = None):
         from ..core.serialization import pack
 
@@ -204,7 +227,11 @@ class ShmChannel(ChannelInterface):
 
             ref = ca.put(value)
             payload, spilled = pack(ref), True
-        self._write_payload(payload, spilled, deadline)
+        self._enter()
+        try:
+            self._write_payload(payload, spilled, deadline)
+        finally:
+            self._exit()
         # _write_payload waited for all acks of the previous version, and
         # readers only ack after fetching a spilled payload — so the prior
         # spilled object (if any) has been consumed.  Drop its ref, and keep
@@ -215,32 +242,69 @@ class ShmChannel(ChannelInterface):
         from ..core.serialization import unpack
 
         deadline = None if timeout is None else _now() + timeout
-        my_ack = self._get(5 + self.reader_index)
-        self._wait_ge(1, my_ack + 1, deadline)
-        ver = self.version
-        ln = self._get(2)
-        spilled = bool(ln & _SPILL_BIT)
-        ln &= ~_SPILL_BIT
-        value = unpack(bytes(self._mm[self.header_size : self.header_size + ln]))
+        self._enter()
+        try:
+            my_ack = self._get(5 + self.reader_index)
+            self._wait_ge(1, my_ack + 1, deadline)
+            ver = self.version
+            ln = self._get(2)
+            spilled = bool(ln & _SPILL_BIT)
+            ln &= ~_SPILL_BIT
+            value = unpack(bytes(self._mm[self.header_size : self.header_size + ln]))
+        finally:
+            self._exit()
         if spilled:
             from ..core import api as ca
 
             # fetch BEFORE acking: the ack is what lets the writer's next
             # write drop its reference to this spilled object
             value = ca.get(value)
-        self._set_wake(5 + self.reader_index, ver)
+        try:
+            self._enter()
+            try:
+                self._set_wake(5 + self.reader_index, ver)
+            finally:
+                self._exit()
+        except ChannelClosedError:
+            pass  # released mid-read: the ack is writer bookkeeping only —
+            # the value was already read in full, so deliver it
         return value
 
     def close(self):
-        self._set(3, _FLAG_CLOSED)
-        if self._fx is not None:
-            # wake WITHOUT storing: a read-modify-store here could roll back a
-            # concurrent publish/ack; sleepers re-check and see the flag
-            self._fx.ca_wake_u64(self._addr + 8)
-            for r in range(self.num_readers):
-                self._fx.ca_wake_u64(self._addr + 8 * (5 + r))
+        try:
+            self._enter()
+        except ChannelClosedError:
+            return  # already released locally; nothing to flag
+        try:
+            self._set(3, _FLAG_CLOSED)
+            if self._fx is not None:
+                # wake WITHOUT storing: a read-modify-store here could roll
+                # back a concurrent publish/ack; sleepers re-check the flag
+                self._fx.ca_wake_u64(self._addr + 8)
+                for r in range(self.num_readers):
+                    self._fx.ca_wake_u64(self._addr + 8 * (5 + r))
+        finally:
+            self._exit()
 
     def release(self):
+        # flip the process-local flag, wake local sleepers, then wait for
+        # every native waiter to leave the segment before unmapping (each
+        # waiter's slice is <=50ms, so this drains quickly; cap at 2s so a
+        # wedged waiter can't hang release forever — leaking the map is
+        # better than a segfault)
+        self._released = True
+        if self._fx is not None and self._addr:
+            try:
+                self._fx.ca_wake_u64(self._addr + 8)
+                for r in range(self.num_readers):
+                    self._fx.ca_wake_u64(self._addr + 8 * (5 + r))
+            except Exception:
+                pass
+        deadline = _now() + 2.0
+        while self._waiters and _now() < deadline:
+            time.sleep(0.001)
+        if self._waiters:
+            return  # leak the mapping rather than unmap under a waiter
         try:
             self._mm.close()
         except Exception:

@@ -107,7 +107,7 @@ class PGRec:
     pg_id: str
     bundles: List[BundleRec]
     strategy: str
-    state: str = "created"  # single-node: reservations either fit or error
+    state: str = "created"  # "pending" until resources free up, then "created"
 
 
 # --------------------------------------------------------------------------
@@ -133,6 +133,8 @@ class Head:
         self._early_refs: Dict[bytes, set] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.pgs: Dict[str, PGRec] = {}
+        self.pending_pgs: deque = deque()  # PG ids awaiting resources, FIFO
+        self._pg_waiters: Dict[str, List[asyncio.Future]] = {}
         # -- worker pool (keyed: cpu workers strip the TPU runtime env for
         # fast start and to keep the chip free; tpu workers keep it) --
         self.idle_workers: Dict[str, deque] = {"cpu": deque(), "tpu": deque()}
@@ -294,6 +296,12 @@ class Head:
     def _try_grant(self, req: LeaseReq) -> bool:
         # resource admission: from a PG bundle or the node pool
         if req.pg_id:
+            pg = self.pgs.get(req.pg_id)
+            if pg is not None and pg.state != "created":
+                # bundles of a pending PG were never deducted from avail;
+                # granting against them would oversubscribe the node — wait
+                # (requeue) until _service_pending_pgs creates the PG
+                return False
             avail = self._bundle_avail(req.pg_id, req.bundle_index)
             if avail is None:
                 req.reply_err(PlacementGroupError(f"placement group {req.pg_id} not found"))
@@ -327,6 +335,9 @@ class Head:
         return True
 
     def _service_queue(self):
+        # pending PGs reserve first: their creation was requested before the
+        # queued leases could possibly run inside them
+        self._service_pending_pgs()
         made_progress = True
         while made_progress and self.pending_leases:
             made_progress = False
@@ -365,6 +376,17 @@ class Head:
         """Spawn a dedicated worker and run the actor creation task on it.
         Mirrors GcsActorScheduler: lease resources, push creation, publish."""
         if a.pg_id:
+            pg = self.pgs.get(a.pg_id)
+            if pg is not None and pg.state == "pending":
+                # wait for the PG's resources to actually be reserved; placing
+                # into a pending PG would charge a bundle whose capacity was
+                # never taken from avail (oversubscription)
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._pg_waiters.setdefault(a.pg_id, []).append(fut)
+                try:
+                    await fut
+                except PlacementGroupError:
+                    pass  # removed while pending: falls through to dead below
             avail = self._bundle_avail(a.pg_id, a.bundle_index)
             ok = avail is not None and self._fits(avail, a.resources)
             if ok:
@@ -745,34 +767,105 @@ class Head:
                         del self._early_refs[oid]
 
     # placement groups ------------------------------------------------------
-    async def _h_create_pg(self, state, msg, reply, reply_err):
-        bundles = [BundleRec(resources=b) for b in msg["bundles"]]
+    @staticmethod
+    def _pg_demand(bundles: List[BundleRec]) -> Dict[str, float]:
         total: Dict[str, float] = {}
         for b in bundles:
             for k, v in b.resources.items():
                 total[k] = total.get(k, 0.0) + v
-        if not self._fits(self.avail, total):
+        return total
+
+    async def _h_create_pg(self, state, msg, reply, reply_err):
+        """PG semantics mirror GcsPlacementGroupManager: infeasible only if
+        the demand exceeds the cluster's TOTAL capacity; a PG that fits total
+        but not currently-free resources is PENDING and is created FIFO as
+        leases/actors/PGs release resources (pg_wait blocks on it)."""
+        bundles = [BundleRec(resources=b) for b in msg["bundles"]]
+        total = self._pg_demand(bundles)
+        if not self._fits(self.total_resources, total):
             reply_err(
                 PlacementGroupError(
-                    f"infeasible placement group: need {total}, available {self.avail}"
+                    f"infeasible placement group: need {total}, "
+                    f"cluster total {self.total_resources}"
                 )
             )
             return
-        self._take(self.avail, total)
-        self.pgs[msg["pg_id"]] = PGRec(
+        rec = PGRec(
             pg_id=msg["pg_id"], bundles=bundles, strategy=msg.get("strategy", "PACK")
         )
-        self._log_event("pg_created", pg_id=msg["pg_id"], bundles=len(bundles))
-        reply()
+        if self._fits(self.avail, total):
+            self._take(self.avail, total)
+            rec.state = "created"
+            self._log_event("pg_created", pg_id=rec.pg_id, bundles=len(bundles))
+        else:
+            rec.state = "pending"
+            self.pending_pgs.append(rec.pg_id)
+            self._log_event("pg_pending", pg_id=rec.pg_id, bundles=len(bundles))
+        self.pgs[rec.pg_id] = rec
+        reply(state=rec.state)
+
+    def _service_pending_pgs(self):
+        """Create pending PGs FIFO as resources free up (no overtaking: a
+        large PG at the head of the queue is not starved by later small ones)."""
+        while self.pending_pgs:
+            pgid = self.pending_pgs[0]
+            rec = self.pgs.get(pgid)
+            if rec is None or rec.state != "pending":
+                self.pending_pgs.popleft()
+                continue
+            total = self._pg_demand(rec.bundles)
+            if not self._fits(self.avail, total):
+                break
+            self._take(self.avail, total)
+            rec.state = "created"
+            self.pending_pgs.popleft()
+            self._log_event("pg_created", pg_id=pgid, bundles=len(rec.bundles))
+            self._wake_pg_waiters(pgid)
+
+    def _wake_pg_waiters(self, pgid: str, exc: Optional[BaseException] = None):
+        for fut in self._pg_waiters.pop(pgid, []):
+            if not fut.done():
+                if exc is None:
+                    fut.set_result(True)
+                else:
+                    fut.set_exception(exc)
+
+    async def _h_pg_wait(self, state, msg, reply, reply_err):
+        """Block until the PG is created (or removed / timeout)."""
+        pgid = msg["pg_id"]
+        rec = self.pgs.get(pgid)
+        if rec is None:
+            reply_err(PlacementGroupError(f"placement group {pgid} not found"))
+            return
+        if rec.state == "created":
+            reply(ready=True)
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pg_waiters.setdefault(pgid, []).append(fut)
+        try:
+            # field is named wait_timeout because Connection.call() consumes
+            # a kwarg named `timeout` as the RPC deadline instead of sending it
+            await asyncio.wait_for(fut, msg.get("wait_timeout"))
+            reply(ready=True)
+        except asyncio.TimeoutError:
+            reply(ready=False)
+        except PlacementGroupError as e:
+            reply_err(e)
 
     async def _h_remove_pg(self, state, msg, reply, reply_err):
         pg = self.pgs.pop(msg["pg_id"], None)
         if pg is not None:
-            total: Dict[str, float] = {}
-            for b in pg.bundles:
-                for k, v in b.resources.items():
-                    total[k] = total.get(k, 0.0) + v
-            self._give(self.avail, total)
+            if pg.state == "created":
+                self._give(self.avail, self._pg_demand(pg.bundles))
+            else:
+                try:
+                    self.pending_pgs.remove(msg["pg_id"])
+                except ValueError:
+                    pass
+            self._wake_pg_waiters(
+                msg["pg_id"],
+                PlacementGroupError(f"placement group {msg['pg_id']} removed"),
+            )
             self._service_queue()
         reply()
 

@@ -4,9 +4,16 @@ A placement group atomically reserves a list of resource bundles; tasks and
 actors scheduled into a bundle consume from that reservation.  Strategies
 (PACK/SPREAD/STRICT_PACK/STRICT_SPREAD) control node placement; on the
 current single-node milestone they are recorded and validated but equivalent.
+
+A PG whose demand exceeds the cluster's *total* capacity errors immediately
+(truly infeasible); one that merely exceeds currently-free resources is
+PENDING and created FIFO as resources release — ready()/wait() block on that
+(mirrors GcsPlacementGroupManager's PENDING->CREATED lifecycle).
 """
 
 from __future__ import annotations
+
+import asyncio
 
 from typing import Dict, List, Optional
 
@@ -27,12 +34,32 @@ class PlacementGroup:
         return self.bundles
 
     def ready(self):
-        """Returns an ObjectRef resolving when the PG is created (already
-        created synchronously on this milestone)."""
-        return global_worker().put(True)
+        """Returns an ObjectRef that resolves (to True) once the head has
+        reserved all bundles — immediately for a created PG, later for a
+        pending one; errors if the PG is removed while pending."""
+        w = global_worker()
+        ref = w.new_owned_ref()
+        oid = ref.id
+        pg_hex = self.id.hex()
+
+        async def _wait():
+            try:
+                await w.head.call("pg_wait", pg_id=pg_hex)
+                w.memory_store.put_value(oid, True)
+            except BaseException as e:  # noqa: BLE001 - propagate via the ref
+                w.memory_store.put_error(oid, e)
+
+        asyncio.run_coroutine_threadsafe(_wait(), w.loop)
+        return ref
 
     def wait(self, timeout_seconds: float = 30) -> bool:
-        return True
+        try:
+            r = global_worker().head_call(
+                "pg_wait", pg_id=self.id.hex(), wait_timeout=timeout_seconds
+            )
+        except PlacementGroupError:
+            return False
+        return bool(r.get("ready"))
 
     def __reduce__(self):
         return (PlacementGroup, (self.id, self.bundles))

@@ -402,14 +402,20 @@ class Worker:
             del self._connecting[addr]
 
     # ------------------------------------------------------------------ put
+    def new_owned_ref(self) -> ObjectRef:
+        """Allocate a fresh owned ObjectRef with no value yet; the caller
+        fulfills it later via memory_store.put_value/put_error (used by put()
+        and by futures like PlacementGroup.ready())."""
+        task_id = self.current_task_id or TaskID.for_normal_task(self.job_id)
+        oid = ObjectID.for_put(task_id, self._put_counter.next())
+        self.reference_counter.add_owned(oid)
+        return ObjectRef(oid, owner=self.client_id, worker=self)
+
     def put(self, value: Any) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("put() of an ObjectRef is not allowed")
-        task_id = self.current_task_id or TaskID.for_normal_task(self.job_id)
-        oid = ObjectID.for_put(task_id, self._put_counter.next())
-        self._put_value(oid, value)
-        ref = ObjectRef(oid, owner=self.client_id, worker=self)
-        self.reference_counter.add_owned(oid)
+        ref = self.new_owned_ref()
+        self._put_value(ref.id, value)
         return ref
 
     def _put_value(self, oid: ObjectID, value: Any):

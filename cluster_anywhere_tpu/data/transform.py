@@ -107,15 +107,50 @@ def apply_transform(spec: Dict[str, Any], block: Block) -> Iterator[Block]:
         yield t
     elif kind == "drop_columns":
         t = acc.to_arrow()
-        yield t.drop_columns([c for c in args[0] if c in t.column_names])
+        dropped = [c for c in args[0] if c in t.column_names]
+        t = t.drop_columns(dropped)
+        yield _remap_tensor_meta(t, {c: None for c in dropped})
     elif kind == "select_columns":
-        yield acc.to_arrow().select(list(args[0]))
+        keep = list(args[0])
+        t = acc.to_arrow().select(keep)
+        all_names = set(keep)
+        yield _remap_tensor_meta(
+            t, {}, keep=all_names
+        )
     elif kind == "rename_columns":
         mapping = args[0]
         t = acc.to_arrow()
-        yield t.rename_columns([mapping.get(c, c) for c in t.column_names])
+        t = t.rename_columns([mapping.get(c, c) for c in t.column_names])
+        yield _remap_tensor_meta(t, mapping)
     else:
         raise ValueError(f"unknown transform kind {kind}")
+
+
+def _remap_tensor_meta(t, mapping, keep=None):
+    """Rewrite 'tensor:<name>' schema-metadata keys through a column rename.
+
+    mapping: old-name -> new-name, or -> None to drop the key (drop_columns).
+    keep: if given, only names in this set survive (select_columns).
+    Without this, a renamed tensor column loses its shape mapping and decodes
+    as flat per-row lists (ADVICE r1)."""
+    meta = t.schema.metadata or {}
+    if not meta:
+        return t
+    out = {}
+    for k, v in meta.items():
+        ks = k.decode() if isinstance(k, bytes) else k
+        if ks.startswith("tensor:"):
+            name = ks[len("tensor:"):]
+            if keep is not None and name not in keep:
+                continue
+            if name in mapping:
+                new = mapping[name]
+                if new is None:
+                    continue
+                out[f"tensor:{new}".encode()] = v
+                continue
+        out[k] = v
+    return t.replace_schema_metadata(out)
 
 
 def _rows_to_block(rows: List[dict]) -> Block:

@@ -255,9 +255,12 @@ class JobSubmissionClient:
 
     def wait_until_finish(self, submission_id: str, timeout_s: float = 300.0) -> str:
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        while True:
             status = self.get_job_status(submission_id)
             if status in (SUCCEEDED, FAILED, STOPPED):
                 return status
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {submission_id} still {status} after {timeout_s}s"
+                )
             time.sleep(0.2)
-        raise TimeoutError(f"job {submission_id} still {status} after {timeout_s}s")

@@ -111,8 +111,10 @@ class _InferenceWorker:
         # compiled program (per-batch max length would recompile per shape)
         max_len = cfg.max_prompt_len
         ids = np.full((len(encoded), max_len), self.tok.pad_id, np.int32)
+        lens = np.empty(len(encoded), np.int32)
         for i, e in enumerate(encoded):
             ids[i, max_len - len(e):] = e
+            lens[i] = len(e)
         self._step += 1
         out = generate(
             self.params,
@@ -122,6 +124,7 @@ class _InferenceWorker:
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             top_k=top_k,
+            prompt_lens=jnp.asarray(lens),
         )
         out = np.asarray(out)
         texts = [self.tok.decode(row) for row in out]

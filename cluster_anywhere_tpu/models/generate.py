@@ -45,14 +45,18 @@ def _mlp(bp, x, cfg):
     return x + gated @ bp["w_down"].astype(dt)
 
 
-def _masked_attention(q, k_cache, v_cache, valid_len, cfg: TransformerConfig):
-    """q: [B, Tq, H, D]; caches: [B, T_max, H, D]; positions >= valid_len are
-    masked out. For decode Tq == 1."""
+def _masked_attention(q, k_cache, v_cache, valid_len, cfg: TransformerConfig, pad=None):
+    """q: [B, Tq, H, D]; caches: [B, T_max, H, D]; cache slots >= valid_len are
+    masked out, as are slots < pad[b] (left-padding of the prompt; pad is a
+    per-row [B] count of pad tokens, None = no padding). For decode Tq == 1."""
     scale = cfg.d_head ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32))
     logits = logits * scale
     t_max = k_cache.shape[1]
-    mask = jnp.arange(t_max)[None, None, None, :] < valid_len
+    slots = jnp.arange(t_max)[None, None, None, :]
+    mask = slots < valid_len
+    if pad is not None:
+        mask = mask & (slots >= pad[:, None, None, None])
     logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
@@ -66,29 +70,41 @@ def init_cache(cfg: TransformerConfig, batch: int, t_max: int):
     }
 
 
-def _block_decode(bp, x, layer_cache, pos, cfg: TransformerConfig):
-    """One block, one token. x: [B, 1, E]; layer_cache: (k,v) [B,Tmax,KV,D]."""
+def _block_decode(bp, x, layer_cache, pos, cfg: TransformerConfig, pad=None):
+    """One block, one token. x: [B, 1, E]; layer_cache: (k,v) [B,Tmax,KV,D].
+    pad: [B] left-pad counts — the RoPE position of the token written at cache
+    slot `pos` is `pos - pad[b]` so each row's positions count real tokens."""
     k_cache, v_cache = layer_cache
     y = _rms_norm(x, bp["ln1"])
     q, k, v = _project_qkv(bp, y, cfg)
-    positions = jnp.array([0]) + pos  # [1]
+    if pad is None:
+        positions = jnp.array([0]) + pos  # [1]
+    else:
+        positions = (pos - pad)[:, None]  # [B, 1]
     q, k = _rope(q, k, positions, cfg)
     k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
     attn = _masked_attention(
-        q, _gqa_repeat(k_cache, cfg), _gqa_repeat(v_cache, cfg), pos + 1, cfg
+        q, _gqa_repeat(k_cache, cfg), _gqa_repeat(v_cache, cfg), pos + 1, cfg, pad
     )
     b = x.shape[0]
     x = x + attn.reshape(b, 1, -1) @ bp["wo"].astype(x.dtype)
     return _mlp(bp, x, cfg), (k_cache, v_cache)
 
 
-def _prefill_block(bp, x, pos0, cfg: TransformerConfig, t_max: int):
-    """One block over the whole prompt; returns padded caches [B,Tmax,KV,D]."""
+def _prefill_block(bp, x, pad, cfg: TransformerConfig, t_max: int):
+    """One block over the whole prompt; returns padded caches [B,Tmax,KV,D].
+    pad: [B] per-row left-pad counts or None. Real tokens sit at columns
+    [pad[b], T); they get RoPE positions starting at 0 and never attend to
+    pad-token keys (ADVICE r1: unmasked pads skewed generation)."""
     b, t, _ = x.shape
     y = _rms_norm(x, bp["ln1"])
     q, k, v = _project_qkv(bp, y, cfg)
-    q, k = _rope(q, k, jnp.arange(t), cfg)
+    if pad is None:
+        positions = jnp.arange(t)
+    else:
+        positions = jnp.maximum(jnp.arange(t)[None, :] - pad[:, None], 0)  # [B,T]
+    q, k = _rope(q, k, positions, cfg)
     k_cache = jnp.zeros((b, t_max, cfg.n_kv_heads, cfg.d_head), x.dtype)
     v_cache = jnp.zeros_like(k_cache)
     k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
@@ -100,8 +116,11 @@ def _prefill_block(bp, x, pos0, cfg: TransformerConfig, t_max: int):
     vr = _gqa_repeat(v, cfg)
     scale = cfg.d_head ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", qr.astype(jnp.float32), kr.astype(jnp.float32)) * scale
-    causal = jnp.tril(jnp.ones((t, t), bool))
-    logits = jnp.where(causal[None, None], logits, -1e30)
+    mask = jnp.tril(jnp.ones((t, t), bool))[None, None]  # [1,1,T,T]
+    if pad is not None:
+        key_ok = jnp.arange(t)[None, :] >= pad[:, None]  # [B,T]
+        mask = mask & key_ok[:, None, None, :]
+    logits = jnp.where(mask, logits, -1e30)
     attn = jnp.einsum(
         "bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1).astype(x.dtype), vr
     ).reshape(b, t, -1)
@@ -109,12 +128,13 @@ def _prefill_block(bp, x, pos0, cfg: TransformerConfig, t_max: int):
     return _mlp(bp, x, cfg), (k_cache, v_cache)
 
 
-def prefill(params, ids, cfg: TransformerConfig, t_max: int):
-    """ids: [B, T_prompt] -> (last-token logits [B, V], cache)."""
+def prefill(params, ids, cfg: TransformerConfig, t_max: int, pad=None):
+    """ids: [B, T_prompt] -> (last-token logits [B, V], cache).
+    pad: optional [B] left-pad counts (see _prefill_block)."""
     x = params["embed"].astype(cfg.dtype)[ids]
 
     def body(x, bp):
-        x, (kc, vc) = _prefill_block(bp, x, 0, cfg, t_max)
+        x, (kc, vc) = _prefill_block(bp, x, pad, cfg, t_max)
         return x, (kc, vc)
 
     blocks = params["blocks"]
@@ -124,13 +144,13 @@ def prefill(params, ids, cfg: TransformerConfig, t_max: int):
     return logits.astype(jnp.float32), {"k": k_all, "v": v_all}
 
 
-def decode_one(params, cache, token, pos, cfg: TransformerConfig):
+def decode_one(params, cache, token, pos, cfg: TransformerConfig, pad=None):
     """token: [B] -> (logits [B, V], updated cache)."""
     x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B,1,E]
 
     def body(x, inputs):
         bp, kc, vc = inputs
-        x, (kc, vc) = _block_decode(bp, x, (kc, vc), pos, cfg)
+        x, (kc, vc) = _block_decode(bp, x, (kc, vc), pos, cfg, pad)
         return x, (kc, vc)
 
     x, (k_all, v_all) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
@@ -162,18 +182,23 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: int = 0,
+    prompt_lens: Optional[jax.Array] = None,
 ) -> jax.Array:
     """prompt_ids: [B, T_prompt] int32 -> generated ids [B, max_new_tokens].
-    One compiled program: prefill + a lax.scan of decode steps."""
+    One compiled program: prefill + a lax.scan of decode steps.
+    prompt_lens: optional [B] int32 count of real (rightmost) tokens per row
+    when prompts are left-padded to a fixed T_prompt; pads are masked out of
+    attention and RoPE positions count real tokens only."""
     b, t_prompt = prompt_ids.shape
     t_max = t_prompt + max_new_tokens
-    logits, cache = prefill(params, prompt_ids, cfg, t_max)
+    pad = None if prompt_lens is None else (t_prompt - prompt_lens).astype(jnp.int32)
+    logits, cache = prefill(params, prompt_ids, cfg, t_max, pad)
     rngs = jax.random.split(rng, max_new_tokens)
     first = _sample(logits, rngs[0], temperature, top_k)
 
     def step(carry, rng_i):
         token, cache, pos = carry
-        logits, cache = decode_one(params, cache, token, pos, cfg)
+        logits, cache = decode_one(params, cache, token, pos, cfg, pad)
         nxt = _sample(logits, rng_i, temperature, top_k)
         return (nxt, cache, pos + 1), nxt
 

@@ -149,12 +149,16 @@ def _rms_norm(x, w, eps=1e-6):
 
 
 def _rope(q, k, positions, cfg: TransformerConfig):
-    """Rotary embeddings; q,k: [B, T, H, D], positions: [T] global positions."""
+    """Rotary embeddings; q,k: [B, T, H, D]. positions: [T] global positions,
+    or [B, T] per-row positions (left-padded prompts shift each row's real
+    tokens to start at position 0)."""
     d = cfg.d_head
     freqs = cfg.rope_theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, D/2]
+    if angles.ndim == 2:
+        angles = angles[None]  # broadcast over batch
+    cos = jnp.cos(angles)[:, :, None, :]  # [B|1, T, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
 
     def rot(x):
         x1, x2 = x[..., 0::2], x[..., 1::2]

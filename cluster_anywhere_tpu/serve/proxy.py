@@ -119,23 +119,44 @@ class ProxyActor:
             return
         asyncio.get_running_loop().create_task(self._dispatch(req, writer))
 
+    # request-size guards (ADVICE r1: unbounded header/body reads let a
+    # client exhaust proxy memory); generous defaults, overridable per proxy
+    MAX_HEADER_LINE = 16 * 1024
+    MAX_HEADERS = 128
+    MAX_BODY = 64 * 1024 * 1024
+
     async def _read_request(self, reader) -> Optional[Request]:
-        line = await reader.readline()
-        if not line:
+        try:
+            line = await reader.readline()
+        except (asyncio.LimitOverrunError, ValueError):
+            return None
+        if not line or len(line) > self.MAX_HEADER_LINE:
             return None
         try:
             method, target, _ = line.decode("latin1").split(" ", 2)
         except ValueError:
             return None
         headers: Dict[str, str] = {}
+        n_lines = 0  # count lines, not dict keys: repeated names must still trip the cap
         while True:
-            h = await reader.readline()
+            try:
+                h = await reader.readline()
+            except (asyncio.LimitOverrunError, ValueError):
+                return None
             if h in (b"\r\n", b"\n", b""):
                 break
+            n_lines += 1
+            if len(h) > self.MAX_HEADER_LINE or n_lines > self.MAX_HEADERS:
+                return None
             k, _, v = h.decode("latin1").partition(":")
             headers[k.strip().lower()] = v.strip()
         body = b""
-        n = int(headers.get("content-length", 0) or 0)
+        try:
+            n = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            return None
+        if n < 0 or n > self.MAX_BODY:
+            return None
         if n:
             body = await reader.readexactly(n)
         parsed = urlparse(target)

@@ -224,3 +224,68 @@ def test_resource_conservation_kill_and_remove_pg(ca_cluster):
             break
         time.sleep(0.2)
     assert ca.available_resources().get("CPU") == total
+
+
+def test_pending_pg_created_when_resources_free(ca_cluster):
+    """A PG that fits total capacity but not currently-free resources must
+    PEND (not error) and be created once blocking actors die; a PG larger
+    than total capacity errors immediately."""
+    import time
+
+    import pytest
+
+    import cluster_anywhere_tpu as ca
+    from cluster_anywhere_tpu.core.errors import PlacementGroupError
+
+    total = int(ca.cluster_resources()["CPU"])
+
+    with pytest.raises(PlacementGroupError, match="infeasible"):
+        ca.placement_group([{"CPU": float(total + 1)}])
+
+    @ca.remote
+    class Hog:
+        def ping(self):
+            return 1
+
+    hogs = [Hog.options(num_cpus=1).remote() for _ in range(total)]
+    ca.get([h.ping.remote() for h in hogs])
+
+    pg = ca.placement_group([{"CPU": 1.0}] * 2)
+    assert not pg.wait(timeout_seconds=0.3)  # pending: all CPUs held
+    ready_ref = pg.ready()
+
+    # scheduling into a pending PG must wait for its creation, not charge a
+    # bundle whose capacity was never reserved (oversubscription hazard):
+    # a task lease request queues server-side...
+    @ca.remote
+    def in_pg():
+        return "ran"
+
+    task_ref = in_pg.options(
+        num_cpus=1, placement_group=pg, placement_group_bundle_index=0
+    ).remote()
+    # ...and a blocking actor creation goes on a helper thread (create_actor
+    # replies only once placed)
+    import threading
+
+    actor_box = {}
+
+    def make_actor():
+        actor_box["actor"] = Hog.options(
+            num_cpus=1, placement_group=pg, placement_group_bundle_index=1
+        ).remote()
+
+    th = threading.Thread(target=make_actor, daemon=True)
+    th.start()
+    time.sleep(0.3)
+    assert not pg.wait(timeout_seconds=0.1)  # still pending; nothing ran early
+    for h in hogs:
+        ca.kill(h)
+    assert ca.get(ready_ref, timeout=15) is True
+    assert pg.wait(5)
+    assert ca.get(task_ref, timeout=15) == "ran"
+    th.join(timeout=15)
+    assert not th.is_alive() and "actor" in actor_box
+    assert ca.get(actor_box["actor"].ping.remote(), timeout=15) == 1
+    ca.kill(actor_box["actor"])
+    ca.remove_placement_group(pg)

@@ -43,6 +43,33 @@ def test_generate_determinism_greedy():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # greedy: rng-free
 
 
+def test_generate_left_padding_invariance():
+    """Left-padding a prompt (with prompt_lens) must not change greedy output:
+    pads are masked out of attention and RoPE counts real tokens only
+    (ADVICE r1 medium finding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_anywhere_tpu.models.generate import generate
+    from cluster_anywhere_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_head=8, d_ff=64
+    )
+    params = init_params(jax.random.key(0), cfg)
+    real = [7, 3, 11, 2, 9]
+    unpadded = jnp.array([real], jnp.int32)
+    a = generate(params, unpadded, jax.random.key(1), cfg=cfg, max_new_tokens=6)
+
+    pad_to = 12
+    padded = jnp.array([[0] * (pad_to - len(real)) + real, list(range(1, pad_to + 1))], jnp.int32)
+    lens = jnp.array([len(real), pad_to], jnp.int32)
+    b = generate(
+        params, padded, jax.random.key(2), cfg=cfg, max_new_tokens=6, prompt_lens=lens
+    )
+    np.testing.assert_array_equal(np.asarray(a)[0], np.asarray(b)[0])
+
+
 def test_batch_processor_pipeline():
     cfg = llm.ProcessorConfig(
         model=llm.ModelSpec(preset="tiny", seed=7),
