@@ -516,6 +516,16 @@ class Head:
             self.objects.pop(rec.oid, None)
             self.stats["objects_gc"] += 1
             if rec.shm_name:
+                if "@" in rec.shm_name:
+                    # arena slice: only the creating process's allocator can
+                    # reclaim it.  That is NOT rec.owner (task returns are
+                    # owned by the submitter but written into the executing
+                    # worker's arena) — parse the creator out of the arena
+                    # file name, arena_<client_id>_<seq>.
+                    fname = rec.shm_name.split("@", 1)[0].rsplit("/", 1)[-1]
+                    cid = fname[len("arena_") : fname.rfind("_")]
+                    self._pub(f"shm_free:{cid}", {"shm_name": rec.shm_name})
+                    return
                 path = os.path.join("/dev/shm", rec.shm_name)
                 try:
                     os.unlink(path)
@@ -537,6 +547,9 @@ class Head:
         state["client_id"] = client_id
         state["role"] = role
         self._clients[client_id] = state
+        # every client gets its private shm-reclaim channel (arena slices can
+        # only be freed by their owner's allocator)
+        self.subscribers.setdefault(f"shm_free:{client_id}", []).append(state["writer"])
         if role == "driver":
             self._driver_clients.add(client_id)
         if role == "worker":
@@ -744,7 +757,9 @@ class Head:
             reply(found=True, shm_name=rec.shm_name, size=rec.size, owner=rec.owner)
 
     async def _h_obj_refs(self, state, msg, reply, reply_err):
-        cid = state.get("client_id", "?")
+        # as_id: synthetic holder ids ("<cid>#v" value pins keep an arena
+        # slice alive while zero-copy views of it outlive the ObjectRef)
+        cid = msg.get("as_id") or state.get("client_id", "?")
         for oid in msg.get("inc", []):
             rec = self.objects.get(oid)
             if rec is not None:
@@ -1017,11 +1032,35 @@ class Head:
         self._shutdown.set()
 
     # ------------------------------------------------------------ lifecycle
+    def _sweep_client_arenas(self, cid: str):
+        """Unlink a departed client's arena files.  Readers with live maps
+        keep their data; objects owned by a dead process are lost either way
+        (ObjectLostError) until lineage reconstruction recovers them."""
+        import glob
+
+        for path in glob.glob(
+            os.path.join("/dev/shm", self.session_name, f"arena_{cid}_*")
+        ):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
     async def _on_disconnect(self, state):
         cid = state.get("client_id")
         if cid is None:
             return
         self._clients.pop(cid, None)
+        self._sweep_client_arenas(cid)
+        # drop this client's pubsub channel and its holder entries (incl. the
+        # "<cid>#v" value pins) so departed readers can't pin objects forever
+        self.subscribers.pop(f"shm_free:{cid}", None)
+        pin_id = f"{cid}#v"
+        for rec in list(self.objects.values()):
+            if cid in rec.holders or pin_id in rec.holders:
+                rec.holders.discard(cid)
+                rec.holders.discard(pin_id)
+                self._obj_maybe_gc(rec)
         if state.get("role") == "worker":
             rec = self.workers.get(cid)
             if rec is not None:
@@ -1085,7 +1124,15 @@ def main():
 
     resources = json.loads(os.environ.get("CA_RESOURCES", '{"CPU": 4}'))
     head = Head(session_dir, config, resources)
-    asyncio.run(head.run())
+
+    def _loop_factory():
+        loop = asyncio.new_event_loop()
+        if hasattr(asyncio, "eager_task_factory"):
+            loop.set_task_factory(asyncio.eager_task_factory)
+        return loop
+
+    with asyncio.Runner(loop_factory=_loop_factory) as runner:
+        runner.run(head.run())
 
 
 if __name__ == "__main__":

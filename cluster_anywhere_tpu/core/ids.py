@@ -12,6 +12,30 @@ from __future__ import annotations
 import os
 import threading
 
+# Fast unique-byte generator for hot ID paths (task submission creates 2+ IDs
+# per call; os.urandom is a ~15-20us getrandom syscall each).  Uniqueness =
+# per-process random prefix (refreshed on fork, keyed by pid) + 6-byte counter.
+_uniq_lock = threading.Lock()
+_uniq_pid: int = -1
+_uniq_prefix: bytes = b""
+_uniq_count: int = 0
+
+
+def _unique_bytes(n: int) -> bytes:
+    """n unique bytes: (n-6)-byte per-process random prefix + 6-byte counter
+    (2^48 ids/process).  Cross-process collision bound is the prefix's
+    min(n-6, 16) random bytes — >= 2^48 for the 12-byte TaskID suffix."""
+    global _uniq_pid, _uniq_prefix, _uniq_count
+    with _uniq_lock:
+        pid = os.getpid()
+        if pid != _uniq_pid:
+            _uniq_pid = pid
+            _uniq_prefix = os.urandom(16)
+            _uniq_count = 0
+        _uniq_count += 1
+        c = _uniq_count
+    return _uniq_prefix[: n - 6] + c.to_bytes(6, "big")
+
 _JOB_ID_SIZE = 4
 _ACTOR_ID_SIZE = 12
 _TASK_ID_SIZE = 16
@@ -25,7 +49,7 @@ NIL = b"\x00"
 
 class BaseID:
     SIZE = 16
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, id_bytes: bytes):
         if len(id_bytes) != self.SIZE:
@@ -33,6 +57,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
         self._bytes = id_bytes
+        self._hash = None
 
     @classmethod
     def from_random(cls):
@@ -59,7 +84,12 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __hash__(self):
-        return hash(self._bytes)
+        # IDs key every hot-path dict (pending stores, ref counts, holders);
+        # cache the hash instead of rehashing 16-20 bytes per lookup
+        h = self._hash
+        if h is None:
+            h = self._hash = hash(self._bytes)
+        return h
 
     def __repr__(self):
         return f"{type(self).__name__}({self.hex()[:16]})"
@@ -88,14 +118,15 @@ class TaskID(BaseID):
 
     @classmethod
     def for_normal_task(cls, job_id: JobID) -> "TaskID":
-        return cls(os.urandom(cls.SIZE - JobID.SIZE) + job_id.binary())
+        return cls(_unique_bytes(cls.SIZE - JobID.SIZE) + job_id.binary())
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
-        # Fully random: embedding the 12-byte ActorID would leave only 4
-        # random bytes — colliding with realistic call counts (birthday bound
-        # ~2^16 calls).  Actor attribution lives in the task spec instead.
-        return cls(os.urandom(cls.SIZE))
+        # Not derived from the 12-byte ActorID: embedding it would leave only
+        # 4 distinguishing bytes — colliding with realistic call counts
+        # (birthday bound ~2^16 calls).  Actor attribution lives in the task
+        # spec instead.
+        return cls(_unique_bytes(cls.SIZE))
 
 
 class ObjectID(BaseID):

@@ -38,97 +38,232 @@ class _Entry:
     size: int = 0
 
 
+class _Waiter:
+    """One blocked wait_ready call: a countdown over a pending-oid set.
+    Stores do O(1) membership work per arriving object instead of the waiter
+    rescanning its whole list per wakeup (which made a 4k-ref get O(N*wakeups)
+    in both scans and thread wakeups)."""
+
+    __slots__ = ("pending", "needed", "event")
+
+    def __init__(self, pending: set, needed: int):
+        self.pending = pending
+        self.needed = needed
+        self.event = threading.Event()
+
+
 class MemoryStore:
     """Thread-safe in-process object table with blocking waits."""
 
     def __init__(self):
         self._entries: Dict[ObjectID, _Entry] = {}
-        self._cv = threading.Condition()
+        self._lock = threading.Lock()
+        self._waiters: List[_Waiter] = []
+
+    def _store(self, oid: ObjectID, entry: _Entry):
+        with self._lock:
+            self._entries[oid] = entry
+            for w in self._waiters:
+                if oid in w.pending:
+                    w.pending.discard(oid)
+                    w.needed -= 1
+                    if w.needed <= 0:
+                        w.event.set()
 
     def put_value(self, oid: ObjectID, value: Any, size: int = 0):
-        with self._cv:
-            self._entries[oid] = _Entry("value", value=value, size=size)
-            self._cv.notify_all()
+        self._store(oid, _Entry("value", value=value, size=size))
 
     def put_packed(self, oid: ObjectID, packed: bytes):
-        with self._cv:
-            self._entries[oid] = _Entry("packed", packed=packed, size=len(packed))
-            self._cv.notify_all()
+        self._store(oid, _Entry("packed", packed=packed, size=len(packed)))
 
     def put_shm(self, oid: ObjectID, shm_name: str, size: int):
-        with self._cv:
-            self._entries[oid] = _Entry("shm", shm_name=shm_name, size=size)
-            self._cv.notify_all()
+        self._store(oid, _Entry("shm", shm_name=shm_name, size=size))
 
     def put_error(self, oid: ObjectID, error: BaseException):
-        with self._cv:
-            self._entries[oid] = _Entry("error", error=error)
-            self._cv.notify_all()
+        self._store(oid, _Entry("error", error=error))
 
     def mark_pending(self, oid: ObjectID):
-        with self._cv:
+        with self._lock:
             self._entries.setdefault(oid, _Entry("pending"))
 
     def contains(self, oid: ObjectID) -> bool:
-        with self._cv:
+        with self._lock:
             e = self._entries.get(oid)
             return e is not None and e.state != "pending"
 
     def get_entry(self, oid: ObjectID) -> Optional[_Entry]:
-        with self._cv:
+        with self._lock:
             return self._entries.get(oid)
 
     def wait_ready(self, oids: List[ObjectID], num_returns: int, timeout: Optional[float]) -> Tuple[List[ObjectID], List[ObjectID]]:
         """Block until num_returns of oids are ready (or timeout). Returns
         (ready, not_ready) preserving input order — `wait()` semantics of the
-        reference (python/ray/_private/worker.py:2868).
-
-        Re-checks only the still-pending subset on each wakeup so waiting on N
-        objects is O(N) total, not O(N^2)."""
+        reference (python/ray/_private/worker.py:2868)."""
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cv:
-            pending = [
-                o for o in oids if (e := self._entries.get(o)) is None or e.state == "pending"
-            ]
-            while True:
-                if len(oids) - len(pending) >= num_returns:
-                    break
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    break
-                self._cv.wait(remaining if remaining is None or remaining < 0.25 else 0.25)
-                pending = [
-                    o
-                    for o in pending
-                    if (e := self._entries.get(o)) is None or e.state == "pending"
-                ]
-            pending_set = set(pending)
-            ready_list, rest = [], []
-            for o in oids:
-                if o not in pending_set and len(ready_list) < num_returns:
-                    ready_list.append(o)
-                else:
-                    rest.append(o)
-            return ready_list, rest
+        with self._lock:
+            pending = {
+                o
+                for o in oids
+                if (e := self._entries.get(o)) is None or e.state == "pending"
+            }
+            # duplicates in oids count once: needed is in unique-oid units
+            n_unique = len(set(oids))
+            needed = num_returns - (n_unique - len(pending))
+            if needed > len(pending):
+                needed = len(pending)
+            waiter = _Waiter(pending, needed)
+            if needed > 0:
+                self._waiters.append(waiter)
+        try:
+            if waiter.needed > 0:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                waiter.event.wait(remaining)
+        finally:
+            with self._lock:
+                if waiter in self._waiters:
+                    self._waiters.remove(waiter)
+                pending_set = set(waiter.pending)
+        ready_list, rest = [], []
+        for o in oids:
+            if o not in pending_set and len(ready_list) < num_returns:
+                ready_list.append(o)
+            else:
+                rest.append(o)
+        return ready_list, rest
 
     def delete(self, oid: ObjectID):
-        with self._cv:
+        with self._lock:
             self._entries.pop(oid, None)
 
     def keys(self):
-        with self._cv:
+        with self._lock:
             return list(self._entries.keys())
 
 
-class ShmObjectStore:
-    """Producer/consumer interface to per-object shm segments.
+_PAGE = 4096
+_ARENA_DEFAULT = 256 * 1024 * 1024  # first arena size
+_ARENA_MAX_OBJ = 1 << 31  # larger objects get dedicated segments
 
-    Segment layout = serialization.pack() format, written in place.
+
+def _align_up(n: int, a: int = _PAGE) -> int:
+    return (n + a - 1) & ~(a - 1)
+
+
+class _Arena:
+    """One pre-faulted shm file carved into object slices (plasma-style
+    arena, design per src/ray/object_manager/plasma/plasma_allocator.h: touch
+    pages once up front so puts pay memcpy, not first-touch fault + memcpy;
+    freed slices are reused already-hot).
+
+    First-fit free list sorted by offset, coalescing on free.  The owner
+    process is the only allocator; readers map the file read-only and slice.
     """
 
-    def __init__(self, session_name: str):
+    __slots__ = ("name", "path", "size", "mm", "free", "lock", "_prefault_thread")
+
+    def __init__(self, name: str, path: str, size: int):
+        self.name = name
+        self.path = path
+        self.size = size
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            self.mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.free: List[Tuple[int, int]] = [(0, size)]  # (offset, size), sorted
+        self.lock = threading.Lock()
+        # fault pages in the background: a put that outruns the prefault just
+        # faults normally; after a few seconds the whole arena is hot
+        self._prefault_thread = threading.Thread(
+            target=self._prefault, name="ca-arena-prefault", daemon=True
+        )
+        self._prefault_thread.start()
+
+    def _reserve_range(self, off: int, size: int) -> bool:
+        """Carve exactly [off, off+size) out of the free list if fully free."""
+        with self.lock:
+            for i, (foff, fsz) in enumerate(self.free):
+                if foff <= off and off + size <= foff + fsz:
+                    self.free.pop(i)
+                    if foff < off:
+                        self.free.insert(i, (foff, off - foff))
+                        i += 1
+                    if off + size < foff + fsz:
+                        self.free.insert(i, (off + size, foff + fsz - (off + size)))
+                    return True
+                if foff > off:
+                    break
+        return False
+
+    def _prefault(self):
+        """Touch every free page once.  Chunks are RESERVED through the
+        allocator while being zeroed, so concurrent puts can never have their
+        freshly written data overwritten (nor allocate a page mid-zero)."""
+        stride = 16 * 1024 * 1024
+        zeros = b"\x00" * stride
+        try:
+            mv = memoryview(self.mm)
+            for off in range(0, self.size, stride):
+                end = min(off + stride, self.size)
+                if not self._reserve_range(off, end - off):
+                    continue  # (partially) allocated: the writer faulted it
+                try:
+                    mv[off:end] = zeros[: end - off]
+                finally:
+                    self.free_slice(off, end - off)
+            mv.release()
+        except (ValueError, IndexError):
+            pass  # arena closed mid-prefault
+
+    def alloc(self, size: int) -> Optional[int]:
+        size = _align_up(size)
+        with self.lock:
+            for i, (off, sz) in enumerate(self.free):
+                if sz >= size:
+                    if sz == size:
+                        self.free.pop(i)
+                    else:
+                        self.free[i] = (off + size, sz - size)
+                    return off
+        return None
+
+    def free_slice(self, offset: int, size: int):
+        size = _align_up(size)
+        with self.lock:
+            import bisect
+
+            i = bisect.bisect_left(self.free, (offset, 0))
+            self.free.insert(i, (offset, size))
+            # coalesce with next, then previous
+            if i + 1 < len(self.free) and offset + size == self.free[i + 1][0]:
+                self.free[i] = (offset, size + self.free[i + 1][1])
+                self.free.pop(i + 1)
+            if i > 0 and self.free[i - 1][0] + self.free[i - 1][1] == self.free[i][0]:
+                self.free[i - 1] = (
+                    self.free[i - 1][0],
+                    self.free[i - 1][1] + self.free[i][1],
+                )
+                self.free.pop(i)
+
+    def close(self):
+        try:
+            self.mm.close()
+        except (BufferError, ValueError):
+            pass
+
+
+class ShmObjectStore:
+    """Producer/consumer interface to the node-local shared-memory store.
+
+    Objects live as slices of pre-faulted arena files (shm_name
+    "<arena>@<offset>+<size>") or, above _ARENA_MAX_OBJ, as dedicated sealed
+    segments.  Segment layout = serialization.pack() format, written in place.
+    """
+
+    def __init__(self, session_name: str, owner_tag: Optional[str] = None):
         self.session_name = session_name
         self.dir = os.path.join(SHM_DIR, session_name)
         os.makedirs(self.dir, exist_ok=True)
@@ -136,6 +271,12 @@ class ShmObjectStore:
         self._native_tried = False
         self._open_maps: Dict[str, Tuple[mmap.mmap, int]] = {}
         self._lock = threading.Lock()
+        # producer-side arenas (keyed by arena shm_name); owner_tag namespaces
+        # this process's arena files so the head can sweep them if it dies
+        self._owner_tag = owner_tag or f"p{os.getpid()}"
+        self._arenas: Dict[str, _Arena] = {}
+        self._arena_seq = 0
+        self._grow_lock = threading.Lock()  # one arena creation at a time
 
     # -- native acceleration ------------------------------------------------
     def _native_lib(self):
@@ -153,10 +294,90 @@ class ShmObjectStore:
     def name_for(self, oid: ObjectID) -> str:
         return f"{self.session_name}/obj_{oid.hex()}"
 
+    def warm(self, capacity: int = _ARENA_DEFAULT):
+        """Pre-create (and background-prefault) an arena so first puts pay
+        memcpy only — the plasma analogue of pre-allocated store memory."""
+        with self._lock:
+            if self._arenas:
+                return
+            self._arena_seq += 1
+            name = f"{self.session_name}/arena_{self._owner_tag}_{self._arena_seq}"
+        try:
+            arena = _Arena(name, os.path.join(SHM_DIR, name), capacity)
+        except OSError:
+            return
+        with self._lock:
+            self._arenas[name] = arena
+
+    def _try_alloc(self, size: int) -> Optional[Tuple[_Arena, int]]:
+        with self._lock:
+            arenas = list(self._arenas.values())
+        for a in arenas:
+            off = a.alloc(size)
+            if off is not None:
+                return a, off
+        return None
+
+    def _arena_alloc(self, size: int) -> Optional[Tuple[_Arena, int]]:
+        got = self._try_alloc(size)
+        if got is not None:
+            return got
+        # growth is serialized: concurrent put bursts must not each create a
+        # full-size arena, and a prefault thread transiently reserving chunks
+        # must not fake an out-of-space condition
+        with self._grow_lock:
+            got = self._try_alloc(size)  # another thread may have grown
+            if got is not None:
+                return got
+            with self._lock:
+                arenas = list(self._arenas.values())
+            for a in arenas:  # drain in-flight prefault reservations
+                t = a._prefault_thread
+                if t is not None and t.is_alive():
+                    t.join(timeout=10.0)
+            got = self._try_alloc(size)
+            if got is not None:
+                return got
+            # genuinely out of space: new arena, geometric in object size and
+            # total footprint so sustained bursts create O(log) arenas
+            total = sum(a.size for a in arenas)
+            cap = max(_ARENA_DEFAULT, total)
+            while cap < size * 2:
+                cap *= 2
+            with self._lock:
+                self._arena_seq += 1
+                name = f"{self.session_name}/arena_{self._owner_tag}_{self._arena_seq}"
+            try:
+                arena = _Arena(name, os.path.join(SHM_DIR, name), cap)
+            except OSError:
+                return None  # /dev/shm exhausted; caller falls back or errors
+            with self._lock:
+                self._arenas[name] = arena
+            off = arena.alloc(size)
+            return (arena, off) if off is not None else None
+
+    def _pack_into(self, mv, data: bytes, raws: List[Any]):
+        native = self._native_lib()
+        if native is not None:
+            serialization_pack_into_native(native, mv, data, raws)
+        else:
+            serialization.pack_into(mv, data, raws)
+
     def create_and_pack(self, oid: ObjectID, data: bytes, raws: List[Any]) -> Tuple[str, int]:
-        """Write a serialized value into a new sealed segment. Returns
-        (shm_name, size)."""
+        """Write a serialized value into the store. Returns (shm_name, size).
+        shm_name addresses either an arena slice or a dedicated segment."""
         size = serialization.packed_size(data, raws)
+        if size <= _ARENA_MAX_OBJ:
+            got = self._arena_alloc(size)
+            if got is not None:
+                arena, off = got
+                mv = memoryview(arena.mm)[off : off + size]
+                try:
+                    self._pack_into(mv, data, raws)
+                finally:
+                    mv.release()
+                return f"{arena.name}@{off}+{size}", size
+        # dedicated segment path (huge objects, or arena creation failed)
         name = self.name_for(oid)
         path = os.path.join(SHM_DIR, name)
         tmp = path + ".tmp"
@@ -167,12 +388,8 @@ class ShmObjectStore:
         try:
             os.ftruncate(fd, size)
             with mmap.mmap(fd, size) as m:
-                native = self._native_lib()
                 mv = memoryview(m)
-                if native is not None:
-                    serialization_pack_into_native(native, mv, data, raws)
-                else:
-                    serialization.pack_into(mv, data, raws)
+                self._pack_into(mv, data, raws)
                 mv.release()
         except OSError as e:
             os.close(fd)
@@ -182,18 +399,37 @@ class ShmObjectStore:
         os.rename(tmp, path)  # atomic seal
         return name, size
 
+    def free_local(self, shm_name: str):
+        """Owner-side reclaim of an arena slice (called when the head GCs the
+        object); no-op for names this process doesn't own."""
+        if "@" not in shm_name:
+            return
+        arena_name, _, rest = shm_name.partition("@")
+        arena = self._arenas.get(arena_name)
+        if arena is None:
+            return
+        off_s, _, size_s = rest.partition("+")
+        try:
+            arena.free_slice(int(off_s), int(size_s))
+        except ValueError:
+            pass
+
     def put(self, oid: ObjectID, value: Any) -> Tuple[str, int]:
         data, buffers = serialization.serialize(value)
         return self.create_and_pack(oid, data, [b.raw() for b in buffers])
 
     # -- consumer -----------------------------------------------------------
-    def open(self, shm_name: str) -> memoryview:
-        """Map a sealed segment read-only (zero-copy)."""
+    def _map_file(self, file_name: str) -> mmap.mmap:
+        """Map a whole shm file (cached; arenas are mapped once per reader)."""
         with self._lock:
-            cached = self._open_maps.get(shm_name)
+            cached = self._open_maps.get(file_name)
             if cached is not None:
-                return memoryview(cached[0])
-        path = os.path.join(SHM_DIR, shm_name)
+                return cached[0]
+        # the owner of this arena writes through its own rw mapping
+        own = self._arenas.get(file_name)
+        if own is not None:
+            return own.mm
+        path = os.path.join(SHM_DIR, file_name)
         fd = os.open(path, os.O_RDONLY)
         try:
             size = os.fstat(fd).st_size
@@ -201,13 +437,31 @@ class ShmObjectStore:
         finally:
             os.close(fd)
         with self._lock:
-            self._open_maps[shm_name] = (m, size)
-        return memoryview(m)
+            prev = self._open_maps.get(file_name)
+            if prev is not None:  # lost a map race; use the winner
+                try:
+                    m.close()
+                except BufferError:
+                    pass
+                return prev[0]
+            self._open_maps[file_name] = (m, size)
+        return m
+
+    def open(self, shm_name: str) -> memoryview:
+        """Zero-copy read view of an object (arena slice or segment)."""
+        if "@" in shm_name:
+            file_name, _, rest = shm_name.partition("@")
+            off_s, _, size_s = rest.partition("+")
+            off, size = int(off_s), int(size_s)
+            return memoryview(self._map_file(file_name))[off : off + size]
+        return memoryview(self._map_file(shm_name))
 
     def get(self, shm_name: str) -> Any:
         return serialization.unpack(self.open(shm_name))
 
     def release(self, shm_name: str):
+        if "@" in shm_name:
+            return  # arena maps are long-lived; slices have no per-reader state
         with self._lock:
             cached = self._open_maps.pop(shm_name, None)
         if cached is not None:
@@ -219,6 +473,9 @@ class ShmObjectStore:
                     self._open_maps[shm_name] = cached
 
     def unlink(self, shm_name: str):
+        if "@" in shm_name:
+            self.free_local(shm_name)
+            return
         self.release(shm_name)
         try:
             os.unlink(os.path.join(SHM_DIR, shm_name))
@@ -231,11 +488,15 @@ class ShmObjectStore:
         with self._lock:
             maps = list(self._open_maps.values())
             self._open_maps.clear()
+            arenas = list(self._arenas.values())
+            self._arenas.clear()
         for m, _ in maps:
             try:
                 m.close()
             except BufferError:
                 pass
+        for a in arenas:
+            a.close()
         shutil.rmtree(self.dir, ignore_errors=True)
 
 

@@ -27,9 +27,15 @@ class ReferenceCounter:
         # objects this process owns (created here); owner keeps data alive
         # until cluster count drops to zero.
         self._owned: set = set()
+        # called (outside the lock) when an object's local count reaches 0 —
+        # the worker evicts its read-cache entry so value pins can release
+        self._on_zero: Optional[Callable[[ObjectID], None]] = None
 
     def set_flush_cb(self, cb):
         self._flush_cb = cb
+
+    def set_on_zero(self, cb: Callable[[ObjectID], None]):
+        self._on_zero = cb
 
     def add_owned(self, oid: ObjectID):
         with self._lock:
@@ -44,15 +50,22 @@ class ReferenceCounter:
 
     def remove_local_ref(self, oid: ObjectID):
         flush = None
+        zero = False
         with self._lock:
             n = self._counts.get(oid, 0) - 1
             if n <= 0:
                 self._counts.pop(oid, None)
                 self._pending_dec.append(oid.binary())
+                zero = True
                 if len(self._pending_dec) >= 64:
                     flush = self._take_pending_locked()
             else:
                 self._counts[oid] = n
+        if zero and self._on_zero is not None:
+            try:
+                self._on_zero(oid)
+            except Exception:
+                pass
         if flush and self._flush_cb:
             self._flush_cb(*flush)
 
@@ -70,3 +83,7 @@ class ReferenceCounter:
     def local_count(self, oid: ObjectID) -> int:
         with self._lock:
             return self._counts.get(oid, 0)
+
+    def is_owned(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._owned

@@ -81,9 +81,15 @@ def pack(value: Any) -> bytes:
     return b"".join(parts)
 
 
-def unpack(blob) -> Any:
+def unpack(blob, pin_cb=None) -> Any:
     """Inverse of pack(). Accepts bytes or a memoryview (zero-copy for
-    buffer-backed payloads when given a memoryview over shm)."""
+    buffer-backed payloads when given a memoryview over shm).
+
+    pin_cb: called once after ALL zero-copy buffers handed to the value have
+    been garbage-collected.  Buffers are wrapped in weakref-able ndarray
+    shims so the store can keep the backing slice alive exactly as long as
+    any user-held view (arena slices get reused; without the pin, a view
+    outliving its ObjectRef would silently read recycled bytes)."""
     mv = memoryview(blob)
     hlen = int.from_bytes(bytes(mv[:4]), "big")
     header = msgpack.unpackb(bytes(mv[4 : 4 + hlen]), raw=False)
@@ -93,6 +99,24 @@ def unpack(blob) -> Any:
         offset = _align(offset)
         buffers.append(mv[offset : offset + ln])
         offset += ln
+    if pin_cb is not None and buffers:
+        import weakref
+
+        import numpy as _np
+
+        wrapped = [_np.frombuffer(b, dtype=_np.uint8) for b in buffers]
+        remaining = {"n": len(wrapped)}
+
+        def _one_done():
+            remaining["n"] -= 1
+            if remaining["n"] == 0:
+                pin_cb()
+
+        for w in wrapped:
+            weakref.finalize(w, _one_done)
+        return deserialize(header["p"], wrapped)
+    if pin_cb is not None:
+        pin_cb()  # no out-of-band buffers: nothing can alias the slice
     return deserialize(header["p"], buffers)
 
 

@@ -227,9 +227,18 @@ class Worker:
         self.serve_addr = serve_addr
         self.job_id = JobID.from_random()
         self.memory_store = MemoryStore()
-        self.shm_store = ShmObjectStore(self.session_name)
+        self.shm_store = ShmObjectStore(self.session_name, owner_tag=self.client_id)
+        if mode == "driver":
+            # plasma-style pre-allocation: warm an arena while the driver is
+            # still bootstrapping so early puts land in pre-faulted pages
+            self.shm_store.warm()
         self.fn_manager = FunctionManager()
         self.reference_counter = ReferenceCounter(self._flush_refs)
+        # evict the cache when the last local ref drops: cached values hold
+        # zero-copy views, which hold arena value-pins — without eviction,
+        # pinned slices would never be reusable.  Owned INLINE values (no shm
+        # backing) are kept: they are the only copy and stay resolvable
+        self.reference_counter.set_on_zero(self._evict_on_zero)
         self._put_counter = _Counter()
         self._task_counter = _Counter()
         self.head: Optional[Connection] = None
@@ -252,6 +261,10 @@ class Worker:
         self._external_loop = loop is not None
         if loop is None:
             self.loop = asyncio.new_event_loop()
+            # eager tasks (3.12+): submission coroutines usually run to their
+            # first await synchronously, skipping a schedule round-trip per task
+            if hasattr(asyncio, "eager_task_factory"):
+                self.loop.set_task_factory(asyncio.eager_task_factory)
             self._io_thread = threading.Thread(
                 target=self._run_loop, name="ca-io", daemon=True
             )
@@ -325,7 +338,7 @@ class Worker:
             )
             self.node_id = reply["node_id"]
             self.total_resources = reply["resources"]
-            spawn_bg(self._housekeeping())
+            self._housekeeping_task = spawn_bg(self._housekeeping())
 
         self.run_coro(_connect(), timeout=30)
 
@@ -341,14 +354,22 @@ class Worker:
         )
         self.node_id = reply["node_id"]
         self.total_resources = reply["resources"]
-        spawn_bg(self._housekeeping())
+        self._housekeeping_task = spawn_bg(self._housekeeping())
 
     async def _on_push(self, msg):
-        if msg.get("m") == "pub" and msg.get("ch") == "actors":
+        if msg.get("m") != "pub":
+            return
+        ch = msg.get("ch")
+        if ch == "actors":
             data = msg.get("data") or {}
             aid = data.get("actor_id")
             if aid and data.get("addr"):
                 self._actor_addr_cache[aid] = (data["addr"], data.get("incarnation", 0))
+        elif ch == f"shm_free:{self.client_id}":
+            data = msg.get("data") or {}
+            name = data.get("shm_name")
+            if name:
+                self.shm_store.free_local(name)
 
     async def _housekeeping(self):
         period = 0.25
@@ -481,6 +502,39 @@ class Worker:
         except RuntimeError:
             pass
 
+    def _evict_on_zero(self, oid: ObjectID):
+        e = self.memory_store.get_entry(oid)
+        if e is None:
+            return
+        if e.shm_name or not self.reference_counter.is_owned(oid):
+            self.memory_store.delete(oid)
+
+    def _make_value_pin(self, oid: ObjectID):
+        """Register a value-holder for an arena-backed object and return the
+        callback that releases it (runs from GC in any thread)."""
+        pin_id = f"{self.client_id}#v"
+        oid_b = oid.binary()
+
+        def _send(inc, dec):
+            def _notify():
+                if self.head is not None and not self.head.closed:
+                    try:
+                        self.head.notify("obj_refs", inc=inc, dec=dec, as_id=pin_id)
+                    except Exception:
+                        pass
+
+            try:
+                self.loop.call_soon_threadsafe(_notify)
+            except RuntimeError:
+                pass
+
+        _send([oid_b], [])
+
+        def _unpin():
+            _send([], [oid_b])
+
+        return _unpin
+
     def _resolve_entry(self, ref: ObjectRef) -> Any:
         e = self.memory_store.get_entry(ref.id)
         if e is None:
@@ -494,7 +548,13 @@ class Worker:
             self.memory_store.put_value(ref.id, value, size=e.size)
             return value
         if e.state == "shm":
-            value = self.shm_store.get(e.shm_name)
+            pin_cb = None
+            if "@" in e.shm_name:
+                # arena slice: hold a synthetic "<cid>#v" holder at the head
+                # until every zero-copy view of this value is gone, so the
+                # owner's allocator cannot recycle the slice under a live view
+                pin_cb = self._make_value_pin(ref.id)
+            value = serialization.unpack(self.shm_store.open(e.shm_name), pin_cb=pin_cb)
             # cache the value; e.shm_name is kept so args can still be passed
             # by shm reference instead of re-packing
             e.value = value
@@ -725,9 +785,7 @@ class Worker:
                 self.memory_store.put_shm(oid, res["shm"], res.get("size", 0))
             elif "dev" in res:
                 e = _Entry("device", value=res.get("spec"), shm_name=res.get("owner", exec_addr))
-                with self.memory_store._cv:
-                    self.memory_store._entries[oid] = e
-                    self.memory_store._cv.notify_all()
+                self.memory_store._store(oid, e)
 
     # ------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, opts: Dict[str, Any]) -> Tuple[ActorID, str]:
@@ -865,6 +923,15 @@ class Worker:
                 pass
 
         async def _close_all():
+            # cancel + await housekeeping first: a bare loop.stop() would
+            # destroy it mid-await ("Task was destroyed but it is pending")
+            task = getattr(self, "_housekeeping_task", None)
+            if task is not None and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
             if self.head is not None:
                 await self.head.close()
             for c in self._conns.values():

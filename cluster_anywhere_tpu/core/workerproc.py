@@ -46,6 +46,8 @@ class WorkerProcess:
         self.config = CAConfig.from_json(os.environ["CA_CONFIG_JSON"])
         set_config(self.config)
         self.loop = asyncio.new_event_loop()
+        if hasattr(asyncio, "eager_task_factory"):
+            self.loop.set_task_factory(asyncio.eager_task_factory)
         self.worker: Optional[Worker] = None
         self.server = Server(self.sock_path, self._handle)
         self.executor = concurrent.futures.ThreadPoolExecutor(
