@@ -26,8 +26,9 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..ops.attention import attention as dense_attention
 from ..parallel.pipeline import pipeline_apply
-from ..parallel.ring_attention import reference_attention, ring_attention
+from ..parallel.ring_attention import ring_attention
 from ..parallel.ulysses import ulysses_attention
 
 
@@ -177,7 +178,8 @@ def _attention(q, k, v, cfg: TransformerConfig, sp_manual: bool):
         return ring_attention(q, k, v, axis_name="sp", causal=True)
     if impl == "ulysses" and sp_manual:
         return ulysses_attention(q, k, v, axis_name="sp", causal=True)
-    return reference_attention(q, k, v, causal=True)
+    # dense path: Pallas flash kernel on TPU, jnp reference elsewhere
+    return dense_attention(q, k, v, causal=True)
 
 
 def _block_forward(bp, x, cfg: TransformerConfig, sp_manual: bool):

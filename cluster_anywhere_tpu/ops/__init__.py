@@ -1,0 +1,8 @@
+"""TPU kernel layer (Pallas).
+
+Hand-written kernels for the ops where XLA's default lowering leaves MXU/HBM
+performance on the table.  Everything degrades gracefully: on CPU (tests) the
+kernels run in Pallas interpret mode or fall back to pure-jax references.
+"""
+
+from .attention import attention, flash_attention, merge_attention  # noqa: F401

@@ -1,0 +1,455 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+Replaces the O(T^2)-memory dense softmax attention with the streaming-softmax
+tiling that keeps the MXU busy from VMEM: per query block, K/V are consumed in
+blocks with a running (max, normalizer, accumulator) — the [T, T] score matrix
+never hits HBM.  Backward recomputes scores blockwise from the saved
+log-sum-exp (no O(T^2) residuals), the standard flash-attention-2 scheme.
+
+The reference framework has no attention kernels at all (it delegates model
+math to torch; SURVEY.md §5 notes SP/CP absent in-tree) — this kernel is the
+compute core of the TPU-native model stack: the dense transformer path calls
+`attention()`, and ring attention merges per-block flash results with
+`merge_attention` (parallel/ring_attention.py).
+
+Layout contract: [B, T, H, D] inputs (time-major per head), fp32 accumulation
+regardless of input dtype.  GQA callers repeat K/V heads first.
+
+On non-TPU backends `attention()` uses the fused-jnp reference; the Pallas
+kernels themselves also run under interpret mode for tests
+(`flash_attention(..., interpret=True)` — exercised in tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas is part of jax, but keep import-failure graceful for CPU-only
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None
+
+NEG_INF = -1e30
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
+    block_q, d = q_ref.shape
+    t_kv = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    if causal:
+        # skip key blocks fully above the diagonal
+        num_k = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    else:
+        num_k = t_kv // block_k
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        s = (
+            lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(s <= NEG_INF, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse_ref is the full (1, t) row; each grid step writes its q-block slice
+    block_q_ = q_ref.shape[0]
+    lse_ref[0, pl.ds(qi * block_q_, block_q_)] = m + jnp.log(l_safe)
+
+
+# --------------------------------------------------------------------------
+# backward kernels (flash-attention-2: recompute p from lse, no O(T^2) saves)
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal, block_k
+):
+    block_q, d = q_ref.shape
+    t_kv = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+    delta = delta_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+
+    if causal:
+        num_k = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    else:
+        num_k = t_kv // block_k
+
+    def body(ki, dq):
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        s = (
+            lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + scale * lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = lax.fori_loop(0, num_k, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, block_q
+):
+    block_k, d = k_ref.shape
+    t_q = q_ref.shape[0]
+    ki = pl.program_id(1)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    nq = t_q // block_q
+    # causal: query blocks strictly before this key block contribute nothing
+    lo = lax.div(ki * block_k, block_q) if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        s = (
+            lax.dot_general(
+                q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])  # [bq, bk]
+        dv_new = dv + lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_blk[:, None])
+        dk_new = dk + scale * lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------------
+# host-side wrappers
+# --------------------------------------------------------------------------
+
+
+def _to_bhtd(x):
+    """[B, T, H, D] -> [B*H, T, D]."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bhtd(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    t_kv = k.shape[1]
+    qf, kf, vf = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
+    bh = b * h
+    nq = t // block_q
+    grid = (bh, nq)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_k=block_k
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
+            pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
+            # (1, t) full-row blocks: TPU lowering requires the last two block
+            # dims divisible by (8, 128) OR equal to the array dims
+            pl.BlockSpec((None, 1, t), lambda bi, qi: (bi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return _from_bhtd(out, b, h), lse.reshape(b, h, t)
+
+
+def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret, dlse=None):
+    b, t, h, d = q.shape
+    t_kv = k.shape[1]
+    qf, kf, vf = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
+    dof, of = _to_bhtd(do), _to_bhtd(o)
+    bh = b * h
+    lsef = lse.reshape(bh, 1, t)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, leave to XLA.  An lse
+    # cotangent folds in with opposite sign: ds = p * (dp - (delta - dlse))
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.reshape(bh, t).astype(jnp.float32)
+    delta = delta.reshape(bh, 1, t)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_k=block_k
+        ),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
+            pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
+            pl.BlockSpec((None, 1, t), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((None, 1, t), lambda bi, qi: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q
+        ),
+        grid=(bh, t_kv // block_k),
+        in_specs=[
+            pl.BlockSpec((None, t, d), lambda bi, ki: (bi, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bi, ki: (bi, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bi, ki: (bi, ki, 0)),
+            pl.BlockSpec((None, t, d), lambda bi, ki: (bi, 0, 0)),
+            pl.BlockSpec((None, 1, t), lambda bi, ki: (bi, 0, 0)),
+            pl.BlockSpec((None, 1, t), lambda bi, ki: (bi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda bi, ki: (bi, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bi, ki: (bi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    return _from_bhtd(dq, b, h), _from_bhtd(dk, b, h), _from_bhtd(dv, b, h)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd_impl(
+        q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_with_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_with_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_with_lse_bwd(causal, scale, block_q, block_k, interpret, res, cts):
+    """Cotangent of lse folds into the delta term: d(lse)/ds = p per row, so
+    ds = p*(dp - delta + dlse) — pass (delta - dlse) where the kernels expect
+    delta (the ring merge differentiates through lse)."""
+    q, k, v, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _bwd_impl(
+        q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret,
+        dlse=dlse,
+    )
+    return dq, dk, dv
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    return_lse: bool = False,
+):
+    """Pallas flash attention.  q: [B, T, H, D]; k, v: [B, T_kv, H, D].
+
+    Requires T % block_q == 0 and T_kv % block_k == 0 (the dispatcher
+    `attention()` falls back to the jnp reference otherwise).  With
+    return_lse=True also returns the per-row log-sum-exp [B, H, T] — the
+    carry ring attention needs to merge per-block results (merge_attention).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _platform() == "cpu"
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    if return_lse:
+        return _flash_with_lse(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def merge_attention(o1, lse1, o2, lse2):
+    """Merge two normalized attention partials over disjoint key sets.
+
+    o: [B, T, H, D]; lse: [B, H, T].  Returns (o, lse) of the union — the
+    streaming-softmax combine that lets ring attention run flash per block.
+    """
+    m = jnp.maximum(lse1, lse2)
+    # exp(-inf - -inf) guard: where both lse are -inf the row saw no keys
+    w1 = jnp.where(lse1 == NEG_INF, 0.0, jnp.exp(lse1 - m))
+    w2 = jnp.where(lse2 == NEG_INF, 0.0, jnp.exp(lse2 - m))
+    tot = w1 + w2
+    tot_safe = jnp.where(tot == 0.0, 1.0, tot)
+    w1t = (w1 / tot_safe).transpose(0, 2, 1)[..., None].astype(o1.dtype)
+    w2t = (w2 / tot_safe).transpose(0, 2, 1)[..., None].astype(o2.dtype)
+    o = o1 * w1t + o2 * w2t
+    lse = m + jnp.log(tot_safe)
+    return o, jnp.where(tot == 0.0, NEG_INF, lse)
+
+
+def reference_attention(q, k, v, causal=True, scale=None):
+    """Dense jnp attention (fallback + test oracle): [B,T,H,D] -> [B,T,H,D]."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    s = (
+        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        * scale
+    )
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Dispatcher: Pallas flash kernel on TPU when shapes tile cleanly, else
+    the jnp reference (XLA still fuses that well on CPU test meshes)."""
+    t, t_kv = q.shape[1], k.shape[1]
+    use_flash = (
+        pl is not None
+        and _platform() not in ("cpu",)
+        and t % min(128, t) == 0
+        and t_kv % min(128, t_kv) == 0
+        and t >= 128
+        and t_kv >= 128
+    )
+    if use_flash:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return reference_attention(q, k, v, causal=causal, scale=scale)

@@ -5,11 +5,15 @@ The reference provides no sequence parallelism (SURVEY.md §5: "SP/CP not
 implemented in-tree"); this module is part of closing that gap TPU-natively.
 Each device holds a sequence shard of Q, K, V.  K/V blocks rotate around the
 'sp' mesh axis via `lax.ppermute` while every device accumulates its Q-shard's
-attention with streaming (flash-style) softmax: running max `m`, normalizer
-`l`, and un-normalized output `o` are updated per block, so the full [T, T]
-score matrix never materializes.  The loop is a `lax.scan` of pure jax ops —
-differentiable by construction, and on TPU each block's inner attention can
-dispatch to the Pallas flash kernel (ops.attention).
+attention with streaming (flash-style) softmax, so the full [T, T] score
+matrix never materializes.
+
+On TPU each arriving block is processed by the Pallas flash kernel
+(ops.attention.flash_attention) — full attention for blocks from earlier
+shards, causal for the diagonal block, skipped for future shards — and the
+per-block (out, lse) partials are combined with ops.attention.merge_attention.
+On CPU test meshes (or non-tiling shapes) the same schedule runs as a pure
+jnp streaming-softmax loop; both paths are differentiable.
 
 Usage inside shard_map (manual over 'sp'; see tests/test_parallel.py):
     out = ring_attention(q, k, v, axis_name="sp", causal=True)
@@ -25,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-NEG_INF = -1e30
+from ..ops.attention import NEG_INF, flash_attention, merge_attention
 
 
 def _block_attention(q, k, v, scale, mask, m_prev, l_prev, o_prev):
@@ -50,6 +54,14 @@ def _block_attention(q, k, v, scale, mask, m_prev, l_prev, o_prev):
     return m_new, l_new, o_new
 
 
+def _flash_tiles(t_local: int) -> bool:
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    return platform not in ("cpu",) and t_local >= 128 and t_local % 128 == 0
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -57,6 +69,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention over a ring of sequence shards (call inside shard_map).
 
@@ -68,6 +81,10 @@ def ring_attention(
     b, t_local, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
+    if use_flash is None:
+        use_flash = _flash_tiles(t_local)
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, scale, n, my_idx)
 
     m0 = jnp.full((b, h, t_local), NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((b, h, t_local), dtype=jnp.float32)
@@ -103,28 +120,63 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True):
+def _ring_flash(q, k, v, axis_name, causal, scale, n, my_idx):
+    """Flash-kernel ring schedule: per arriving K/V block run the Pallas
+    kernel in the right causality mode and merge the (out, lse) partials.
+    Blocks from later shards contribute nothing under causal masking and are
+    skipped via lax.switch (the branch still participates in the merge with
+    lse=-inf, i.e. zero weight)."""
+    b, t_local, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def _full(q, kb, vb):
+        o, lse = flash_attention(q, kb, vb, causal=False, scale=scale, return_lse=True)
+        return o.astype(jnp.float32), lse
+
+    def _causal(q, kb, vb):
+        o, lse = flash_attention(q, kb, vb, causal=True, scale=scale, return_lse=True)
+        return o.astype(jnp.float32), lse
+
+    def _skip(q, kb, vb):
+        return (
+            jnp.zeros((b, t_local, h, d), jnp.float32),
+            jnp.full((b, h, t_local), NEG_INF, jnp.float32),
+        )
+
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
+
+    def step(carry, step_idx):
+        k_blk, v_blk, o, lse = carry
+        src = (my_idx - step_idx) % n
+        if causal:
+            # 0: future shard (skip), 1: diagonal (causal), 2: past (full)
+            mode = jnp.where(src == my_idx, 1, jnp.where(src < my_idx, 2, 0))
+        else:
+            mode = 2
+        ob, lb = lax.switch(mode, [_skip, _causal, _full], q, k_blk, v_blk)
+        o, lse = merge_attention(o, lse, ob, lb)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, o, lse), None
+
+    (_, _, o, _), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True, use_flash=None):
     """Convenience wrapper: shard_map over the sp axis of `mesh` with
     [batch, seq, heads, dim] inputs sharded on seq."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
-    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, use_flash=use_flash
+    )
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)
 
 
-def reference_attention(q, k, v, causal=True, scale=None):
-    """Dense reference for testing: [B, T, H, D] -> [B, T, H, D]."""
-    d = q.shape[-1]
-    if scale is None:
-        scale = d ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-    if causal:
-        t_q, t_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+from ..ops.attention import reference_attention  # noqa: E402  (re-export; test oracle)
