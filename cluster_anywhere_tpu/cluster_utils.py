@@ -1,0 +1,187 @@
+"""In-process multi-node cluster fixture (analogue of
+python/ray/cluster_utils.py:135 `Cluster`).
+
+Starts a head process plus any number of node-agent processes on this host,
+each with its own shm namespace and resource pool, talking to the head over
+TCP exactly as real remote hosts would.  This is how all distributed behavior
+(scheduling spillover, node-to-node object transfer, node death, actor
+restart across nodes) is tested without real multi-host hardware — the same
+strategy the reference uses (cluster_utils.py:202,286 add_node/remove_node).
+
+Usage:
+    cluster = Cluster(head_resources={"CPU": 1})
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()          # ca.init(address=...) as the driver
+    ...
+    cluster.remove_node(nid)   # SIGKILL the agent: simulates node power-off
+    cluster.shutdown()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .core.config import CAConfig
+
+
+class Cluster:
+    def __init__(
+        self,
+        head_resources: Optional[Dict[str, float]] = None,
+        config: Optional[CAConfig] = None,
+        connect: bool = False,
+    ):
+        self.config = config or CAConfig()
+        root = self.config.session_dir_root
+        os.makedirs(root, exist_ok=True)
+        self.session_dir = os.path.join(
+            root, f"session_{int(time.time() * 1000)}_{os.getpid()}"
+        )
+        os.makedirs(self.session_dir, exist_ok=True)
+        self._node_seq = 0
+        self._agents: Dict[str, subprocess.Popen] = {}
+        self._connected = False
+        resources = dict(head_resources or {"CPU": 0.0})
+        resources.setdefault("memory", float(self.config.object_store_memory))
+
+        env = self._base_env()
+        env["CA_RESOURCES"] = json.dumps(resources)
+        env["CA_HEAD_PERSIST"] = "1"  # fixture controls teardown, not drivers
+        head_log = open(os.path.join(self.session_dir, "head.log"), "ab")
+        self._head_proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_anywhere_tpu.core.head"],
+            env=env,
+            stdout=head_log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        head_log.close()
+        self._wait_for_file(os.path.join(self.session_dir, "head.ready"), 30)
+        self.head_tcp = open(os.path.join(self.session_dir, "head.addr")).read().strip()
+        if connect:
+            self.connect()
+
+    def _base_env(self) -> dict:
+        env = dict(os.environ)
+        env["CA_SESSION_DIR"] = self.session_dir
+        env["CA_CONFIG_JSON"] = self.config.to_json()
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+
+    @staticmethod
+    def _wait_for_file(path: str, timeout: float):
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(path):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"timed out waiting for {path}")
+            time.sleep(0.01)
+
+    # ------------------------------------------------------------------ nodes
+    def add_node(
+        self,
+        num_cpus: float = 4,
+        num_tpus: float = 0,
+        resources: Optional[Dict[str, float]] = None,
+        node_id: Optional[str] = None,
+    ) -> str:
+        """Start a node-agent process and wait for it to join the cluster."""
+        self._node_seq += 1
+        nid = node_id or f"node{self._node_seq}"
+        shape: Dict[str, float] = {"CPU": float(num_cpus)}
+        if num_tpus:
+            shape["TPU"] = float(num_tpus)
+        shape.setdefault("memory", float(self.config.object_store_memory))
+        if resources:
+            shape.update({k: float(v) for k, v in resources.items()})
+        env = self._base_env()
+        env["CA_HEAD_ADDR"] = self.head_tcp
+        env["CA_NODE_ID"] = nid
+        env["CA_NODE_RESOURCES"] = json.dumps(shape)
+        node_dir = os.path.join(self.session_dir, "nodes", nid)
+        os.makedirs(node_dir, exist_ok=True)
+        agent_log = open(os.path.join(node_dir, "agent.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_anywhere_tpu.core.nodeagent"],
+            env=env,
+            stdout=agent_log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        agent_log.close()
+        self._agents[nid] = proc
+        self._wait_for_file(os.path.join(node_dir, "agent.ready"), 30)
+        return nid
+
+    def remove_node(self, node_id: str, graceful: bool = False):
+        """Kill a node: SIGKILL the agent (simulated power-off; the head
+        detects the death via connection drop / missed heartbeats and fences
+        the node's workers, which exit on their closed head connections)."""
+        proc = self._agents.pop(node_id, None)
+        if proc is None:
+            raise ValueError(f"unknown node {node_id!r}")
+        try:
+            os.kill(proc.pid, signal.SIGTERM if graceful else signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait(timeout=10)
+
+    def nodes(self) -> List[dict]:
+        from .core import api
+
+        return api.nodes()
+
+    def wait_for_nodes(self, n: int, timeout: float = 30) -> None:
+        """Block until `n` nodes (including the head node) are alive."""
+        from .core.worker import global_worker
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = [x for x in self.nodes() if x["alive"]]
+            if len(alive) >= n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"cluster did not reach {n} alive nodes")
+
+    # ----------------------------------------------------------------- driver
+    def connect(self) -> dict:
+        from .core import api
+
+        info = api.init(address=self.session_dir)
+        self._connected = True
+        return info
+
+    def shutdown(self):
+        from .core import api
+
+        if self._connected:
+            try:
+                api.shutdown()
+            except Exception:
+                pass
+            self._connected = False
+        for nid in list(self._agents):
+            try:
+                self.remove_node(nid)
+            except Exception:
+                pass
+        if self._head_proc.poll() is None:
+            try:
+                os.kill(self._head_proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self._head_proc.wait(timeout=10)
+        import shutil
+
+        shutil.rmtree(
+            os.path.join("/dev/shm", os.path.basename(self.session_dir)),
+            ignore_errors=True,
+        )
+        shutil.rmtree(self.session_dir, ignore_errors=True)

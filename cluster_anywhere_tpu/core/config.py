@@ -43,6 +43,10 @@ class CAConfig:
     worker_prestart: bool = True
     scheduler_spread_threshold: float = 0.5  # hybrid policy: pack below, spread above
 
+    # --- multi-node ---
+    head_host: str = "127.0.0.1"  # TCP bind host for the head (cross-host: 0.0.0.0)
+    transfer_chunk_bytes: int = 4 * 1024**2  # node-to-node object pull chunk
+
     # --- health / failure detection ---
     health_check_period_s: float = 2.0
     health_check_failure_threshold: int = 5

@@ -2,16 +2,21 @@
 
 One process per cluster.  Owns cluster metadata and cluster-wide decisions,
 mirroring the subsystem split of the reference's GCS server
-(src/ray/gcs/gcs_server/gcs_server.h): node/worker tables, worker pool,
-resource accounting + lease scheduler, actor directory with restart FSM,
-placement groups, namespaced KV, pubsub, object directory with refcount GC,
-and health checking.  Workers and drivers talk to it over the msgpack unix
-socket protocol (protocol.py); the hot task path does NOT go through the head
-— drivers lease workers and push tasks directly (normal_task_submitter.h
-lease model).
+(src/ray/gcs/gcs_server/gcs_server.h): node table with joins/deaths
+(gcs_node_manager.h), worker tables, per-node worker pools, resource
+accounting + lease scheduler with pluggable policies (scheduling.py),
+actor directory with restart FSM, placement groups with multi-node bundle
+placement, namespaced KV, pubsub, object directory with locations + refcount
+GC, and health checking.  Workers and drivers talk to it over the msgpack
+protocol (protocol.py: unix sockets same-host, TCP across hosts); the hot
+task path does NOT go through the head — drivers lease workers and push tasks
+directly (normal_task_submitter.h lease model).
 
-This is the Python reference implementation of the control plane; the C++
-port (native/) replaces it subsystem-by-subsystem behind the same protocol.
+Multi-node topology: the head embeds the local node ("n0": it spawns and
+monitors that node's workers directly, and serves that node's object pulls).
+Every other node runs a node agent (nodeagent.py, the raylet analogue) that
+registers here over TCP, spawns workers on head request, reports their
+deaths, and serves chunked object pulls from its node's shm namespace.
 """
 
 from __future__ import annotations
@@ -26,9 +31,12 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from . import scheduling
 from .config import CAConfig
 from .errors import ActorDiedError, PlacementGroupError
-from .protocol import Connection, Server, connect_unix, write_frame
+from .protocol import Connection, Server, connect_addr, spawn_bg, write_frame
+
+LOCAL_NODE = "n0"
 
 # --------------------------------------------------------------------------
 # state records
@@ -36,10 +44,30 @@ from .protocol import Connection, Server, connect_unix, write_frame
 
 
 @dataclass
+class NodeRec:
+    node_id: str
+    addr: Optional[str]  # agent RPC address; None = head-embedded local node
+    total: Dict[str, float]
+    avail: Dict[str, float]
+    index: int = 0  # join order (scheduling tiebreak: pack onto earliest)
+    state: str = "alive"  # alive | dead
+    pid: int = 0
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    idle: Dict[str, deque] = field(default_factory=lambda: {"cpu": deque(), "tpu": deque()})
+    conn: Optional[Connection] = None  # head -> agent connection
+    max_workers: int = 64
+
+    @property
+    def is_local(self) -> bool:
+        return self.addr is None
+
+
+@dataclass
 class WorkerRec:
     worker_id: str
     pid: int
-    addr: str  # unix socket path it serves
+    addr: str  # address it serves (unix: same host, tcp: other nodes)
+    node_id: str = LOCAL_NODE
     proc: Optional[subprocess.Popen] = None
     state: str = "starting"  # starting | idle | leased | actor | dead
     purpose: str = "pool"  # pool | actor — actor workers never join the idle pool
@@ -69,8 +97,10 @@ class ActorRec:
     pg_id: Optional[str] = None
     bundle_index: int = -1
     runtime_env: Optional[dict] = None
+    strategy: Optional[dict] = None  # scheduling strategy wire dict
+    node_id: Optional[str] = None  # where this incarnation runs
     # where this incarnation's resources are currently charged:
-    # "pg" (bundle.used) | "node" (self.avail) | None (not charged) — guards
+    # "pg" (bundle.used) | "node" (node.avail) | None (not charged) — guards
     # against double-crediting when a PG is removed before the actor's
     # worker-death event is processed
     charged: Optional[str] = None
@@ -82,6 +112,8 @@ class ObjectRec:
     shm_name: Optional[str]
     size: int
     owner: str  # client id of owner process
+    node_id: str = LOCAL_NODE  # node holding the primary copy
+    copies: Dict[str, str] = field(default_factory=dict)  # node_id -> shm_name
     holders: set = field(default_factory=set)  # client ids holding refs
     owner_released: bool = False
 
@@ -94,12 +126,14 @@ class LeaseReq:
     client: str
     pg_id: Optional[str] = None
     bundle_index: int = -1
+    strategy: Optional[dict] = None
 
 
 @dataclass
 class BundleRec:
     resources: Dict[str, float]
     used: Dict[str, float] = field(default_factory=dict)
+    node_id: Optional[str] = None  # assigned node (None until placed)
 
 
 @dataclass
@@ -107,7 +141,7 @@ class PGRec:
     pg_id: str
     bundles: List[BundleRec]
     strategy: str
-    state: str = "created"  # "pending" until resources free up, then "created"
+    state: str = "created"  # "pending" until all bundles placed, then "created"
 
 
 # --------------------------------------------------------------------------
@@ -119,11 +153,11 @@ class Head:
         self.session_name = os.path.basename(session_dir)
         self.config = config
         self.sock_path = os.path.join(session_dir, "head.sock")
-        self.node_id = os.urandom(8).hex()
-        # -- resources (single node round 1; table keyed by node for the
-        # multi-node milestone) --
-        self.total_resources = dict(resources)
-        self.avail = dict(resources)
+        self.tcp_addr: Optional[str] = None  # filled after server start
+        # -- node table (gcs_node_manager.h analogue); the head embeds n0 --
+        self.nodes: Dict[str, NodeRec] = {}
+        self._node_index = 0
+        self._add_node(NodeRec(LOCAL_NODE, None, dict(resources), dict(resources)))
         # -- tables --
         self.workers: Dict[str, WorkerRec] = {}
         self.actors: Dict[str, ActorRec] = {}
@@ -135,21 +169,21 @@ class Head:
         self.pgs: Dict[str, PGRec] = {}
         self.pending_pgs: deque = deque()  # PG ids awaiting resources, FIFO
         self._pg_waiters: Dict[str, List[asyncio.Future]] = {}
-        # -- worker pool (keyed: cpu workers strip the TPU runtime env for
-        # fast start and to keep the chip free; tpu workers keep it) --
-        self.idle_workers: Dict[str, deque] = {"cpu": deque(), "tpu": deque()}
         self.pending_leases: deque[LeaseReq] = deque()
         self.leases: Dict[str, str] = {}  # lease_id -> worker_id
         self._lease_shapes: Dict[str, Dict[str, float]] = {}
         self._lease_pg: Dict[str, tuple] = {}  # lease_id -> (pg_id, bundle_index)
+        self._lease_node: Dict[str, str] = {}  # lease_id -> node_id
         self._spawn_count = 0
-        self.max_workers = int(resources.get("CPU", 4)) * 4 + 4
         # -- conns --
         self._worker_conns: Dict[str, Connection] = {}
         self._clients: Dict[str, dict] = {}  # client_id -> conn state
         self._register_waiters: Dict[str, asyncio.Future] = {}
         self.subscribers: Dict[str, List[Any]] = {}  # channel -> [writer]
-        self.server = Server(self.sock_path, self._handle, self._on_disconnect)
+        host = getattr(config, "head_host", "127.0.0.1")
+        self.server = Server(
+            [self.sock_path, f"tcp:{host}:0"], self._handle, self._on_disconnect
+        )
         self.stats = {
             "leases_granted": 0,
             "tasks_pushed": 0,
@@ -158,6 +192,9 @@ class Head:
             "objects_created": 0,
             "objects_gc": 0,
             "workers_spawned": 0,
+            "nodes_joined": 0,
+            "nodes_died": 0,
+            "objects_transferred": 0,
         }
         self._shutdown = asyncio.Event()
         self._driver_clients: set = set()
@@ -167,6 +204,42 @@ class Head:
         self.metrics: Dict[str, dict] = {}  # name -> {type, desc, data{tags_key: ...}}
         # structured lifecycle event log (util/event.h analogue): JSONL file
         self._event_log = open(os.path.join(session_dir, "events.jsonl"), "a", buffering=1)
+        # pull-side file maps for serving n0's object chunks
+        self._pull_maps: Dict[str, Any] = {}
+
+    def _add_node(self, node: NodeRec) -> NodeRec:
+        node.index = self._node_index
+        self._node_index += 1
+        node.max_workers = int(node.total.get("CPU", 4)) * 4 + 4
+        self.nodes[node.node_id] = node
+        return node
+
+    @property
+    def local_node(self) -> NodeRec:
+        return self.nodes[LOCAL_NODE]
+
+    def _alive_nodes(self) -> List[NodeRec]:
+        return [n for n in self.nodes.values() if n.state == "alive"]
+
+    def _node_views(self, nodes: Optional[List[NodeRec]] = None) -> List[scheduling.NodeView]:
+        return [
+            scheduling.NodeView(n.node_id, n.total, n.avail, n.index)
+            for n in (nodes if nodes is not None else self._alive_nodes())
+        ]
+
+    def _agg_total(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self._alive_nodes():
+            for k, v in n.total.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def _agg_avail(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self._alive_nodes():
+            for k, v in n.avail.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
 
     def _log_event(self, kind: str, **fields):
         import json as _json
@@ -190,7 +263,7 @@ class Head:
             self.subscribers[channel].remove(w)
 
     def _fits(self, avail: Dict[str, float], shape: Dict[str, float]) -> bool:
-        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in shape.items())
+        return scheduling.fits(avail, shape)
 
     def _take(self, avail: Dict[str, float], shape: Dict[str, float]):
         for k, v in shape.items():
@@ -201,9 +274,13 @@ class Head:
             avail[k] = avail.get(k, 0.0) + v
 
     # ------------------------------------------------------------ worker pool
-    def _spawn_worker(self, purpose: str = "pool", pool: str = "cpu") -> WorkerRec:
+    def _new_wid(self) -> str:
         self._spawn_count += 1
-        wid = f"w{self._spawn_count:04d}"
+        return f"w{self._spawn_count:04d}"
+
+    def _spawn_worker(self, purpose: str = "pool", pool: str = "cpu") -> WorkerRec:
+        """Spawn a worker process on the local (head-embedded) node."""
+        wid = self._new_wid()
         addr = os.path.join(self.session_dir, f"{wid}.sock")
         log_path = os.path.join(self.session_dir, f"{wid}.log")
         env = dict(os.environ)
@@ -211,6 +288,7 @@ class Head:
         env["CA_HEAD_SOCK"] = self.sock_path
         env["CA_WORKER_ID"] = wid
         env["CA_WORKER_SOCK"] = addr
+        env["CA_NODE_ID"] = LOCAL_NODE
         env["CA_CONFIG_JSON"] = self.config.to_json()
         if pool != "tpu":
             # CPU workers must not grab the accelerator: drop the TPU runtime
@@ -234,10 +312,37 @@ class Head:
         self.stats["workers_spawned"] += 1
         return rec
 
+    def _spawn_worker_on(self, node: NodeRec, purpose: str = "pool", pool: str = "cpu") -> WorkerRec:
+        """Spawn a worker on any node: directly for the local node, via the
+        node agent RPC otherwise (the agent is the raylet-analogue process
+        that owns worker lifecycles on its host)."""
+        if node.is_local:
+            return self._spawn_worker(purpose=purpose, pool=pool)
+        wid = self._new_wid()
+        rec = WorkerRec(worker_id=wid, pid=0, addr="", node_id=node.node_id,
+                        purpose=purpose, pool=pool)
+        self.workers[wid] = rec
+        self.stats["workers_spawned"] += 1
+
+        async def _ask_agent():
+            try:
+                await node.conn.call("spawn_worker", wid=wid, purpose=purpose, pool=pool)
+            except Exception:
+                rec.state = "dead"
+                fut = self._register_waiters.pop(wid, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(False)
+                # a pending lease may have been waiting on this spawn; give
+                # the scheduler a chance to spawn elsewhere
+                self._service_queue()
+
+        spawn_bg(_ask_agent())
+        return rec
+
     async def _worker_conn(self, rec: WorkerRec) -> Connection:
         conn = self._worker_conns.get(rec.worker_id)
         if conn is None or conn.closed:
-            conn = await connect_unix(rec.addr)
+            conn = await connect_addr(rec.addr)
             self._worker_conns[rec.worker_id] = conn
         return conn
 
@@ -258,32 +363,54 @@ class Head:
         return "tpu" if shape.get("TPU") else "cpu"
 
     def _ensure_pool(self):
-        """Prestart/grow each pool when demand outstrips idle workers.
-
-        Demand is capped by what the node's free resources could actually
-        grant — queued lease requests beyond resource capacity must not spawn
-        processes (they'd sit idle and thrash the CPU starting up)."""
-        n_alive = sum(1 for w in self.workers.values() if w.state != "dead")
-        for pool in ("cpu", "tpu"):
-            demand = 0
-            sim_avail = dict(self.avail)
-            for r in self.pending_leases:
-                if self._pool_key(r.shape) == pool and (
-                    r.pg_id or self._fits(sim_avail, r.shape)
-                ):
-                    demand += 1
-                    if not r.pg_id:
-                        self._take(sim_avail, r.shape)
-            want = demand - len(self.idle_workers[pool])
-            want -= sum(
-                1
-                for w in self.workers.values()
-                if w.state == "starting" and w.purpose == "pool" and w.pool == pool
-            )
-            while want > 0 and n_alive < self.max_workers:
-                self._spawn_worker(pool=pool)
+        """Prestart/grow per-node worker pools when demand outstrips idle
+        workers.  Demand is computed by simulating placement of the queued
+        lease requests onto the alive nodes (policy-faithful: spawn where the
+        scheduler will grant), capped by each node's free resources."""
+        alive = self._alive_nodes()
+        if not alive:
+            return
+        views = self._node_views(alive)
+        demand: Dict[tuple, int] = {}
+        for r in self.pending_leases:
+            pool = self._pool_key(r.shape)
+            if r.pg_id:
+                pg = self.pgs.get(r.pg_id)
+                if pg is None or pg.state != "created":
+                    continue
+                if not (0 <= r.bundle_index < len(pg.bundles)):
+                    continue
+                nid = pg.bundles[r.bundle_index].node_id
+                if nid is None:
+                    continue
+                demand[(nid, pool)] = demand.get((nid, pool), 0) + 1
+            else:
+                view = scheduling.pick_node(
+                    views, r.shape, r.strategy, self.config.scheduler_spread_threshold
+                )
+                if view is None:
+                    continue
+                scheduling.take(view.avail, r.shape)
+                demand[(view.node_id, pool)] = demand.get((view.node_id, pool), 0) + 1
+        per_node_alive: Dict[str, int] = {}
+        per_node_starting: Dict[tuple, int] = {}
+        for w in self.workers.values():
+            if w.state != "dead":
+                per_node_alive[w.node_id] = per_node_alive.get(w.node_id, 0) + 1
+            if w.state == "starting" and w.purpose == "pool":
+                key = (w.node_id, w.pool)
+                per_node_starting[key] = per_node_starting.get(key, 0) + 1
+        for (nid, pool), d in demand.items():
+            node = self.nodes.get(nid)
+            if node is None or node.state != "alive":
+                continue
+            want = d - len(node.idle[pool]) - per_node_starting.get((nid, pool), 0)
+            n_alive = per_node_alive.get(nid, 0)
+            while want > 0 and n_alive < node.max_workers:
+                self._spawn_worker_on(node, pool=pool)
                 want -= 1
                 n_alive += 1
+                per_node_alive[nid] = n_alive
 
     # ------------------------------------------------------------- scheduler
     def _bundle_avail(self, pg_id: str, bundle_index: int) -> Optional[Dict[str, float]]:
@@ -293,14 +420,43 @@ class Head:
         b = pg.bundles[bundle_index]
         return {k: v - b.used.get(k, 0.0) for k, v in b.resources.items()}
 
+    def _grant_on_node(self, node: NodeRec, req: LeaseReq) -> bool:
+        """Pop an idle worker of the right pool on `node` and grant the lease.
+        Returns False if the node has no usable idle worker."""
+        pool = node.idle[self._pool_key(req.shape)]
+        while pool:
+            wid = pool.popleft()
+            rec = self.workers.get(wid)
+            if rec is None or rec.state != "idle":
+                continue
+            if req.pg_id:
+                b = self.pgs[req.pg_id].bundles[req.bundle_index]
+                for k, v in req.shape.items():
+                    b.used[k] = b.used.get(k, 0.0) + v
+            else:
+                self._take(node.avail, req.shape)
+            lease_id = f"l{os.urandom(6).hex()}"
+            rec.state = "leased"
+            rec.lease_id = lease_id
+            self.leases[lease_id] = wid
+            self._lease_shapes[lease_id] = dict(req.shape)
+            self._lease_node[lease_id] = node.node_id
+            if req.pg_id:
+                self._lease_pg[lease_id] = (req.pg_id, req.bundle_index)
+            self.stats["leases_granted"] += 1
+            req.reply(lease_id=lease_id, worker_id=wid, addr=rec.addr)
+            return True
+        return False
+
     def _try_grant(self, req: LeaseReq) -> bool:
-        # resource admission: from a PG bundle or the node pool
+        # resource admission: from a PG bundle (on the bundle's node) or from
+        # a node chosen by the scheduling policy
         if req.pg_id:
             pg = self.pgs.get(req.pg_id)
             if pg is not None and pg.state != "created":
-                # bundles of a pending PG were never deducted from avail;
-                # granting against them would oversubscribe the node — wait
-                # (requeue) until _service_pending_pgs creates the PG
+                # bundles of a pending PG were never deducted from any node's
+                # avail; granting against them would oversubscribe — wait
+                # (requeue) until _service_pending_pgs places the PG
                 return False
             avail = self._bundle_avail(req.pg_id, req.bundle_index)
             if avail is None:
@@ -308,31 +464,41 @@ class Head:
                 return True
             if not self._fits(avail, req.shape):
                 return False
-        elif not self._fits(self.avail, req.shape):
-            return False
-        pool = self.idle_workers[self._pool_key(req.shape)]
-        if not pool:
-            return False
-        wid = pool.popleft()
-        rec = self.workers.get(wid)
-        if rec is None or rec.state != "idle":
-            return self._try_grant(req)
-        if req.pg_id:
-            b = self.pgs[req.pg_id].bundles[req.bundle_index]
-            for k, v in req.shape.items():
-                b.used[k] = b.used.get(k, 0.0) + v
+            nid = pg.bundles[req.bundle_index].node_id
+            node = self.nodes.get(nid)
+            if node is None or node.state != "alive":
+                return False
+            return self._grant_on_node(node, req)
+        # policy-ranked candidates; grant on the first that has an idle worker
+        views = self._node_views()
+        threshold = self.config.scheduler_spread_threshold
+        kind = (req.strategy or {}).get("type", "DEFAULT")
+        if kind == "NODE_AFFINITY":
+            want = req.strategy.get("node_id")
+            node = self.nodes.get(want)
+            if node is not None and node.state == "alive" and self._fits(node.avail, req.shape):
+                if self._grant_on_node(node, req):
+                    return True
+                return False  # wait for a worker on that node
+            if not req.strategy.get("soft", False):
+                if node is None or node.state != "alive":
+                    req.reply_err(
+                        ValueError(f"node {want!r} not available for NODE_AFFINITY")
+                    )
+                    return True
+                return False
+            ranked = scheduling.rank_hybrid(views, threshold)
+        elif kind == "SPREAD":
+            ranked = scheduling.rank_spread(views)
         else:
-            self._take(self.avail, req.shape)
-        lease_id = f"l{os.urandom(6).hex()}"
-        rec.state = "leased"
-        rec.lease_id = lease_id
-        self.leases[lease_id] = wid
-        self._lease_shapes[lease_id] = dict(req.shape)
-        if req.pg_id:
-            self._lease_pg[lease_id] = (req.pg_id, req.bundle_index)
-        self.stats["leases_granted"] += 1
-        req.reply(lease_id=lease_id, worker_id=wid, addr=rec.addr)
-        return True
+            ranked = scheduling.rank_hybrid(views, threshold)
+        for view in ranked:
+            if not scheduling.fits(view.avail, req.shape):
+                continue
+            node = self.nodes[view.node_id]
+            if self._grant_on_node(node, req):
+                return True
+        return False
 
     def _service_queue(self):
         # pending PGs reserve first: their creation was requested before the
@@ -353,6 +519,7 @@ class Head:
         wid = self.leases.pop(lease_id, None)
         shape = self._lease_shapes.pop(lease_id, None)
         pg = self._lease_pg.pop(lease_id, None)
+        nid = self._lease_node.pop(lease_id, None)
         if shape is not None:
             if pg is not None:
                 pgrec = self.pgs.get(pg[0])
@@ -361,26 +528,32 @@ class Head:
                     for k, v in shape.items():
                         b.used[k] = b.used.get(k, 0.0) - v
             else:
-                self._give(self.avail, shape)
+                node = self.nodes.get(nid or LOCAL_NODE)
+                if node is not None and node.state == "alive":
+                    self._give(node.avail, shape)
         if wid is not None:
             rec = self.workers.get(wid)
             if rec is not None and rec.state == "leased":
                 if worker_ok:
                     rec.state = "idle"
                     rec.lease_id = None
-                    self.idle_workers[rec.pool].append(wid)
+                    node = self.nodes.get(rec.node_id)
+                    if node is not None and node.state == "alive":
+                        node.idle[rec.pool].append(wid)
         self._service_queue()
 
     # --------------------------------------------------------------- actors
     async def _place_actor(self, a: ActorRec):
-        """Spawn a dedicated worker and run the actor creation task on it.
-        Mirrors GcsActorScheduler: lease resources, push creation, publish."""
+        """Pick a node for the actor, spawn a dedicated worker there, and run
+        the actor creation task on it.  Mirrors GcsActorScheduler: lease
+        resources, push creation, publish."""
+        node: Optional[NodeRec] = None
         if a.pg_id:
             pg = self.pgs.get(a.pg_id)
             if pg is not None and pg.state == "pending":
                 # wait for the PG's resources to actually be reserved; placing
                 # into a pending PG would charge a bundle whose capacity was
-                # never taken from avail (oversubscription)
+                # never taken from a node (oversubscription)
                 fut: asyncio.Future = asyncio.get_running_loop().create_future()
                 self._pg_waiters.setdefault(a.pg_id, []).append(fut)
                 try:
@@ -391,20 +564,29 @@ class Head:
             ok = avail is not None and self._fits(avail, a.resources)
             if ok:
                 b = self.pgs[a.pg_id].bundles[a.bundle_index]
-                for k, v in a.resources.items():
-                    b.used[k] = b.used.get(k, 0.0) + v
-                a.charged = "pg"
+                node = self.nodes.get(b.node_id) if b.node_id else None
+                ok = node is not None and node.state == "alive"
+                if ok:
+                    for k, v in a.resources.items():
+                        b.used[k] = b.used.get(k, 0.0) + v
+                    a.charged = "pg"
         else:
-            ok = self._fits(self.avail, a.resources)
+            view = scheduling.pick_node(
+                self._node_views(), a.resources, a.strategy,
+                self.config.scheduler_spread_threshold,
+            )
+            ok = view is not None
             if ok:
-                self._take(self.avail, a.resources)
+                node = self.nodes[view.node_id]
+                self._take(node.avail, a.resources)
                 a.charged = "node"
-        if not ok:
+        if not ok or node is None:
             a.state = "dead"
             a.death_cause = "resources unavailable for actor"
             self._pub("actors", self._actor_info(a))
             return
-        rec = self._spawn_worker(purpose="actor", pool=self._pool_key(a.resources))
+        a.node_id = node.node_id
+        rec = self._spawn_worker_on(node, purpose="actor", pool=self._pool_key(a.resources))
         rec.actor_id = a.actor_id
         a.worker_id = rec.worker_id
         if not await self._wait_registered(rec):
@@ -426,7 +608,9 @@ class Head:
             )
             a.state = "alive"
             self.stats["actors_created"] += 1
-            self._log_event("actor_alive", actor_id=a.actor_id, worker_id=a.worker_id)
+            self._log_event(
+                "actor_alive", actor_id=a.actor_id, worker_id=a.worker_id, node_id=a.node_id
+            )
         except Exception as e:
             a.state = "dead"
             a.death_cause = f"actor __init__ failed: {e!r}"
@@ -440,6 +624,7 @@ class Head:
             "incarnation": a.incarnation,
             "name": a.name,
             "death_cause": a.death_cause,
+            "node_id": a.node_id,
         }
 
     async def _on_worker_death(self, rec: WorkerRec):
@@ -447,17 +632,29 @@ class Head:
             return
         prev_state = rec.state
         rec.state = "dead"
-        self._log_event("worker_died", worker_id=rec.worker_id, prev_state=prev_state)
+        self._log_event(
+            "worker_died", worker_id=rec.worker_id, prev_state=prev_state, node_id=rec.node_id
+        )
         fut = self._register_waiters.pop(rec.worker_id, None)
         if fut is not None and not fut.done():
             fut.set_result(False)
         conn = self._worker_conns.pop(rec.worker_id, None)
         if conn is not None:
             await conn.close()
-        try:
-            self.idle_workers[rec.pool].remove(rec.worker_id)
-        except ValueError:
-            pass
+        # fence the worker: close its registration connection so a live-but-
+        # declared-dead process exits instead of acting on stale leases
+        client_state = self._clients.get(rec.worker_id)
+        if client_state is not None:
+            try:
+                client_state["writer"].close()
+            except Exception:
+                pass
+        node = self.nodes.get(rec.node_id)
+        if node is not None:
+            try:
+                node.idle[rec.pool].remove(rec.worker_id)
+            except ValueError:
+                pass
         if rec.blocked:
             # its cpus were returned to the pool at block time; take them back
             # before the lease/actor release re-adds them (double-free guard)
@@ -467,8 +664,8 @@ class Head:
             elif rec.actor_id and rec.actor_id in self.actors:
                 shape = self.actors[rec.actor_id].resources
             cpus = (shape or {}).get("CPU", 0.0)
-            if cpus:
-                self._take(self.avail, {"CPU": cpus})
+            if cpus and node is not None and node.state == "alive":
+                self._take(node.avail, {"CPU": cpus})
             rec.blocked = False
         if rec.lease_id:
             self._release_lease(rec.lease_id, worker_ok=False)
@@ -484,7 +681,9 @@ class Head:
                         for k, v in a.resources.items():
                             b.used[k] = b.used.get(k, 0.0) - v
                 elif a.charged == "node":
-                    self._give(self.avail, a.resources)
+                    anode = self.nodes.get(a.node_id or LOCAL_NODE)
+                    if anode is not None and anode.state == "alive":
+                        self._give(anode.avail, a.resources)
                 a.charged = None
                 if a.max_restarts != 0 and (
                     a.max_restarts < 0 or a.restarts_used < a.max_restarts
@@ -510,27 +709,105 @@ class Head:
         if a.name and self.named_actors.get(a.name) == a.actor_id:
             del self.named_actors[a.name]
 
+    # ---------------------------------------------------------------- nodes
+    async def _connect_agent(self, node: NodeRec):
+        try:
+            node.conn = await connect_addr(node.addr)
+        except Exception as e:
+            self._log_event("agent_connect_failed", node_id=node.node_id, error=repr(e))
+            await self._on_node_death(node)
+
+    async def _on_node_death(self, node: NodeRec):
+        """Node agent died or went silent: everything on it is gone.
+        Mirrors GcsNodeManager::OnNodeFailure + per-manager node-death hooks."""
+        if node.state == "dead":
+            return
+        node.state = "dead"
+        self.stats["nodes_died"] += 1
+        self._log_event("node_died", node_id=node.node_id)
+        if node.conn is not None:
+            await node.conn.close()
+            node.conn = None
+        # fence the agent: close its registration connection so an agent
+        # declared dead by heartbeat timeout tears itself down (kills its
+        # workers, sweeps its shm namespace) instead of zombieing on
+        agent_state = self._clients.get(node.node_id)
+        if agent_state is not None:
+            try:
+                agent_state["writer"].close()
+            except Exception:
+                pass
+        # workers on the node are dead (their lease/actor cleanup runs through
+        # the normal worker-death path; node.avail credits are skipped because
+        # the node is already marked dead)
+        for rec in list(self.workers.values()):
+            if rec.node_id == node.node_id and rec.state != "dead":
+                await self._on_worker_death(rec)
+        # objects: promote a surviving copy to primary, else the object is
+        # lost (locate -> not found -> ObjectLostError / reconstruction)
+        for rec in list(self.objects.values()):
+            rec.copies.pop(node.node_id, None)
+            if rec.node_id == node.node_id:
+                if rec.copies:
+                    new_node, new_name = next(iter(rec.copies.items()))
+                    rec.node_id, rec.shm_name = new_node, new_name
+                    del rec.copies[new_node]
+                else:
+                    self.objects.pop(rec.oid, None)
+                    self._log_event("object_lost", oid=rec.oid.hex(), node_id=node.node_id)
+        # placement groups: bundles on the dead node lose their reservation
+        # and the PG goes back to pending for re-placement (reference:
+        # GcsPlacementGroupManager::OnNodeDead reschedules)
+        for pg in self.pgs.values():
+            hit = False
+            for b in pg.bundles:
+                if b.node_id == node.node_id:
+                    b.node_id = None
+                    b.used = {}
+                    hit = True
+            if hit and pg.state == "created":
+                pg.state = "pending"
+                self.pending_pgs.append(pg.pg_id)
+                self._log_event("pg_rescheduling", pg_id=pg.pg_id)
+        self._pub("nodes", {"node_id": node.node_id, "alive": False})
+        self._service_queue()
+
     # --------------------------------------------------------------- objects
+    def _free_shm_name(self, shm_name: str, node_id: str):
+        """Release one physical copy: arena slices are reclaimed by their
+        creating process's allocator (pubsub), dedicated segments unlinked on
+        the node that holds them (locally for n0, via the agent otherwise)."""
+        if "@" in shm_name:
+            # arena slice: only the creating process's allocator can reclaim
+            # it — parse the creator out of the arena file name,
+            # .../arena_<client_id>_<seq>.
+            fname = shm_name.split("@", 1)[0].rsplit("/", 1)[-1]
+            cid = fname[len("arena_"): fname.rfind("_")]
+            self._pub(f"shm_free:{cid}", {"shm_name": shm_name})
+            return
+        if node_id == LOCAL_NODE:
+            drop_pull_map(self._pull_maps, shm_name)
+            path = os.path.join("/dev/shm", shm_name)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+        else:
+            node = self.nodes.get(node_id)
+            if node is not None and node.conn is not None and not node.conn.closed:
+                try:
+                    node.conn.notify("unlink_shm", shm_name=shm_name)
+                except Exception:
+                    pass
+
     def _obj_maybe_gc(self, rec: ObjectRec):
         if rec.owner_released and not rec.holders:
             self.objects.pop(rec.oid, None)
             self.stats["objects_gc"] += 1
             if rec.shm_name:
-                if "@" in rec.shm_name:
-                    # arena slice: only the creating process's allocator can
-                    # reclaim it.  That is NOT rec.owner (task returns are
-                    # owned by the submitter but written into the executing
-                    # worker's arena) — parse the creator out of the arena
-                    # file name, arena_<client_id>_<seq>.
-                    fname = rec.shm_name.split("@", 1)[0].rsplit("/", 1)[-1]
-                    cid = fname[len("arena_") : fname.rfind("_")]
-                    self._pub(f"shm_free:{cid}", {"shm_name": rec.shm_name})
-                    return
-                path = os.path.join("/dev/shm", rec.shm_name)
-                try:
-                    os.unlink(path)
-                except FileNotFoundError:
-                    pass
+                self._free_shm_name(rec.shm_name, rec.node_id)
+            for nid, name in rec.copies.items():
+                self._free_shm_name(name, nid)
 
     # --------------------------------------------------------------- handler
     async def _handle(self, state, msg, reply, reply_err):
@@ -547,6 +824,10 @@ class Head:
         state["client_id"] = client_id
         state["role"] = role
         self._clients[client_id] = state
+        if role == "agent":
+            await self._register_agent(state, msg, reply, reply_err)
+            return
+        state["node_id"] = msg.get("node_id", LOCAL_NODE)
         # every client gets its private shm-reclaim channel (arena slices can
         # only be freed by their owner's allocator)
         self.subscribers.setdefault(f"shm_free:{client_id}", []).append(state["writer"])
@@ -555,24 +836,73 @@ class Head:
         if role == "worker":
             rec = self.workers.get(client_id)
             if rec is None:
-                # externally started worker (future multi-node); register it
-                rec = WorkerRec(client_id, msg.get("pid", 0), msg["addr"])
+                # externally started worker; register it on its node
+                rec = WorkerRec(
+                    client_id, msg.get("pid", 0), msg["addr"],
+                    node_id=msg.get("node_id", LOCAL_NODE),
+                )
                 self.workers[client_id] = rec
+            if msg.get("addr"):
+                rec.addr = msg["addr"]
+            if msg.get("pid"):
+                rec.pid = msg["pid"]
             rec.last_heartbeat = time.monotonic()
             if rec.purpose == "actor":
                 rec.state = "actor"
             else:
                 rec.state = "idle"
-                self.idle_workers[rec.pool].append(client_id)
+                node = self.nodes.get(rec.node_id)
+                if node is not None and node.state == "alive":
+                    node.idle[rec.pool].append(client_id)
             fut = self._register_waiters.pop(client_id, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
             self._service_queue()
         reply(
-            node_id=self.node_id,
+            node_id=state["node_id"],
             session=self.session_name,
-            resources=self.total_resources,
+            resources=self._agg_total(),
+            head_tcp=self.tcp_addr,
         )
+
+    async def _register_agent(self, state, msg, reply, reply_err):
+        node_id = msg["client_id"]
+        existing = self.nodes.get(node_id)
+        if existing is not None and existing.state == "alive":
+            reply_err(ValueError(f"node id {node_id!r} already registered"))
+            return
+        node = self._add_node(
+            NodeRec(
+                node_id,
+                msg["addr"],
+                dict(msg.get("resources") or {}),
+                dict(msg.get("resources") or {}),
+                pid=msg.get("pid", 0),
+            )
+        )
+        state["node_id"] = node_id
+        self.stats["nodes_joined"] += 1
+        self._log_event("node_joined", node_id=node_id, resources=node.total)
+        await self._connect_agent(node)
+        if node.state != "alive":
+            # dial-back failed (unreachable advertised address): the join is
+            # a failure, not a silent capacity loss
+            reply_err(ConnectionError(f"head cannot reach agent at {node.addr}"))
+            return
+        self._pub("nodes", {"node_id": node_id, "alive": True, "resources": node.total})
+        reply(node_id=node_id, session=self.session_name, head_tcp=self.tcp_addr)
+        self._service_queue()
+
+    async def _h_node_heartbeat(self, state, msg, reply, reply_err):
+        node = self.nodes.get(msg.get("node_id", state.get("node_id")))
+        if node is not None:
+            node.last_heartbeat = time.monotonic()
+
+    async def _h_worker_exit(self, state, msg, reply, reply_err):
+        """Node agent reports one of its worker processes exited."""
+        rec = self.workers.get(msg["wid"])
+        if rec is not None:
+            await self._on_worker_death(rec)
 
     async def _h_heartbeat(self, state, msg, reply, reply_err):
         rec = self.workers.get(msg.get("client_id", state.get("client_id")))
@@ -587,6 +917,7 @@ class Head:
             client=state.get("client_id", "?"),
             pg_id=msg.get("pg_id"),
             bundle_index=msg.get("bundle_index", -1),
+            strategy=msg.get("strategy"),
         )
         if not self._try_grant(req):
             self.pending_leases.append(req)
@@ -596,6 +927,14 @@ class Head:
         for lid in msg["lease_ids"]:
             self._release_lease(lid)
 
+    def _blocked_shape_node(self, rec: WorkerRec):
+        shape = None
+        if rec.lease_id:
+            shape = self._lease_shapes.get(rec.lease_id)
+        elif rec.actor_id and rec.actor_id in self.actors:
+            shape = self.actors[rec.actor_id].resources
+        return shape, self.nodes.get(rec.node_id)
+
     async def _h_worker_blocked(self, state, msg, reply, reply_err):
         # a leased/actor worker blocked in get(): release its cpus so nested
         # tasks can run (deadlock avoidance, as the reference raylet does when
@@ -604,14 +943,10 @@ class Head:
         rec = self.workers.get(wid)
         if rec is not None and not rec.blocked:
             rec.blocked = True
-            shape = None
-            if rec.lease_id:
-                shape = self._lease_shapes.get(rec.lease_id)
-            elif rec.actor_id and rec.actor_id in self.actors:
-                shape = self.actors[rec.actor_id].resources
+            shape, node = self._blocked_shape_node(rec)
             cpus = (shape or {}).get("CPU", 0.0)
-            if cpus:
-                self._give(self.avail, {"CPU": cpus})
+            if cpus and node is not None and node.state == "alive":
+                self._give(node.avail, {"CPU": cpus})
                 self._service_queue()
 
     async def _h_worker_unblocked(self, state, msg, reply, reply_err):
@@ -619,15 +954,11 @@ class Head:
         rec = self.workers.get(wid)
         if rec is not None and rec.blocked:
             rec.blocked = False
-            shape = None
-            if rec.lease_id:
-                shape = self._lease_shapes.get(rec.lease_id)
-            elif rec.actor_id and rec.actor_id in self.actors:
-                shape = self.actors[rec.actor_id].resources
+            shape, node = self._blocked_shape_node(rec)
             cpus = (shape or {}).get("CPU", 0.0)
-            if cpus:
+            if cpus and node is not None and node.state == "alive":
                 # oversubscribe temporarily rather than deadlock
-                self._take(self.avail, {"CPU": cpus})
+                self._take(node.avail, {"CPU": cpus})
 
     async def _h_create_actor(self, state, msg, reply, reply_err):
         a = ActorRec(
@@ -642,6 +973,7 @@ class Head:
             pg_id=msg.get("pg_id"),
             bundle_index=msg.get("bundle_index", -1),
             runtime_env=msg.get("runtime_env"),
+            strategy=msg.get("strategy"),
         )
         if a.name:
             if a.name in self.named_actors:
@@ -680,12 +1012,23 @@ class Head:
             a.max_restarts = 0
         a.death_cause = "killed via kill()"
         rec = self.workers.get(a.worker_id) if a.worker_id else None
-        if rec is not None and rec.proc is not None and rec.proc.poll() is None:
+        if rec is not None:
+            self._kill_worker_rec(rec)
+        reply()
+
+    def _kill_worker_rec(self, rec: WorkerRec):
+        if rec.proc is not None and rec.proc.poll() is None:
             try:
                 os.kill(rec.pid, signal.SIGKILL)
             except ProcessLookupError:
                 pass
-        reply()
+        elif rec.proc is None:
+            node = self.nodes.get(rec.node_id)
+            if node is not None and node.conn is not None and not node.conn.closed:
+                try:
+                    node.conn.notify("kill_worker", wid=rec.worker_id)
+                except Exception:
+                    pass
 
     async def _h_actor_exited(self, state, msg, reply, reply_err):
         # graceful actor exit (__ray_terminate__ analogue): no restart
@@ -744,17 +1087,60 @@ class Head:
             size=msg.get("size", 0),
             # the submitter owns task returns; the connecting client owns puts
             owner=msg.get("owner") or state.get("client_id", "?"),
+            node_id=msg.get("node") or state.get("node_id", LOCAL_NODE),
         )
         rec.holders |= self._early_refs.pop(oid, set())
         self.objects[oid] = rec
         self.stats["objects_created"] += 1
 
+    async def _h_obj_copy(self, state, msg, reply, reply_err):
+        """A node finished pulling a copy of an object (node-to-node
+        transfer): record the secondary location.  Redundant copies (two
+        workers on one node raced the same pull) are freed immediately rather
+        than silently overwritten — only one copy per node is tracked."""
+        rec = self.objects.get(msg["oid"])
+        if rec is not None:
+            nid = msg.get("node") or state.get("node_id", LOCAL_NODE)
+            if nid == rec.node_id or nid in rec.copies:
+                self._free_shm_name(msg["shm_name"], nid)
+            else:
+                rec.copies[nid] = msg["shm_name"]
+            self.stats["objects_transferred"] += 1
+        reply()
+
+    def _pull_addr_for(self, node_id: str) -> Optional[str]:
+        """Where to pull a node's objects from: the head itself serves n0's
+        namespace; agents serve theirs."""
+        if node_id == LOCAL_NODE:
+            return self.tcp_addr
+        node = self.nodes.get(node_id)
+        return node.addr if node is not None and node.state == "alive" else None
+
     async def _h_obj_locate(self, state, msg, reply, reply_err):
         rec = self.objects.get(msg["oid"])
         if rec is None:
             reply(found=False)
-        else:
-            reply(found=True, shm_name=rec.shm_name, size=rec.size, owner=rec.owner)
+            return
+        # prefer a copy on the caller's node
+        caller_node = state.get("node_id", LOCAL_NODE)
+        if rec.node_id != caller_node and caller_node in rec.copies:
+            reply(
+                found=True, shm_name=rec.copies[caller_node], size=rec.size,
+                owner=rec.owner, node=caller_node, pull_addr=None,
+            )
+            return
+        reply(
+            found=True, shm_name=rec.shm_name, size=rec.size, owner=rec.owner,
+            node=rec.node_id, pull_addr=self._pull_addr_for(rec.node_id),
+        )
+
+    async def _h_pull_chunk(self, state, msg, reply, reply_err):
+        """Serve a chunk of one of n0's objects for node-to-node transfer
+        (object_manager.h chunked push analogue; the head doubles as n0's
+        object server since n0 has no agent)."""
+        reply(data=read_shm_chunk(
+            self.session_name, self._pull_maps, msg["shm_name"], msg["off"], msg["len"]
+        ))
 
     async def _h_obj_refs(self, state, msg, reply, reply_err):
         # as_id: synthetic holder ids ("<cid>#v" value pins keep an arena
@@ -790,27 +1176,65 @@ class Head:
                 total[k] = total.get(k, 0.0) + v
         return total
 
+    def _pg_infeasible(self, bundles: List[BundleRec], strategy: str) -> Optional[str]:
+        """A PG is infeasible only if it can never fit the current cluster's
+        TOTAL capacity (strategy-aware); temporary shortage means pending."""
+        alive = self._alive_nodes()
+        if strategy == "STRICT_PACK":
+            demand = self._pg_demand(bundles)
+            if not any(self._fits(n.total, demand) for n in alive):
+                return f"STRICT_PACK: no node's total capacity fits {demand}"
+            return None
+        if strategy == "STRICT_SPREAD" and len(bundles) > len(alive):
+            return f"STRICT_SPREAD: {len(bundles)} bundles > {len(alive)} nodes"
+        for b in bundles:
+            if not any(self._fits(n.total, b.resources) for n in alive):
+                return f"bundle {b.resources} fits no node's total capacity"
+        demand = self._pg_demand(bundles)
+        if not self._fits(self._agg_total(), demand):
+            return f"need {demand}, cluster total {self._agg_total()}"
+        return None
+
+    def _try_place_pg(self, rec: PGRec) -> bool:
+        """Assign nodes to all unplaced bundles (taking node resources).
+        Returns True when the whole PG is placed."""
+        unplaced = [i for i, b in enumerate(rec.bundles) if b.node_id is None]
+        if not unplaced:
+            rec.state = "created"
+            return True
+        nodes = self._alive_nodes()
+        if rec.strategy == "STRICT_SPREAD":
+            placed_on = {b.node_id for b in rec.bundles if b.node_id is not None}
+            nodes = [n for n in nodes if n.node_id not in placed_on]
+        views = self._node_views(nodes)
+        assignment = scheduling.place_bundles(
+            views,
+            [rec.bundles[i].resources for i in unplaced],
+            rec.strategy,
+            self.config.scheduler_spread_threshold,
+        )
+        if assignment is None:
+            return False
+        for i, nid in zip(unplaced, assignment):
+            rec.bundles[i].node_id = nid
+            self._take(self.nodes[nid].avail, rec.bundles[i].resources)
+        rec.state = "created"
+        return True
+
     async def _h_create_pg(self, state, msg, reply, reply_err):
         """PG semantics mirror GcsPlacementGroupManager: infeasible only if
-        the demand exceeds the cluster's TOTAL capacity; a PG that fits total
-        but not currently-free resources is PENDING and is created FIFO as
-        leases/actors/PGs release resources (pg_wait blocks on it)."""
+        the demand exceeds the cluster's TOTAL capacity (strategy-aware); a PG
+        that fits total but not currently-free resources is PENDING and is
+        created FIFO as leases/actors/PGs release resources (pg_wait blocks
+        on it).  Bundles are placed onto nodes per PACK/SPREAD/STRICT_*."""
         bundles = [BundleRec(resources=b) for b in msg["bundles"]]
-        total = self._pg_demand(bundles)
-        if not self._fits(self.total_resources, total):
-            reply_err(
-                PlacementGroupError(
-                    f"infeasible placement group: need {total}, "
-                    f"cluster total {self.total_resources}"
-                )
-            )
+        strategy = msg.get("strategy", "PACK")
+        why = self._pg_infeasible(bundles, strategy)
+        if why is not None:
+            reply_err(PlacementGroupError(f"infeasible placement group: {why}"))
             return
-        rec = PGRec(
-            pg_id=msg["pg_id"], bundles=bundles, strategy=msg.get("strategy", "PACK")
-        )
-        if self._fits(self.avail, total):
-            self._take(self.avail, total)
-            rec.state = "created"
+        rec = PGRec(pg_id=msg["pg_id"], bundles=bundles, strategy=strategy)
+        if self._try_place_pg(rec):
             self._log_event("pg_created", pg_id=rec.pg_id, bundles=len(bundles))
         else:
             rec.state = "pending"
@@ -828,11 +1252,8 @@ class Head:
             if rec is None or rec.state != "pending":
                 self.pending_pgs.popleft()
                 continue
-            total = self._pg_demand(rec.bundles)
-            if not self._fits(self.avail, total):
+            if not self._try_place_pg(rec):
                 break
-            self._take(self.avail, total)
-            rec.state = "created"
             self.pending_pgs.popleft()
             self._log_event("pg_created", pg_id=pgid, bundles=len(rec.bundles))
             self._wake_pg_waiters(pgid)
@@ -870,9 +1291,12 @@ class Head:
     async def _h_remove_pg(self, state, msg, reply, reply_err):
         pg = self.pgs.pop(msg["pg_id"], None)
         if pg is not None:
-            if pg.state == "created":
-                self._give(self.avail, self._pg_demand(pg.bundles))
-            else:
+            for b in pg.bundles:
+                if b.node_id is not None:
+                    node = self.nodes.get(b.node_id)
+                    if node is not None and node.state == "alive":
+                        self._give(node.avail, b.resources)
+            if pg.state != "created":
                 try:
                     self.pending_pgs.remove(msg["pg_id"])
                 except ValueError:
@@ -892,6 +1316,7 @@ class Head:
                     "strategy": p.strategy,
                     "state": p.state,
                     "bundles": [b.resources for b in p.bundles],
+                    "bundle_nodes": [b.node_id for b in p.bundles],
                 }
                 for p in self.pgs.values()
             ]
@@ -899,30 +1324,39 @@ class Head:
 
     # introspection ---------------------------------------------------------
     async def _h_nodes(self, state, msg, reply, reply_err):
-        reply(
-            nodes=[
+        out = []
+        for n in self.nodes.values():
+            out.append(
                 {
-                    "node_id": self.node_id,
-                    "alive": True,
-                    "resources": self.total_resources,
-                    "available": self.avail,
-                    "n_workers": sum(1 for w in self.workers.values() if w.state != "dead"),
+                    "node_id": n.node_id,
+                    "alive": n.state == "alive",
+                    "resources": n.total,
+                    "available": n.avail,
+                    "is_head_node": n.is_local,
+                    "n_workers": sum(
+                        1
+                        for w in self.workers.values()
+                        if w.node_id == n.node_id and w.state != "dead"
+                    ),
                 }
-            ]
-        )
+            )
+        reply(nodes=out)
 
     async def _h_cluster_resources(self, state, msg, reply, reply_err):
-        reply(total=self.total_resources, available=self.avail)
+        reply(total=self._agg_total(), available=self._agg_avail())
 
     async def _h_stats(self, state, msg, reply, reply_err):
         reply(
             stats=dict(
                 self.stats,
                 pending_leases=len(self.pending_leases),
-                idle_workers=sum(len(d) for d in self.idle_workers.values()),
+                idle_workers=sum(
+                    len(d) for n in self._alive_nodes() for d in n.idle.values()
+                ),
                 n_workers=sum(1 for w in self.workers.values() if w.state != "dead"),
                 n_actors=len(self.actors),
                 n_objects=len(self.objects),
+                n_nodes=len(self._alive_nodes()),
             )
         )
 
@@ -937,6 +1371,7 @@ class Head:
                     "pid": w.pid,
                     "state": w.state,
                     "actor_id": w.actor_id,
+                    "node_id": w.node_id,
                 }
                 for w in self.workers.values()
             ]
@@ -967,6 +1402,7 @@ class Head:
                     "owner": rec.owner,
                     "in_shm": rec.shm_name is not None,
                     "num_holders": len(rec.holders),
+                    "node_id": rec.node_id,
                 }
             )
         reply(objects=out)
@@ -1010,48 +1446,67 @@ class Head:
         pending demand shapes + current utilization."""
         reply(
             pending_demands=[dict(r.shape) for r in self.pending_leases],
-            total=self.total_resources,
-            available=self.avail,
-            idle_workers=sum(len(d) for d in self.idle_workers.values()),
+            total=self._agg_total(),
+            available=self._agg_avail(),
+            idle_workers=sum(
+                len(d) for n in self._alive_nodes() for d in n.idle.values()
+            ),
             n_workers=sum(1 for w in self.workers.values() if w.state != "dead"),
         )
 
     async def _h_update_resources(self, state, msg, reply, reply_err):
-        """Autoscaler grows/shrinks node capacity as provider nodes join/leave."""
+        """Autoscaler grows/shrinks the local node's capacity as provider
+        nodes join/leave (the v1 provider models capacity, not real hosts;
+        real hosts join as agent nodes via register)."""
         delta = msg.get("delta") or {}
+        node = self.local_node
         for k, v in delta.items():
-            self.total_resources[k] = self.total_resources.get(k, 0.0) + v
-            self.avail[k] = self.avail.get(k, 0.0) + v
-        self.max_workers = int(self.total_resources.get("CPU", 4)) * 4 + 4
-        self._log_event("resources_updated", delta=delta, total=self.total_resources)
+            node.total[k] = node.total.get(k, 0.0) + v
+            node.avail[k] = node.avail.get(k, 0.0) + v
+        node.max_workers = int(node.total.get("CPU", 4)) * 4 + 4
+        self._log_event("resources_updated", delta=delta, total=node.total)
         self._service_queue()
-        reply(total=self.total_resources)
+        reply(total=self._agg_total())
 
     async def _h_job_stop(self, state, msg, reply, reply_err):
         reply()
         self._shutdown.set()
 
     # ------------------------------------------------------------ lifecycle
-    def _sweep_client_arenas(self, cid: str):
-        """Unlink a departed client's arena files.  Readers with live maps
-        keep their data; objects owned by a dead process are lost either way
-        (ObjectLostError) until lineage reconstruction recovers them."""
-        import glob
+    def _sweep_client_arenas(self, cid: str, node_id: str):
+        """Unlink a departed client's arena files (on its node).  Readers with
+        live maps keep their data; objects owned by a dead process are lost
+        either way (ObjectLostError) until lineage reconstruction recovers
+        them."""
+        if node_id == LOCAL_NODE:
+            import glob
 
-        for path in glob.glob(
-            os.path.join("/dev/shm", self.session_name, f"arena_{cid}_*")
-        ):
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            for path in glob.glob(
+                os.path.join("/dev/shm", self.session_name, LOCAL_NODE, f"arena_{cid}_*")
+            ):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        else:
+            node = self.nodes.get(node_id)
+            if node is not None and node.conn is not None and not node.conn.closed:
+                try:
+                    node.conn.notify("sweep_arenas", cid=cid)
+                except Exception:
+                    pass
 
     async def _on_disconnect(self, state):
         cid = state.get("client_id")
         if cid is None:
             return
         self._clients.pop(cid, None)
-        self._sweep_client_arenas(cid)
+        if state.get("role") == "agent":
+            node = self.nodes.get(state.get("node_id"))
+            if node is not None:
+                await self._on_node_death(node)
+            return
+        self._sweep_client_arenas(cid, state.get("node_id", LOCAL_NODE))
         # drop this client's pubsub channel and its holder entries (incl. the
         # "<cid>#v" value pins) so departed readers can't pin objects forever
         self.subscribers.pop(f"shm_free:{cid}", None)
@@ -1088,12 +1543,26 @@ class Head:
                     > period * self.config.health_check_failure_threshold
                 ):
                     await self._on_worker_death(rec)
+            for node in list(self.nodes.values()):
+                if node.state != "alive" or node.is_local:
+                    continue
+                if (
+                    now - node.last_heartbeat
+                    > period * self.config.health_check_failure_threshold
+                ):
+                    await self._on_node_death(node)
 
     async def run(self):
         await self.server.start()
+        # advertise the TCP endpoint for agents / cross-host clients
+        for a in self.server.bound_addrs:
+            if a.startswith("tcp:"):
+                self.tcp_addr = a
+        with open(os.path.join(self.session_dir, "head.addr"), "w") as f:
+            f.write(self.tcp_addr or "")
         # prestart one worker per CPU (worker_pool.h prestart behavior)
         if self.config.worker_prestart:
-            for _ in range(int(self.total_resources.get("CPU", 1))):
+            for _ in range(int(self.local_node.total.get("CPU", 1))):
                 self._spawn_worker()
         monitor = asyncio.ensure_future(self._monitor_loop())
         # readiness marker for the driver
@@ -1104,6 +1573,15 @@ class Head:
         await self._teardown()
 
     async def _teardown(self):
+        for node in self.nodes.values():
+            if node.conn is not None and not node.conn.closed:
+                try:
+                    node.conn.notify("node_shutdown")
+                    from .protocol import flush_writer
+
+                    flush_writer(node.conn.writer)
+                except Exception:
+                    pass
         for rec in self.workers.values():
             if rec.proc is not None and rec.proc.poll() is None:
                 try:
@@ -1111,10 +1589,50 @@ class Head:
                 except ProcessLookupError:
                     pass
         await self.server.stop()
-        # GC all shm segments of this session
+        # GC all shm segments of this session (local host; agents clean their
+        # own namespaces on shutdown)
         import shutil
 
         shutil.rmtree(os.path.join("/dev/shm", self.session_name), ignore_errors=True)
+
+
+def read_shm_chunk(session_name: str, map_cache: Dict[str, Any], shm_name: str, off: int, length: int) -> bytes:
+    """Read one chunk of a local shm object for node-to-node transfer.
+    Shared by the head (serving n0) and node agents (serving their node).
+    The name is validated against the session namespace (no path escapes)."""
+    import mmap as _mmap
+
+    if not shm_name.startswith(session_name + "/") or ".." in shm_name:
+        raise ValueError(f"invalid shm name {shm_name!r}")
+    file_name = shm_name.split("@", 1)[0]
+    base = 0
+    if "@" in shm_name:
+        rest = shm_name.split("@", 1)[1]
+        base = int(rest.partition("+")[0])
+    m = map_cache.get(file_name)
+    if m is None:
+        fd = os.open(os.path.join("/dev/shm", file_name), os.O_RDONLY)
+        try:
+            m = _mmap.mmap(fd, os.fstat(fd).st_size, prot=_mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        map_cache[file_name] = m
+    return bytes(memoryview(m)[base + off : base + off + length])
+
+
+def drop_pull_map(map_cache: Dict[str, Any], shm_name: str) -> None:
+    """Invalidate the serving-side map of an unlinked shm file, so transfer
+    caches don't pin pages of deleted objects (arena files are owned by their
+    producer and are never dropped here)."""
+    file_name = shm_name.split("@", 1)[0]
+    if "@" in shm_name:
+        return  # arena slice: the arena file outlives the object
+    m = map_cache.pop(file_name, None)
+    if m is not None:
+        try:
+            m.close()
+        except (BufferError, ValueError):
+            pass
 
 
 def main():

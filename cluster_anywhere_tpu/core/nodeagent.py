@@ -1,0 +1,214 @@
+"""Node agent: the per-node daemon (raylet analogue, src/ray/raylet/
+node_manager.h) for every node other than the head's own.
+
+Responsibilities, mirroring the reference raylet minus local scheduling
+(which stays centralized at the head for this control-plane scale):
+- register the node (its resources) with the head over TCP and heartbeat;
+- spawn/kill/monitor this node's worker processes on head request
+  (worker_pool.h role) and report their deaths;
+- serve chunked reads of this node's shm objects for node-to-node transfer
+  (object_manager.h push analogue);
+- sweep departed clients' arena files and clean the node's shm namespace on
+  shutdown.
+
+The agent deliberately has no role on the task hot path: drivers/workers push
+tasks directly to leased workers, exactly as on the head node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from .config import CAConfig, set_config
+from .head import read_shm_chunk
+from .protocol import Server, connect_addr, spawn_bg
+
+
+class NodeAgent:
+    def __init__(self):
+        self.session_dir = os.environ["CA_SESSION_DIR"]
+        self.session_name = os.path.basename(self.session_dir)
+        self.head_addr = os.environ["CA_HEAD_ADDR"]
+        self.node_id = os.environ["CA_NODE_ID"]
+        import json
+
+        self.resources = json.loads(os.environ.get("CA_NODE_RESOURCES", '{"CPU": 4}'))
+        self.config = CAConfig.from_json(os.environ["CA_CONFIG_JSON"])
+        set_config(self.config)
+        self.serve_addr_spec = os.environ.get("CA_AGENT_SERVE", "tcp:127.0.0.1:0")
+        self.node_dir = os.path.join(self.session_dir, "nodes", self.node_id)
+        os.makedirs(self.node_dir, exist_ok=True)
+        self.shm_ns_dir = os.path.join("/dev/shm", self.session_name, self.node_id)
+        os.makedirs(self.shm_ns_dir, exist_ok=True)
+        self.server = Server([self.serve_addr_spec], self._handle)
+        self.head = None
+        self.procs: Dict[str, subprocess.Popen] = {}  # wid -> proc
+        self._pull_maps: Dict[str, Any] = {}
+        self._shutdown = asyncio.Event()
+
+    # --------------------------------------------------------------- workers
+    def _spawn_worker(self, wid: str, purpose: str, pool: str) -> None:
+        env = dict(os.environ)
+        env["CA_SESSION_DIR"] = self.session_dir
+        env["CA_HEAD_SOCK"] = self.head_addr  # workers dial the head over TCP
+        env["CA_WORKER_ID"] = wid
+        env["CA_WORKER_SOCK"] = "tcp:127.0.0.1:0"  # bind ephemeral, advertise
+        env["CA_NODE_ID"] = self.node_id
+        env["CA_AGENT_ADDR"] = self.serve_addr  # local pulls dedup through us
+        env["CA_CONFIG_JSON"] = self.config.to_json()
+        if pool != "tpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        log_path = os.path.join(self.node_dir, f"{wid}.log")
+        logf = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_anywhere_tpu.core.workerproc"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        logf.close()
+        self.procs[wid] = proc
+
+    def _kill_worker(self, wid: str):
+        proc = self.procs.get(wid)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.kill(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    # --------------------------------------------------------------- handler
+    async def _handle(self, state, msg, reply, reply_err):
+        m = msg["m"]
+        if m == "spawn_worker":
+            self._spawn_worker(msg["wid"], msg.get("purpose", "pool"), msg.get("pool", "cpu"))
+            reply()
+        elif m == "kill_worker":
+            self._kill_worker(msg["wid"])
+            reply()
+        elif m == "pull_chunk":
+            reply(data=read_shm_chunk(
+                self.session_name, self._pull_maps, msg["shm_name"], msg["off"], msg["len"]
+            ))
+        elif m == "sweep_arenas":
+            import glob
+
+            for path in glob.glob(os.path.join(self.shm_ns_dir, f"arena_{msg['cid']}_*")):
+                name = os.path.relpath(path, "/dev/shm")
+                mm = self._pull_maps.pop(name, None)
+                if mm is not None:
+                    try:
+                        mm.close()
+                    except (BufferError, ValueError):
+                        pass
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            reply()
+        elif m == "unlink_shm":
+            name = msg["shm_name"]
+            if name.startswith(f"{self.session_name}/{self.node_id}/") and ".." not in name:
+                from .head import drop_pull_map
+
+                drop_pull_map(self._pull_maps, name)
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                except OSError:
+                    pass
+        elif m == "node_shutdown":
+            self._shutdown.set()
+        elif m == "ping":
+            reply(node_id=self.node_id, n_workers=len(self.procs))
+        else:
+            reply_err(ValueError(f"unknown agent method {m}"))
+
+    # ------------------------------------------------------------ lifecycle
+    async def _heartbeat_loop(self):
+        period = self.config.health_check_period_s / 2
+        while not self._shutdown.is_set():
+            await asyncio.sleep(min(period, 1.0))
+            try:
+                self.head.notify("node_heartbeat", node_id=self.node_id)
+            except Exception:
+                pass
+            # reap exited worker processes and report them (the head cannot
+            # poll processes it didn't spawn)
+            for wid, proc in list(self.procs.items()):
+                if proc.poll() is not None:
+                    del self.procs[wid]
+                    try:
+                        self.head.notify("worker_exit", wid=wid)
+                    except Exception:
+                        pass
+
+    async def _amain(self):
+        await self.server.start()
+        self.serve_addr = self.server.bound_addrs[0]
+        self.head = await connect_addr(self.head_addr)
+
+        async def _on_push(msg):
+            # the head reaches us both through its own connection (requests)
+            # and as pushes on ours; route pushes through the same handler
+            if "m" in msg:
+                await self._handle({}, msg, lambda **kw: None, lambda e: None)
+
+        self.head.set_push_handler(_on_push)
+        await self.head.call(
+            "register",
+            role="agent",
+            client_id=self.node_id,
+            addr=self.serve_addr,
+            resources=self.resources,
+            pid=os.getpid(),
+        )
+        # readiness marker for the cluster fixture
+        with open(os.path.join(self.node_dir, "agent.ready"), "w") as f:
+            f.write(f"{os.getpid()}\n{self.serve_addr}\n")
+        hb = spawn_bg(self._heartbeat_loop())
+        head_watch = spawn_bg(self._watch_head())
+        await self._shutdown.wait()
+        hb.cancel()
+        head_watch.cancel()
+        self._teardown()
+
+    async def _watch_head(self):
+        """If the head connection dies, this node is orphaned: kill workers
+        and exit (the reference raylet exits when GCS is unreachable past the
+        grace period)."""
+        while not self.head.closed:
+            await asyncio.sleep(0.2)
+        self._shutdown.set()
+
+    def _teardown(self):
+        import shutil
+
+        for wid in list(self.procs):
+            self._kill_worker(wid)
+        shutil.rmtree(self.shm_ns_dir, ignore_errors=True)
+
+    def main(self):
+        loop = asyncio.new_event_loop()
+        if hasattr(asyncio, "eager_task_factory"):
+            loop.set_task_factory(asyncio.eager_task_factory)
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(self._amain())
+        except (KeyboardInterrupt, SystemExit):
+            self._teardown()
+
+
+def main():
+    NodeAgent().main()
+
+
+if __name__ == "__main__":
+    main()

@@ -263,9 +263,14 @@ class ShmObjectStore:
     segments.  Segment layout = serialization.pack() format, written in place.
     """
 
-    def __init__(self, session_name: str, owner_tag: Optional[str] = None):
+    def __init__(self, session_name: str, owner_tag: Optional[str] = None, node_id: str = "n0"):
         self.session_name = session_name
-        self.dir = os.path.join(SHM_DIR, session_name)
+        self.node_id = node_id
+        # per-node namespace: objects living in another node's namespace are
+        # NOT mapped directly (even when the "nodes" share a host in the
+        # simulated cluster) — they go through the node-to-node transfer path
+        self.ns = f"{session_name}/{node_id}"
+        self.dir = os.path.join(SHM_DIR, self.ns)
         os.makedirs(self.dir, exist_ok=True)
         self._native = None
         self._native_tried = False
@@ -292,7 +297,12 @@ class ShmObjectStore:
 
     # -- producer -----------------------------------------------------------
     def name_for(self, oid: ObjectID) -> str:
-        return f"{self.session_name}/obj_{oid.hex()}"
+        return f"{self.ns}/obj_{oid.hex()}"
+
+    def is_local(self, shm_name: str) -> bool:
+        """True if this shm name lives in this node's namespace (directly
+        mappable); False means it must be fetched node-to-node."""
+        return shm_name.startswith(self.ns + "/")
 
     def warm(self, capacity: int = _ARENA_DEFAULT):
         """Pre-create (and background-prefault) an arena so first puts pay
@@ -301,7 +311,7 @@ class ShmObjectStore:
             if self._arenas:
                 return
             self._arena_seq += 1
-            name = f"{self.session_name}/arena_{self._owner_tag}_{self._arena_seq}"
+            name = f"{self.ns}/arena_{self._owner_tag}_{self._arena_seq}"
         try:
             arena = _Arena(name, os.path.join(SHM_DIR, name), capacity)
         except OSError:
@@ -346,7 +356,7 @@ class ShmObjectStore:
                 cap *= 2
             with self._lock:
                 self._arena_seq += 1
-                name = f"{self.session_name}/arena_{self._owner_tag}_{self._arena_seq}"
+                name = f"{self.ns}/arena_{self._owner_tag}_{self._arena_seq}"
             try:
                 arena = _Arena(name, os.path.join(SHM_DIR, name), cap)
             except OSError:
@@ -398,6 +408,28 @@ class ShmObjectStore:
         os.close(fd)
         os.rename(tmp, path)  # atomic seal
         return name, size
+
+    def create_for_import(self, oid: ObjectID, size: int) -> Tuple[str, memoryview]:
+        """Allocate local space for a verbatim copy of a remote object
+        (node-to-node transfer: the packed bytes are copied as-is).  Returns
+        (local shm_name, writable view of exactly `size` bytes); the caller
+        writes the pulled chunks into the view and releases it."""
+        if size <= _ARENA_MAX_OBJ:
+            got = self._arena_alloc(size)
+            if got is not None:
+                arena, off = got
+                return f"{arena.name}@{off}+{size}", memoryview(arena.mm)[off : off + size]
+        name = f"{self.ns}/import_{oid.hex()}"
+        path = os.path.join(SHM_DIR, name)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            m = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        with self._lock:
+            self._open_maps[name] = (m, size)
+        return name, memoryview(m)
 
     def free_local(self, shm_name: str):
         """Owner-side reclaim of an arena slice (called when the head GCs the

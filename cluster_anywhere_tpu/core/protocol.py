@@ -1,11 +1,16 @@
 """Wire protocol between processes: length-prefixed msgpack frames over unix
-domain sockets.
+domain sockets (same host) or TCP (cross host).
 
 This is the analogue of the reference's gRPC services + local-socket
 flatbuffer protocol (src/ray/protobuf/*.proto, src/ray/raylet/format/): a
-small set of typed messages between driver <-> head <-> workers.  msgpack maps
-keep the schema explicit and language-neutral so the head can later be swapped
-for the C++ implementation without changing clients.
+small set of typed messages between driver <-> head <-> node agents <->
+workers.  msgpack maps keep the schema explicit and language-neutral so the
+head can later be swapped for the C++ implementation without changing clients.
+
+Addresses are strings with a scheme prefix: "unix:/path/to.sock" or
+"tcp:host:port"; a bare path is treated as unix for backward compatibility.
+A Server can listen on several addresses at once (unix for same-host clients,
+TCP for the rest of the cluster) and shares one handler across them.
 
 Frame format: [u32 big-endian length][msgpack map]
 Every request carries "m" (method), "i" (request id); responses echo "i" and
@@ -20,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import socket as _socket
 import struct
 import weakref
 from typing import Any, Awaitable, Callable, Dict, Optional
@@ -234,23 +240,62 @@ async def connect_unix(path: str) -> Connection:
     return Connection(reader, writer)
 
 
+def parse_addr(addr: str):
+    """Split a scheme-prefixed address into ("unix", path) or ("tcp", host, port)."""
+    if addr.startswith("unix:"):
+        return ("unix", addr[5:])
+    if addr.startswith("tcp:"):
+        host, _, port = addr[4:].rpartition(":")
+        return ("tcp", host, int(port))
+    return ("unix", addr)  # bare path
+
+
+async def connect_addr(addr: str) -> Connection:
+    """Dial a scheme-prefixed address (TCP_NODELAY on tcp: small RPC frames
+    must not sit in Nagle buffers)."""
+    parsed = parse_addr(addr)
+    if parsed[0] == "unix":
+        reader, writer = await asyncio.open_unix_connection(parsed[1])
+    else:
+        reader, writer = await asyncio.open_connection(parsed[1], parsed[2])
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    return Connection(reader, writer)
+
+
 class Server:
-    """Asyncio unix-socket server dispatching frames to a handler.
+    """Asyncio socket server dispatching frames to a handler; listens on one
+    or more addresses (unix and/or tcp) with a shared handler.
 
     handler(conn_state, msg, reply) — `reply(**fields)` sends the response for
     request-style frames; notifications have no "i" and get no reply.
     """
 
-    def __init__(self, path: str, handler, on_disconnect=None):
-        self.path = path
+    def __init__(self, path, handler, on_disconnect=None):
+        # `path` may be a single address or a list; bare paths mean unix
+        self.addrs = [path] if isinstance(path, str) else list(path)
         self.handler = handler
         self.on_disconnect = on_disconnect
-        self._server: Optional[asyncio.AbstractServer] = None
+        self._servers: list = []
+        self.bound_addrs: list = []  # resolved (tcp port 0 -> real port)
 
     async def start(self):
-        self._server = await asyncio.start_unix_server(self._on_client, path=self.path)
+        for addr in self.addrs:
+            parsed = parse_addr(addr)
+            if parsed[0] == "unix":
+                srv = await asyncio.start_unix_server(self._on_client, path=parsed[1])
+                self.bound_addrs.append(f"unix:{parsed[1]}")
+            else:
+                srv = await asyncio.start_server(self._on_client, parsed[1], parsed[2])
+                host, port = srv.sockets[0].getsockname()[:2]
+                self.bound_addrs.append(f"tcp:{host}:{port}")
+            self._servers.append(srv)
 
     async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        sock = writer.get_extra_info("socket")
+        if sock is not None and sock.family in (_socket.AF_INET, _socket.AF_INET6):
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         state: Dict[str, Any] = {"writer": writer}
         try:
             while True:
@@ -294,6 +339,7 @@ class Server:
             reply_err(e)
 
     async def stop(self):
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        for srv in self._servers:
+            srv.close()
+            await srv.wait_closed()
+        self._servers = []

@@ -41,13 +41,25 @@ def _check_options(opts: Dict[str, Any]):
 def _normalize_pg(opts: Dict[str, Any]) -> Dict[str, Any]:
     """Accept PlacementGroup objects or scheduling strategies in options."""
     from .placement import PlacementGroup
-    from .scheduling_strategies import PlacementGroupSchedulingStrategy
+    from .scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+        SpreadSchedulingStrategy,
+    )
 
     out = dict(opts)
     strat = out.pop("scheduling_strategy", None)
     if isinstance(strat, PlacementGroupSchedulingStrategy):
         out["placement_group"] = strat.placement_group
         out["placement_group_bundle_index"] = strat.placement_group_bundle_index
+    elif isinstance(strat, NodeAffinitySchedulingStrategy):
+        out["strategy"] = {
+            "type": "NODE_AFFINITY",
+            "node_id": strat.node_id,
+            "soft": strat.soft,
+        }
+    elif isinstance(strat, SpreadSchedulingStrategy) or strat == "SPREAD":
+        out["strategy"] = {"type": "SPREAD"}
     pg = out.get("placement_group")
     if isinstance(pg, PlacementGroup):
         out["placement_group"] = pg.id.hex()

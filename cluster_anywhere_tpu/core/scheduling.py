@@ -1,0 +1,174 @@
+"""Node scheduling policies (analogue of src/ray/raylet/scheduling/policy/*).
+
+Pure functions over node views so they are unit-testable without a head:
+the head passes a list of (node_id, total, avail) snapshots and gets back a
+node choice (or a full bundle->node assignment for placement groups).
+
+Policies mirrored from the reference:
+- hybrid (hybrid_scheduling_policy.h): pack onto already-used nodes while
+  their critical-resource utilization stays below a threshold (default 0.5),
+  then spread by least utilization.
+- spread (spread_scheduling_policy.h): least-utilized first.
+- node affinity (node_affinity_scheduling_policy.h): a specific node, with a
+  soft fallback to hybrid.
+- bundle placement (bundle_scheduling_policy.h): PACK / SPREAD /
+  STRICT_PACK / STRICT_SPREAD over placement-group bundles.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Shape = Dict[str, float]
+
+
+def fits(avail: Shape, shape: Shape) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in shape.items())
+
+
+def take(avail: Shape, shape: Shape) -> None:
+    for k, v in shape.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def utilization(total: Shape, avail: Shape) -> float:
+    """Critical-resource utilization: max over resources of used/total."""
+    worst = 0.0
+    for k, t in total.items():
+        if t > 0:
+            u = (t - avail.get(k, 0.0)) / t
+            if u > worst:
+                worst = u
+    return worst
+
+
+class NodeView:
+    """Mutable scheduling snapshot of one node (the policy `take`s from it
+    while simulating multi-item placement)."""
+
+    __slots__ = ("node_id", "total", "avail", "index")
+
+    def __init__(self, node_id: str, total: Shape, avail: Shape, index: int = 0):
+        self.node_id = node_id
+        self.total = dict(total)
+        self.avail = dict(avail)
+        self.index = index  # join order; lower = longer-lived (head node first)
+
+
+def rank_hybrid(nodes: Sequence[NodeView], threshold: float) -> List[NodeView]:
+    """Hybrid order: nodes under the utilization threshold first (in join
+    order — pack onto the earliest nodes), then the rest by least utilized."""
+    below = [n for n in nodes if utilization(n.total, n.avail) <= threshold]
+    above = [n for n in nodes if n not in below]
+    below.sort(key=lambda n: n.index)
+    above.sort(key=lambda n: utilization(n.total, n.avail))
+    return below + above
+
+
+def rank_spread(nodes: Sequence[NodeView]) -> List[NodeView]:
+    return sorted(nodes, key=lambda n: (utilization(n.total, n.avail), n.index))
+
+
+def pick_node(
+    nodes: Sequence[NodeView],
+    shape: Shape,
+    strategy: Optional[dict] = None,
+    threshold: float = 0.5,
+) -> Optional[NodeView]:
+    """Choose a node for one resource shape. `strategy` is a wire dict:
+    None/{"type":"DEFAULT"} = hybrid; {"type":"SPREAD"};
+    {"type":"NODE_AFFINITY","node_id":...,"soft":bool}."""
+    kind = (strategy or {}).get("type", "DEFAULT")
+    if kind == "NODE_AFFINITY":
+        want = strategy.get("node_id")
+        for n in nodes:
+            if n.node_id == want:
+                if fits(n.avail, shape):
+                    return n
+                break
+        if not strategy.get("soft", False):
+            return None
+        kind = "DEFAULT"
+    ranked = rank_spread(nodes) if kind == "SPREAD" else rank_hybrid(nodes, threshold)
+    for n in ranked:
+        if fits(n.avail, shape):
+            return n
+    return None
+
+
+def place_bundles(
+    nodes: Sequence[NodeView],
+    bundles: Sequence[Shape],
+    strategy: str,
+    threshold: float = 0.5,
+) -> Optional[List[str]]:
+    """Assign each bundle a node id per the PG strategy, simulating resource
+    consumption as it goes.  Returns the node id per bundle, or None if the
+    assignment is not currently possible (caller decides pending/infeasible).
+    Mutates the passed NodeViews' avail (callers pass snapshots)."""
+    out: List[Optional[str]] = [None] * len(bundles)
+    if strategy == "STRICT_PACK":
+        for n in rank_hybrid(nodes, threshold):
+            sim = dict(n.avail)
+            if all(_sim_take(sim, b) for b in bundles):
+                for i, b in enumerate(bundles):
+                    take(n.avail, b)
+                    out[i] = n.node_id
+                return out  # all on one node
+        return None
+    if strategy == "STRICT_SPREAD":
+        used: set = set()
+        for i, b in enumerate(bundles):
+            chosen = None
+            for n in rank_spread(nodes):
+                if n.node_id in used or not fits(n.avail, b):
+                    continue
+                chosen = n
+                break
+            if chosen is None:
+                return None
+            take(chosen.avail, b)
+            used.add(chosen.node_id)
+            out[i] = chosen.node_id
+        return out
+    if strategy == "SPREAD":
+        # round-robin over least-utilized nodes, wrapping when there are more
+        # bundles than nodes (soft spread)
+        for i, b in enumerate(bundles):
+            chosen = None
+            ranked = rank_spread(nodes)
+            # prefer a node not used yet by this PG
+            used_ids = set(x for x in out if x is not None)
+            for n in ranked:
+                if n.node_id not in used_ids and fits(n.avail, b):
+                    chosen = n
+                    break
+            if chosen is None:
+                for n in ranked:
+                    if fits(n.avail, b):
+                        chosen = n
+                        break
+            if chosen is None:
+                return None
+            take(chosen.avail, b)
+            out[i] = chosen.node_id
+        return out
+    # PACK (default): fill the hybrid-ranked nodes with as few nodes as we can
+    for i, b in enumerate(bundles):
+        chosen = None
+        for n in rank_hybrid(nodes, threshold):
+            if fits(n.avail, b):
+                chosen = n
+                break
+        if chosen is None:
+            return None
+        take(chosen.avail, b)
+        out[i] = chosen.node_id
+    return out
+
+
+def _sim_take(avail: Shape, shape: Shape) -> bool:
+    if not fits(avail, shape):
+        return False
+    take(avail, shape)
+    return True

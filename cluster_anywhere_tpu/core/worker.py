@@ -35,7 +35,7 @@ from .function_manager import FunctionManager
 from .ids import ActorID, JobID, ObjectID, TaskID, _Counter
 from .object_ref import DeviceRef, ObjectRef
 from .object_store import MemoryStore, ShmObjectStore, _Entry
-from .protocol import Connection, connect_unix, spawn_bg
+from .protocol import Connection, connect_addr, spawn_bg
 from .reference_counter import ReferenceCounter
 
 _global_worker: Optional["Worker"] = None
@@ -115,10 +115,18 @@ class LeasePool:
     timeout so other processes (nested tasks, actors) can use the CPUs.
     """
 
-    def __init__(self, worker: "Worker", shape_key: tuple, shape: Dict[str, float], pg: Optional[Tuple[str, int]]):
+    def __init__(
+        self,
+        worker: "Worker",
+        shape_key: tuple,
+        shape: Dict[str, float],
+        pg: Optional[Tuple[str, int]],
+        strategy: Optional[Dict[str, Any]] = None,
+    ):
         self.worker = worker
         self.shape = shape
         self.pg = pg
+        self.strategy = strategy
         self.leases: List[_Lease] = []
         self.waiters: deque = deque()
         self.requests_outstanding = 0
@@ -135,17 +143,26 @@ class LeasePool:
         return best
 
     async def acquire(self) -> _Lease:
+        """Get a lease to push one task onto.
+
+        Preference order balances parallelism against pipelining: (1) an idle
+        lease — the task starts immediately; (2) grow the pool — up to
+        max_leases tasks run truly in parallel across the cluster; (3) only
+        when growth is exhausted, pipeline onto the least-loaded busy lease
+        (the tiny-task throughput path: beyond max_leases concurrent tasks,
+        queueing at workers beats per-task lease RPCs)."""
         while True:
             lease = self._pick()
-            if lease is not None:
+            if lease is not None and lease.inflight == 0:
                 lease.inflight += 1
                 return lease
-            if (
-                len([l for l in self.leases if not l.dead]) + self.requests_outstanding
-                < self.max_leases
-            ):
+            live = sum(1 for l in self.leases if not l.dead)
+            if live + self.requests_outstanding < self.max_leases:
                 self.requests_outstanding += 1
                 spawn_bg(self._request_lease())
+            elif lease is not None:
+                lease.inflight += 1
+                return lease
             fut = asyncio.get_running_loop().create_future()
             self.waiters.append(fut)
             await fut  # raises if the lease request failed terminally
@@ -155,6 +172,8 @@ class LeasePool:
             kw = {}
             if self.pg is not None:
                 kw = {"pg_id": self.pg[0], "bundle_index": self.pg[1]}
+            if self.strategy is not None:
+                kw["strategy"] = self.strategy
             reply = await self.worker.head.call(
                 "request_lease", shape=self.shape, timeout=None, **kw
             )
@@ -226,8 +245,15 @@ class Worker:
         self.client_id = client_id or f"{mode}-{os.getpid()}-{os.urandom(3).hex()}"
         self.serve_addr = serve_addr
         self.job_id = JobID.from_random()
+        # which node this process runs on (n0 = the head's own node; agent
+        # nodes set CA_NODE_ID for their workers).  Limitation: a driver must
+        # run on the head's host — a cross-host driver would wrongly claim n0
+        # (remote drivers belong to the Ray-Client-analogue milestone).
+        self.node_id = os.environ.get("CA_NODE_ID", "n0")
         self.memory_store = MemoryStore()
-        self.shm_store = ShmObjectStore(self.session_name, owner_tag=self.client_id)
+        self.shm_store = ShmObjectStore(
+            self.session_name, owner_tag=self.client_id, node_id=self.node_id
+        )
         if mode == "driver":
             # plasma-style pre-allocation: warm an arena while the driver is
             # still bootstrapping so early puts land in pre-faulted pages
@@ -246,8 +272,9 @@ class Worker:
         self._connecting: Dict[str, asyncio.Future] = {}
         self._lease_pools: Dict[tuple, LeasePool] = {}
         self._actor_addr_cache: Dict[str, Tuple[str, int]] = {}  # aid -> (addr, incarnation)
-        self.node_id: Optional[str] = None
         self.total_resources: Dict[str, float] = {}
+        # in-flight node-to-node object pulls, deduped by oid
+        self._pulls: Dict[bytes, asyncio.Future] = {}
         # device object table: oid-bytes -> live device value (owner side)
         self.device_objects: Dict[bytes, Any] = {}
         self.current_task_id: Optional[TaskID] = None
@@ -326,24 +353,10 @@ class Worker:
 
     # ------------------------------------------------------------- bootstrap
     def connect(self):
-        async def _connect():
-            self.head = await connect_unix(self.head_sock)
-            self.head.set_push_handler(self._on_push)
-            reply = await self.head.call(
-                "register",
-                role=self.mode,
-                client_id=self.client_id,
-                pid=os.getpid(),
-                addr=self.serve_addr or "",
-            )
-            self.node_id = reply["node_id"]
-            self.total_resources = reply["resources"]
-            self._housekeeping_task = spawn_bg(self._housekeeping())
-
-        self.run_coro(_connect(), timeout=30)
+        self.run_coro(self.connect_async(), timeout=30)
 
     async def connect_async(self):
-        self.head = await connect_unix(self.head_sock)
+        self.head = await connect_addr(self.head_sock)
         self.head.set_push_handler(self._on_push)
         reply = await self.head.call(
             "register",
@@ -351,8 +364,8 @@ class Worker:
             client_id=self.client_id,
             pid=os.getpid(),
             addr=self.serve_addr or "",
+            node_id=self.node_id,
         )
-        self.node_id = reply["node_id"]
         self.total_resources = reply["resources"]
         self._housekeeping_task = spawn_bg(self._housekeeping())
 
@@ -412,7 +425,7 @@ class Worker:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._connecting[addr] = fut
         try:
-            conn = await connect_unix(addr)
+            conn = await connect_addr(addr)
             self._conns[addr] = conn
             fut.set_result(conn)
             return conn
@@ -548,6 +561,13 @@ class Worker:
             self.memory_store.put_value(ref.id, value, size=e.size)
             return value
         if e.state == "shm":
+            if not self.shm_store.is_local(e.shm_name):
+                # primary copy lives on another node: pull it into the local
+                # namespace first (chunked node-to-node transfer)
+                local_name, _ = self.run_coro(
+                    self._ensure_local_shm(ref.id.binary(), e.shm_name, e.size)
+                )
+                e.shm_name = local_name
             pin_cb = None
             if "@" in e.shm_name:
                 # arena slice: hold a synthetic "<cid>#v" holder at the head
@@ -575,6 +595,84 @@ class Worker:
     async def _fetch_remote_async(self, addr: str, oid: bytes):
         conn = await self.conn_to(addr)
         return await conn.call("fetch_object", oid=oid, timeout=self.config.push_timeout_s)
+
+    # ----------------------------------------------- node-to-node transfer
+    async def _ensure_local_shm(self, oid_b: bytes, shm_name: Optional[str] = None, size: int = 0):
+        """Make a shm object local to this node, pulling it in chunks from
+        the node holding the primary copy if necessary (the client side of
+        the reference's ObjectManager pull protocol).  Returns (local
+        shm_name, size).  Concurrent pulls of the same object share one
+        transfer."""
+        if shm_name is not None and self.shm_store.is_local(shm_name):
+            return shm_name, size
+        fut = self._pulls.get(oid_b)
+        if fut is not None:
+            return await fut
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls[oid_b] = fut
+        try:
+            result = await self._pull_object(oid_b)
+            fut.set_result(result)
+            return result
+        except BaseException as e:
+            fut.set_exception(e)
+            # consume the exception if nobody else awaited the future
+            if not fut.cancelled():
+                fut.exception()
+            raise
+        finally:
+            del self._pulls[oid_b]
+
+    async def _pull_object(self, oid_b: bytes):
+        reply = await self.head.call("obj_locate", oid=oid_b)
+        if not reply.get("found"):
+            raise ObjectLostError(
+                f"object {oid_b.hex()} not found in the cluster (node lost?)"
+            )
+        name, total = reply["shm_name"], reply["size"]
+        if self.shm_store.is_local(name):
+            return name, total  # a copy already lives on this node
+        pull_addr = reply.get("pull_addr")
+        if not pull_addr:
+            raise ObjectLostError(
+                f"object {oid_b.hex()} is on node {reply.get('node')} with no "
+                f"reachable object server"
+            )
+        oid = ObjectID(oid_b)
+        local_name, mv = self.shm_store.create_for_import(oid, total)
+        try:
+            conn = await self.conn_to(pull_addr)
+            chunk = self.config.transfer_chunk_bytes
+            off = 0
+            while off < total:
+                n = min(chunk, total - off)
+                r = await conn.call(
+                    "pull_chunk", shm_name=name, off=off, len=n,
+                    timeout=self.config.push_timeout_s,
+                )
+                data = r["data"]
+                if not data:
+                    # short read: size metadata disagrees with the served
+                    # file — fail loudly instead of spinning
+                    raise ObjectLostError(
+                        f"short read pulling {oid_b.hex()}: got 0 bytes at "
+                        f"{off}/{total}"
+                    )
+                mv[off : off + len(data)] = data
+                off += len(data)
+        finally:
+            mv.release()
+        try:
+            self.head.notify("obj_copy", oid=oid_b, node=self.node_id, shm_name=local_name)
+        except Exception:
+            pass
+        return local_name, total
+
+    def ensure_local_shm_blocking(self, oid_b: bytes, shm_name: str, size: int = 0) -> str:
+        """Thread-safe blocking wrapper (used by executor threads resolving
+        task args that reference another node's objects)."""
+        name, _ = self.run_coro(self._ensure_local_shm(oid_b, shm_name, size))
+        return name
 
     # ------------------------------------------------------------------ wait
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None):
@@ -713,10 +811,12 @@ class Worker:
         pg = None
         if opts.get("placement_group") is not None:
             pg = (opts["placement_group"], opts.get("placement_group_bundle_index", 0))
-        key = (tuple(sorted(shape.items())), pg)
+        strat = opts.get("strategy")
+        strat_key = tuple(sorted(strat.items())) if strat else None
+        key = (tuple(sorted(shape.items())), pg, strat_key)
         pool = self._lease_pools.get(key)
         if pool is None:
-            pool = LeasePool(self, key, shape, pg)
+            pool = LeasePool(self, key, shape, pg, strat)
             self._lease_pools[key] = pool
         return pool
 
@@ -819,6 +919,7 @@ class Worker:
                 pg_id=opts.get("placement_group"),
                 bundle_index=opts.get("placement_group_bundle_index", -1),
                 runtime_env=wire_env,
+                strategy=opts.get("strategy"),
                 timeout=None,
             )
             return reply

@@ -64,7 +64,14 @@ class WorkerProcess:
         if "v" in spec:
             return serialization.unpack(spec["v"])
         if "shm" in spec:
-            return self.worker.shm_store.get(spec["shm"])
+            name = spec["shm"]
+            if not self.worker.shm_store.is_local(name):
+                # arg lives on another node: pull it over (runs on the
+                # executor thread; the transfer itself rides the IO loop)
+                name = self.worker.ensure_local_shm_blocking(
+                    spec["oid"], name, spec.get("size", 0)
+                )
+            return self.worker.shm_store.get(name)
         if "dev" in spec:
             oid = spec["dev"]
             if spec.get("owner") == self.sock_path and oid in self.worker.device_objects:
@@ -333,6 +340,10 @@ class WorkerProcess:
 
     # ------------------------------------------------------------------ main
     async def _amain(self):
+        # start serving first: with "tcp:host:0" the advertised address is
+        # only known after bind (agent-spawned workers on other nodes)
+        await self.server.start()
+        self.sock_path = self.server.bound_addrs[0]
         self.worker = Worker(
             mode="worker",
             session_dir=self.session_dir,
@@ -343,11 +354,20 @@ class WorkerProcess:
             serve_addr=self.sock_path,
         )
         set_global_worker(self.worker)
-        await self.server.start()
         await self.worker.connect_async()
         spawn_bg(self._heartbeat_loop())
+        spawn_bg(self._watch_head())
         # park forever; the head kills us at job teardown
         await asyncio.Event().wait()
+
+    async def _watch_head(self):
+        """Exit when the head connection dies: a worker without a control
+        plane is an orphan (the head also force-closes our connection when it
+        declares us dead — fencing, so a partitioned worker can't act on a
+        stale lease).  Analogue of the raylet-death exit in the reference."""
+        while not self.worker.head.closed:
+            await asyncio.sleep(0.5)
+        os._exit(1)
 
     def main(self):
         asyncio.set_event_loop(self.loop)

@@ -11,8 +11,12 @@ import cluster_anywhere_tpu as ca
 
 
 def _session_shm_files(info):
+    """All shm file names of the session, across node namespaces."""
     d = os.path.join("/dev/shm", os.path.basename(info["session_dir"]))
-    return os.listdir(d) if os.path.isdir(d) else []
+    out = []
+    for root, _dirs, files in os.walk(d):
+        out.extend(files)
+    return out
 
 
 def _driver_arena_allocated() -> int:
