@@ -95,8 +95,35 @@ def bench_core():
     return best_tasks, best_actor, sync_rate
 
 
+def _check_flash_numerics():
+    """One-shot compiled (NOT interpret-mode) flash-vs-dense numerics check on
+    the real device, so a wrong kernel can never silently ship a fast number."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_anywhere_tpu.ops.attention import flash_attention, reference_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (2, 256, 4, 64), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (2, 256, 4, 64), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (2, 256, 4, 64), jnp.bfloat16)
+    got = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    want = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))(q, k, v)
+    import numpy as np
+
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))))
+    ok = err < 0.05  # bf16 tolerance
+    log(f"flash numerics (compiled): max_abs_err={err:.4f} {'OK' if ok else 'MISMATCH'}")
+    return ok
+
+
 def bench_model():
-    """Train-step throughput of the flagship model on the local accelerator."""
+    """Train-step throughput of the flagship model on the local accelerator.
+
+    Times are synced by reading the loss back to host (block_until_ready does
+    not force completion through the axon tunnel).  Both attention paths are
+    timed (A/B) so a slower kernel can never silently become the dispatch
+    default; the headline is the better of the two."""
     try:
         import jax
 
@@ -109,34 +136,52 @@ def bench_model():
         from cluster_anywhere_tpu.parallel import MeshSpec, make_mesh
 
         on_tpu = devs[0].platform not in ("cpu",)
-        cfg = TransformerConfig(
-            vocab_size=32000,
-            d_model=1024 if on_tpu else 128,
-            n_layers=8 if on_tpu else 2,
-            n_heads=16 if on_tpu else 4,
-            n_kv_heads=8 if on_tpu else 4,
-            d_head=64 if on_tpu else 16,
-            d_ff=4096 if on_tpu else 256,
-            max_seq_len=1024,
-            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-        )
-        mesh = make_mesh(MeshSpec(dp=len(devs)))
-        step, init_state = make_train_step(cfg, mesh)
-        params, opt_state = init_state(jax.random.PRNGKey(0))
-        b, t = (8, 1024) if on_tpu else (4, 128)
-        batch = {
-            "ids": jnp.asarray(np.random.randint(0, cfg.vocab_size, (b, t + 1), dtype=np.int32))
-        }
-        jstep = jax.jit(step, donate_argnums=(0, 1))
-        params, opt_state, loss = jstep(params, opt_state, batch)  # compile
-        jax.block_until_ready(loss)
-        n = 3 if QUICK else 10
-        t0 = time.time()
-        for _ in range(n):
-            params, opt_state, loss = jstep(params, opt_state, batch)
-        jax.block_until_ready(loss)
-        dt = (time.time() - t0) / n
-        tokens = b * t / dt
+        if on_tpu:
+            _check_flash_numerics()
+
+        def run(attn_impl: str):
+            cfg = TransformerConfig(
+                vocab_size=32000,
+                d_model=1024 if on_tpu else 128,
+                n_layers=8 if on_tpu else 2,
+                n_heads=16 if on_tpu else 4,
+                n_kv_heads=8 if on_tpu else 4,
+                d_head=64 if on_tpu else 16,
+                d_ff=4096 if on_tpu else 256,
+                max_seq_len=1024,
+                dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                attn_impl=attn_impl,
+            )
+            mesh = make_mesh(MeshSpec(dp=len(devs)))
+            step, init_state = make_train_step(cfg, mesh)
+            params, opt_state = init_state(jax.random.PRNGKey(0))
+            b, t = (8, 1024) if on_tpu else (4, 128)
+            batch = {
+                "ids": jnp.asarray(
+                    np.random.randint(0, cfg.vocab_size, (b, t + 1), dtype=np.int32)
+                )
+            }
+            jstep = jax.jit(step, donate_argnums=(0, 1))
+            params, opt_state, loss = jstep(params, opt_state, batch)  # compile
+            _ = float(loss)  # host readback = real completion barrier
+            n = 3 if QUICK else 10
+            t0 = time.time()
+            for _ in range(n):
+                params, opt_state, loss = jstep(params, opt_state, batch)
+            _ = float(loss)
+            dt = (time.time() - t0) / n
+            log(
+                f"model_step[{attn_impl}]: {dt*1000:.1f} ms, "
+                f"tokens_per_s: {b*t/dt:,.0f} ({devs[0].platform})"
+            )
+            return dt, b * t / dt
+
+        dt_jnp, tok_jnp = run("jnp")
+        if on_tpu:
+            dt_flash, tok_flash = run("flash")
+        else:
+            dt_flash, tok_flash = dt_jnp, tok_jnp
+        dt, tokens = min((dt_jnp, tok_jnp), (dt_flash, tok_flash), key=lambda x: x[0])
         log(f"model_step_s: {dt*1000:.1f} ms, tokens_per_s: {tokens:,.0f} ({devs[0].platform})")
     except Exception as e:
         log(f"model bench skipped: {type(e).__name__}: {e}")

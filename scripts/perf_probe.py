@@ -1,0 +1,108 @@
+"""TPU perf probe for the flagship model: A/B attention impls, remat, batch.
+
+Run on the real chip (no JAX_PLATFORMS override):
+    python scripts/perf_probe.py [variant ...]
+Variants: jnp8 flash8 jnp16 flash16 jnp16r jnp32r attnmicro
+Default: all step variants.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from cluster_anywhere_tpu.models import TransformerConfig, make_train_step
+from cluster_anywhere_tpu.parallel import MeshSpec, make_mesh
+
+
+def base_cfg(**kw):
+    return TransformerConfig(
+        vocab_size=32000,
+        d_model=1024,
+        n_layers=8,
+        n_heads=16,
+        n_kv_heads=8,
+        d_head=64,
+        d_ff=4096,
+        max_seq_len=1024,
+        dtype=jnp.bfloat16,
+        **kw,
+    )
+
+
+def run_step(name, cfg, b, t, n=10):
+    mesh = make_mesh(MeshSpec(dp=1))
+    step, init_state = make_train_step(cfg, mesh)
+    params, opt = init_state(jax.random.PRNGKey(0))
+    batch = {"ids": jnp.asarray(np.random.randint(0, 32000, (b, t + 1), dtype=np.int32))}
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    t0 = time.time()
+    params, opt, loss = jstep(params, opt, batch)
+    jax.block_until_ready(loss)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    for _ in range(n):
+        params, opt, loss = jstep(params, opt, batch)
+    jax.block_until_ready(loss)
+    dt = (time.time() - t0) / n
+    print(
+        f"{name:10s}: {dt*1000:7.1f} ms/step  {b*t/dt:10,.0f} tok/s  "
+        f"(compile {compile_s:.0f}s, loss {float(loss):.3f})",
+        flush=True,
+    )
+    return dt
+
+
+def attn_micro():
+    from cluster_anywhere_tpu.ops.attention import flash_attention, reference_attention
+
+    b, t, h, d = 8, 1024, 16, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, t, h, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, t, h, d), jnp.bfloat16)
+
+    def bench(name, fn):
+        f = jax.jit(jax.grad(lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+        out = f(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(20):
+            out = f(q, k, v)
+        jax.block_until_ready(out)
+        print(f"attn {name:24s}: {(time.time()-t0)/20*1000:7.2f} ms fwd+bwd", flush=True)
+
+    bench("jnp", lambda q, k, v: reference_attention(q, k, v, causal=True))
+    for bq, bk in ((128, 128), (256, 256), (512, 512), (256, 512), (512, 1024), (1024, 1024)):
+        bench(
+            f"flash bq{bq} bk{bk}",
+            lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk
+            ),
+        )
+
+
+VARIANTS = {
+    "jnp8": lambda: run_step("jnp b8", base_cfg(attn_impl="jnp"), 8, 1024),
+    "flash8": lambda: run_step("flash b8", base_cfg(attn_impl="flash"), 8, 1024),
+    "jnp16": lambda: run_step("jnp b16", base_cfg(attn_impl="jnp"), 16, 1024),
+    "flash16": lambda: run_step("flash b16", base_cfg(attn_impl="flash"), 16, 1024),
+    "jnp16r": lambda: run_step("jnp b16 rm", base_cfg(attn_impl="jnp", remat=True), 16, 1024),
+    "jnp32r": lambda: run_step("jnp b32 rm", base_cfg(attn_impl="jnp", remat=True), 32, 1024),
+    "attnmicro": attn_micro,
+}
+
+
+def main():
+    names = [a for a in sys.argv[1:] if a in VARIANTS] or ["jnp8", "flash8", "jnp16", "flash16"]
+    print(f"devices: {jax.devices()}", flush=True)
+    for n in names:
+        VARIANTS[n]()
+
+
+if __name__ == "__main__":
+    main()
