@@ -82,7 +82,8 @@ def bench_core():
     del warm
     time.sleep(1.0)  # slice reclaim drains; pages stay faulted
     best_put = 0.0
-    for _ in range(2):
+    # best-of-3: the shared host's memcpy bandwidth swings >2x run to run
+    for _ in range(3):
         t0 = time.time()
         refs = [ca.put(arr) for _ in range(reps)]
         dt = time.time() - t0
@@ -109,8 +110,6 @@ def _check_flash_numerics():
     v = jax.random.normal(ks[2], (2, 256, 4, 64), jnp.bfloat16)
     got = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
     want = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))(q, k, v)
-    import numpy as np
-
     err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))))
     ok = err < 0.05  # bf16 tolerance
     log(f"flash numerics (compiled): max_abs_err={err:.4f} {'OK' if ok else 'MISMATCH'}")
@@ -136,8 +135,7 @@ def bench_model():
         from cluster_anywhere_tpu.parallel import MeshSpec, make_mesh
 
         on_tpu = devs[0].platform not in ("cpu",)
-        if on_tpu:
-            _check_flash_numerics()
+        flash_ok = _check_flash_numerics() if on_tpu else False
 
         def run(attn_impl: str):
             cfg = TransformerConfig(
@@ -177,7 +175,7 @@ def bench_model():
             return dt, b * t / dt
 
         dt_jnp, tok_jnp = run("jnp")
-        if on_tpu:
+        if flash_ok:  # a numerically wrong kernel must not set the headline
             dt_flash, tok_flash = run("flash")
         else:
             dt_flash, tok_flash = dt_jnp, tok_jnp

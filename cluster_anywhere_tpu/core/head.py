@@ -469,8 +469,11 @@ class Head:
             if node is None or node.state != "alive":
                 return False
             return self._grant_on_node(node, req)
-        # policy-ranked candidates; grant on the first that has an idle worker
-        views = self._node_views()
+        # policy-ranked candidates; grant on the first that has an idle
+        # worker.  Ranking reads NodeRecs in place (no snapshot copies): this
+        # runs per queued request per scheduling pass, and the single-node
+        # case must stay allocation-free for task-throughput.
+        alive = self._alive_nodes()
         threshold = self.config.scheduler_spread_threshold
         kind = (req.strategy or {}).get("type", "DEFAULT")
         if kind == "NODE_AFFINITY":
@@ -487,15 +490,16 @@ class Head:
                     )
                     return True
                 return False
-            ranked = scheduling.rank_hybrid(views, threshold)
-        elif kind == "SPREAD":
-            ranked = scheduling.rank_spread(views)
-        else:
-            ranked = scheduling.rank_hybrid(views, threshold)
-        for view in ranked:
-            if not scheduling.fits(view.avail, req.shape):
+            kind = "DEFAULT"
+        if len(alive) > 1:
+            # rank over the live NodeRecs in place (no snapshot copies)
+            if kind == "SPREAD":
+                alive = scheduling.rank_spread(alive)
+            else:
+                alive = scheduling.rank_hybrid(alive, threshold)
+        for node in alive:
+            if not scheduling.fits(node.avail, req.shape):
                 continue
-            node = self.nodes[view.node_id]
             if self._grant_on_node(node, req):
                 return True
         return False

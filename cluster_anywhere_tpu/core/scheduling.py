@@ -55,17 +55,20 @@ class NodeView:
         self.index = index  # join order; lower = longer-lived (head node first)
 
 
-def rank_hybrid(nodes: Sequence[NodeView], threshold: float) -> List[NodeView]:
+def rank_hybrid(nodes: Sequence, threshold: float) -> List:
     """Hybrid order: nodes under the utilization threshold first (in join
-    order — pack onto the earliest nodes), then the rest by least utilized."""
-    below = [n for n in nodes if utilization(n.total, n.avail) <= threshold]
-    above = [n for n in nodes if n not in below]
+    order — pack onto the earliest nodes), then the rest by least utilized.
+    Accepts any node-like object with .total/.avail/.index (NodeView
+    snapshots or the head's live NodeRecs)."""
+    below, above = [], []
+    for n in nodes:
+        (below if utilization(n.total, n.avail) <= threshold else above).append(n)
     below.sort(key=lambda n: n.index)
     above.sort(key=lambda n: utilization(n.total, n.avail))
     return below + above
 
 
-def rank_spread(nodes: Sequence[NodeView]) -> List[NodeView]:
+def rank_spread(nodes: Sequence) -> List:
     return sorted(nodes, key=lambda n: (utilization(n.total, n.avail), n.index))
 
 

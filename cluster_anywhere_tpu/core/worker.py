@@ -127,6 +127,7 @@ class LeasePool:
         self.shape = shape
         self.pg = pg
         self.strategy = strategy
+        self.inflight_total = 0  # tasks currently pushed across all leases
         self.leases: List[_Lease] = []
         self.waiters: deque = deque()
         self.requests_outstanding = 0
@@ -146,26 +147,58 @@ class LeasePool:
         """Get a lease to push one task onto.
 
         Preference order balances parallelism against pipelining: (1) an idle
-        lease — the task starts immediately; (2) grow the pool — up to
-        max_leases tasks run truly in parallel across the cluster; (3) only
-        when growth is exhausted, pipeline onto the least-loaded busy lease
-        (the tiny-task throughput path: beyond max_leases concurrent tasks,
-        queueing at workers beats per-task lease RPCs)."""
-        while True:
-            lease = self._pick()
-            if lease is not None and lease.inflight == 0:
-                lease.inflight += 1
-                return lease
-            live = sum(1 for l in self.leases if not l.dead)
-            if live + self.requests_outstanding < self.max_leases:
-                self.requests_outstanding += 1
-                spawn_bg(self._request_lease())
-            elif lease is not None:
-                lease.inflight += 1
-                return lease
-            fut = asyncio.get_running_loop().create_future()
-            self.waiters.append(fut)
-            await fut  # raises if the lease request failed terminally
+        lease — the task starts immediately; (2) grow the pool, but only up to
+        the observed demand (inflight + waiting + this task) so a burst of N
+        long tasks gets N parallel leases without flooding the head with
+        max_leases speculative requests; (3) once growth is exhausted,
+        pipeline onto the least-loaded busy lease (the tiny-task throughput
+        path: beyond max_leases concurrent tasks, queueing at workers beats
+        per-task lease RPCs)."""
+        self.inflight_total += 1
+        try:
+            while True:
+                lease = self._pick()
+                if lease is not None and lease.inflight == 0:
+                    lease.inflight += 1
+                    return lease
+                if self._should_grow():
+                    self.requests_outstanding += 1
+                    spawn_bg(self._request_lease())
+                elif lease is not None and self._pipeline_ok():
+                    lease.inflight += 1
+                    return lease
+                fut = asyncio.get_running_loop().create_future()
+                self.waiters.append(fut)
+                await fut  # raises if the lease request failed terminally
+        except BaseException:
+            self.inflight_total -= 1
+            raise
+
+    _MAX_OUTSTANDING = 8  # lease requests in flight at the head per pool
+
+    def _should_grow(self) -> bool:
+        """Grow towards observed demand, with a cap on in-flight lease
+        requests so an ungrantable burst doesn't pile a max_leases-deep queue
+        at the head (the head re-scans pending requests every release)."""
+        if self.requests_outstanding >= self._MAX_OUTSTANDING:
+            return False
+        live = sum(1 for l in self.leases if not l.dead)
+        return live + self.requests_outstanding < min(self.max_leases, self.inflight_total)
+
+    def _pipeline_ok(self) -> bool:
+        """Pushing onto a BUSY lease is right only when the leases we already
+        have plus those on the way cannot cover demand (the tiny-task flood
+        case).  While expected leases >= demand, waiting for one is right —
+        pipelining there would serialize long tasks on one worker while the
+        rest of the cluster idles."""
+        live = sum(1 for l in self.leases if not l.dead)
+        expected = live + self.requests_outstanding
+        if expected >= self.inflight_total:
+            return False
+        return (
+            expected >= self.max_leases
+            or self.requests_outstanding >= self._MAX_OUTSTANDING
+        )
 
     async def _request_lease(self):
         try:
@@ -201,6 +234,7 @@ class LeasePool:
                 fut.set_exception(exc)
 
     def release(self, lease: _Lease, dead: bool = False):
+        self.inflight_total -= 1
         lease.inflight -= 1
         if dead:
             lease.dead = True
@@ -333,8 +367,12 @@ class Worker:
             self._submit_queue.clear()
             self._submit_wakeup_pending = False
         for factory in items:
-            task = spawn_bg(factory())
-            task.add_done_callback(self._report_task_exc)
+            # a factory may complete synchronously (fast-path submission via
+            # call_cb) and return None; only coroutines become tasks
+            coro = factory()
+            if coro is not None:
+                task = spawn_bg(coro)
+                task.add_done_callback(self._report_task_exc)
 
     @staticmethod
     def _report_task_exc(task):
@@ -795,9 +833,74 @@ class Worker:
         refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
         fn_id, blob = self.fn_manager.export(fn)
         self._pump_submit(
-            lambda: self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+            lambda: self._task_entry(task_id, fn_id, blob, args, kwargs, opts, oids)
         )
         return refs
+
+    def _task_entry(self, task_id, fn_id, blob, args, kwargs, opts, oids):
+        """Runs on the IO thread.  Fast path: an argless task of an
+        already-exported function pushed onto an available lease entirely via
+        callbacks — no per-task coroutine/Task.  Anything needing awaiting
+        (arg resolution, function export, lease growth/waiting) returns the
+        slow coroutine instead."""
+        if blob is not None or args or kwargs or opts.get("runtime_env"):
+            return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+        pool = self._lease_pool(opts)
+        lease = pool._pick()
+        if lease is None:
+            return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+        # count this task as demand BEFORE deciding (both predicates read
+        # inflight_total); a busy lease is only used when pipelining is the
+        # right regime, else the slow path grows/waits
+        pool.inflight_total += 1
+        if lease.inflight > 0 and not pool._pipeline_ok():
+            pool.inflight_total -= 1
+            return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+        conn = self._conns.get(lease.addr)
+        if conn is None or conn.closed:
+            pool.inflight_total -= 1
+            return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+        lease.inflight += 1
+        addr = lease.addr
+
+        def on_reply(msg):
+            pool.release(lease, dead=msg is None)
+            if msg is None:
+                # worker died with the push in flight: retry on a fresh lease
+                # only within the task's retry budget (at-most-once otherwise)
+                retries = opts.get("max_retries", self.config.default_max_retries)
+                if retries > 0:
+                    retry_opts = dict(opts, max_retries=retries - 1)
+                    t = spawn_bg(
+                        self._submit_task(task_id, fn_id, None, args, kwargs, retry_opts, oids)
+                    )
+                    t.add_done_callback(self._report_task_exc)
+                else:
+                    self._store_error(
+                        oids, WorkerCrashedError("worker died executing task")
+                    )
+            elif not msg.get("ok", True):
+                import pickle
+
+                self._store_error(oids, pickle.loads(msg["err"]))
+            else:
+                self._store_results(oids, msg["results"], addr)
+
+        try:
+            conn.call_cb(
+                "push_task",
+                on_reply,
+                task_id=task_id.binary(),
+                fn_id=fn_id,
+                owner=self.client_id,
+                args=[],
+                kwargs={},
+                num_returns=opts.get("num_returns", 1),
+            )
+        except ConnectionError:
+            pool.release(lease, dead=True)
+            return self._submit_task(task_id, fn_id, None, args, kwargs, opts, oids)
+        return None
 
     def _shape_of(self, opts) -> Dict[str, float]:
         shape = dict(opts.get("resources") or {})
@@ -827,8 +930,7 @@ class Worker:
                 self.fn_manager.mark_exported(fn_id)
             specs, kwspecs = await self._build_args(args, kwargs)
         except BaseException as e:
-            for oid in oids:
-                self.memory_store.put_error(oid, e if isinstance(e, CAError) else TaskError(repr(e)))
+            self._store_error(oids, e)
             return
         retries = opts.get("max_retries", self.config.default_max_retries)
         pool = self._lease_pool(opts)
@@ -836,10 +938,7 @@ class Worker:
             try:
                 lease = await pool.acquire()
             except BaseException as e:
-                for oid in oids:
-                    self.memory_store.put_error(
-                        oid, e if isinstance(e, CAError) else TaskError(repr(e))
-                    )
+                self._store_error(oids, e)
                 return
             dead = False
             try:
@@ -863,15 +962,19 @@ class Worker:
                 if retries > 0:
                     retries -= 1
                     continue
-                for oid in oids:
-                    self.memory_store.put_error(
-                        oid, WorkerCrashedError(f"worker died executing task: {e}")
-                    )
+                self._store_error(
+                    oids, WorkerCrashedError(f"worker died executing task: {e}")
+                )
                 return
             finally:
                 pool.release(lease, dead=dead)
             self._store_results(oids, reply["results"], lease.addr)
             return
+
+    def _store_error(self, oids: List[ObjectID], e: BaseException):
+        err = e if isinstance(e, CAError) else TaskError(repr(e))
+        for oid in oids:
+            self.memory_store.put_error(oid, err)
 
     def _store_results(self, oids: List[ObjectID], results: List[dict], exec_addr: str):
         for oid, res in zip(oids, results):
@@ -955,17 +1058,61 @@ class Worker:
             self.reference_counter.add_owned(oid)
         refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
         self._pump_submit(
-            lambda: self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
+            lambda: self._actor_call_entry(actor_id, method, args, kwargs, opts, task_id, oids)
         )
         return refs
+
+    def _actor_call_entry(self, actor_id, method, args, kwargs, opts, task_id, oids):
+        """IO-thread fast path for argless actor calls on a known-alive
+        incarnation: pure callback RPC, no coroutine.  Falls back to the
+        retrying slow path for args, unknown addresses, or failures."""
+        if args or kwargs:
+            return self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
+        aid = actor_id.hex()
+        cached = self._actor_addr_cache.get(aid)
+        conn = self._conns.get(cached[0]) if cached is not None else None
+        if conn is None or conn.closed:
+            return self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
+        addr = cached[0]
+
+        def on_reply(msg):
+            if msg is None:
+                # connection died mid-call: slow path refreshes the actor
+                # address (restart transparency) and retries
+                t = spawn_bg(
+                    self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
+                )
+                t.add_done_callback(self._report_task_exc)
+            elif not msg.get("ok", True):
+                import pickle
+
+                e = pickle.loads(msg["err"])
+                self._store_error(oids, e)
+            else:
+                self._store_results(oids, msg["results"], addr)
+
+        try:
+            conn.call_cb(
+                "actor_call",
+                on_reply,
+                actor_id=aid,
+                method=method,
+                task_id=task_id.binary(),
+                owner=self.client_id,
+                args=[],
+                kwargs={},
+                num_returns=opts.get("num_returns", 1),
+            )
+        except ConnectionError:
+            return self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
+        return None
 
     async def _submit_actor_task(self, actor_id, method, args, kwargs, opts, task_id, oids):
         aid = actor_id.hex()
         try:
             specs, kwspecs = await self._build_args(args, kwargs)
         except BaseException as e:
-            for oid in oids:
-                self.memory_store.put_error(oid, e if isinstance(e, CAError) else TaskError(repr(e)))
+            self._store_error(oids, e)
             return
         attempts = 1 + max(0, opts.get("max_task_retries", 0))
         last_err: Optional[BaseException] = None
@@ -996,8 +1143,7 @@ class Worker:
             except ActorDiedError as e:
                 last_err = e
                 break
-        for oid in oids:
-            self.memory_store.put_error(oid, last_err or ActorDiedError("actor call failed"))
+        self._store_error(oids, last_err or ActorDiedError("actor call failed"))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.run_coro(

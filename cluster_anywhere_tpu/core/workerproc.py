@@ -25,7 +25,7 @@ from .config import CAConfig, set_config
 from .errors import TaskCancelledError, TaskError
 from .ids import ActorID, ObjectID, TaskID
 from .object_ref import ObjectRef
-from .protocol import Server, spawn_bg
+from .protocol import Server, spawn_bg, write_frame
 from .worker import Worker, _device_spec, _is_device_value, set_global_worker
 
 
@@ -49,7 +49,7 @@ class WorkerProcess:
         if hasattr(asyncio, "eager_task_factory"):
             self.loop.set_task_factory(asyncio.eager_task_factory)
         self.worker: Optional[Worker] = None
-        self.server = Server(self.sock_path, self._handle)
+        self.server = Server(self.sock_path, self._handle, fast_handler=self._fast_handle)
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ca-exec"
         )
@@ -251,6 +251,78 @@ class WorkerProcess:
             return self._error_results(num_returns, e)
 
     # --------------------------------------------------------------- handlers
+    def _fast_handle(self, state, msg, writer) -> bool:
+        """Synchronous hot path run directly in the server read loop: execute
+        sync tasks/actor calls by handing the executor a job whose done-
+        callback writes the reply — no per-frame asyncio Task, no coroutine.
+        Returns False to fall back to the general async handler (async
+        methods, uncached functions, control RPCs)."""
+        m = msg.get("m")
+        if m == "actor_call":
+            ctx = self.actor
+            if ctx is None or ctx.actor_id != msg.get("actor_id"):
+                return False
+            name = msg.get("method")
+            if name == "__ca_exec__":
+                return False
+            fn = getattr(ctx.instance, name, None)
+            if fn is None or asyncio.iscoroutinefunction(fn):
+                return False
+            self._submit_fast(fn, msg, writer, msg["actor_id"], "actor_task", name)
+            return True
+        if m == "push_task":
+            fn = self.worker.fn_manager.get(msg["fn_id"])
+            if fn is None:
+                return False  # definition needs a head fetch: slow path
+            self._submit_fast(
+                fn, msg, writer, None, "task", getattr(fn, "__name__", "task")
+            )
+            return True
+        return False
+
+    def _submit_fast(self, fn, msg, writer, actor_id, kind, ev_name):
+        import time as _time
+
+        rid = msg.get("i")
+        task_id = msg.get("task_id") or os.urandom(16)
+        num_returns = msg.get("num_returns", 1)
+        t0 = _time.time()
+
+        def job():
+            ok = True
+            exited_actor = None
+            try:
+                results = self._exec_sync(fn, msg, task_id, actor_id)
+            except SystemExit:
+                self._exiting = True
+                results = self._error_results(
+                    num_returns, TaskError("actor exited via exit_actor()")
+                )
+                if self.actor is not None:
+                    exited_actor = self.actor.actor_id
+            except BaseException as e:
+                ok = False
+                results = self._error_results(num_returns, e)
+
+            def finish():
+                # notify/write only from the loop thread (the cork needs a
+                # running loop); actor_exited must precede the process death
+                # so the head records a graceful exit, not a crash-to-restart
+                if exited_actor is not None:
+                    try:
+                        self.worker.head.notify("actor_exited", actor_id=exited_actor)
+                    except Exception:
+                        pass
+                if rid is not None:
+                    write_frame(writer, {"i": rid, "ok": True, "results": results})
+                self._record_event(task_id, ev_name, kind, t0, ok)
+                if self._exiting:
+                    spawn_bg(self._graceful_exit())
+
+            self.loop.call_soon_threadsafe(finish)
+
+        self.executor.submit(job)
+
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
         if m == "push_task":
