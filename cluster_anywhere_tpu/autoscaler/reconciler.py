@@ -41,9 +41,12 @@ def _take(avail: Dict[str, float], shape: Dict[str, float]):
 
 
 class Reconciler:
-    def __init__(self, provider: NodeProvider, config: AutoscalerConfig):
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig, state_fn=None):
         self.provider = provider
         self.config = config
+        # state_fn() -> the head's autoscaler_state dict; injectable so tests
+        # drive step() through synthetic cluster states without a live head
+        self._state_fn = state_fn or (lambda: global_worker().head_call("autoscaler_state"))
         self._idle_since: Optional[float] = None
         self.requested_min: Dict[str, float] = {}
 
@@ -54,8 +57,7 @@ class Reconciler:
 
     def step(self) -> Dict[str, int]:
         """One reconcile pass. Returns {'launched': n, 'terminated': m}."""
-        w = global_worker()
-        state = w.head_call("autoscaler_state")
+        state = self._state_fn()
         launched = self._scale_up(state)
         terminated = self._scale_down(state) if not launched else 0
         return {"launched": launched, "terminated": terminated}
@@ -63,12 +65,6 @@ class Reconciler:
     # ------------------------------------------------------------- scale up
     def _scale_up(self, state) -> int:
         demands = [dict(d) for d in state["pending_demands"]]
-        if self.requested_min:
-            free = dict(state["available"])
-            if not _fits(free, self.requested_min):
-                demands.append(dict(self.requested_min))
-        if not demands:
-            return 0
         # demand that the current free capacity cannot serve
         free = dict(state["available"])
         unmet = []
@@ -77,8 +73,6 @@ class Reconciler:
                 _take(free, d)
             else:
                 unmet.append(d)
-        if not unmet:
-            return 0
         # bin-pack unmet demand onto new nodes, smallest node type first
         current = self.provider.non_terminated_nodes()
         count_by_type = {}
@@ -86,6 +80,16 @@ class Reconciler:
             count_by_type[n.node_type] = count_by_type.get(n.node_type, 0) + 1
         to_launch: List[NodeType] = []
         packing: List[Dict[str, float]] = []
+
+        def can_launch(nt: NodeType) -> bool:
+            used = count_by_type.get(nt.name, 0) + sum(
+                1 for t in to_launch if t.name == nt.name
+            )
+            return (
+                used < nt.max_nodes
+                and len(current) + len(to_launch) < self.config.max_total_nodes
+            )
+
         for d in unmet:
             placed = False
             for cap in packing:  # try already-planned nodes
@@ -96,13 +100,8 @@ class Reconciler:
             if placed:
                 continue
             for nt in sorted(self.config.node_types, key=lambda t: sum(t.resources.values())):
-                used = count_by_type.get(nt.name, 0) + sum(
-                    1 for t in to_launch if t.name == nt.name
-                )
-                if used >= nt.max_nodes:
+                if not can_launch(nt):
                     continue
-                if len(current) + len(to_launch) >= self.config.max_total_nodes:
-                    break
                 if _fits(dict(nt.resources), d):
                     cap = dict(nt.resources)
                     _take(cap, d)
@@ -111,9 +110,39 @@ class Reconciler:
                     placed = True
                     break
             # unplaceable demand (too big for any node type): skip
+        if self.requested_min:
+            # the requested minimum is an AGGREGATE capacity floor, not a
+            # single-node shape: launch nodes until free + planned covers it
+            floor_free = dict(state["available"])
+            for nt in to_launch:
+                self._give(floor_free, nt.resources)
+            guard = 0
+            while not _fits(floor_free, self.requested_min) and guard < 64:
+                guard += 1
+                deficit = {
+                    k: v - floor_free.get(k, 0.0)
+                    for k, v in self.requested_min.items()
+                    if v - floor_free.get(k, 0.0) > 1e-9
+                }
+                chosen = None
+                for nt in sorted(
+                    self.config.node_types, key=lambda t: sum(t.resources.values())
+                ):
+                    if can_launch(nt) and any(nt.resources.get(k, 0.0) > 0 for k in deficit):
+                        chosen = nt
+                        break
+                if chosen is None:
+                    break  # caps reached or no type contributes
+                to_launch.append(chosen)
+                self._give(floor_free, chosen.resources)
         for nt in to_launch:
             self.provider.create_node(nt)
         return len(to_launch)
+
+    @staticmethod
+    def _give(avail: Dict[str, float], shape: Dict[str, float]):
+        for k, v in shape.items():
+            avail[k] = avail.get(k, 0.0) + v
 
     # ----------------------------------------------------------- scale down
     def _scale_down(self, state) -> int:

@@ -116,6 +116,10 @@ class ObjectRec:
     copies: Dict[str, str] = field(default_factory=dict)  # node_id -> shm_name
     holders: set = field(default_factory=set)  # client ids holding refs
     owner_released: bool = False
+    # oids of ObjectRefs serialized inside this object's payload: they are
+    # held alive (holder "cnt:<oid>") for as long as this object exists
+    # (borrowed-reference containment edges)
+    contains: List[bytes] = field(default_factory=list)
 
 
 @dataclass
@@ -204,6 +208,10 @@ class Head:
         self.metrics: Dict[str, dict] = {}  # name -> {type, desc, data{tags_key: ...}}
         # structured lifecycle event log (util/event.h analogue): JSONL file
         self._event_log = open(os.path.join(session_dir, "events.jsonl"), "a", buffering=1)
+        # transit tokens acked by the receiver BEFORE the sender's pin landed
+        # (the two travel on different sockets): tombstones cancel the late
+        # pin instead of leaking a permanent holder
+        self._spent_transit: Dict[str, float] = {}
         # pull-side file maps for serving n0's object chunks
         self._pull_maps: Dict[str, Any] = {}
 
@@ -812,6 +820,14 @@ class Head:
                 self._free_shm_name(rec.shm_name, rec.node_id)
             for nid, name in rec.copies.items():
                 self._free_shm_name(name, nid)
+            if rec.contains:
+                # release this object's containment pins on nested refs
+                edge = f"cnt:{rec.oid.hex()}"
+                for r in rec.contains:
+                    inner = self.objects.get(r)
+                    if inner is not None:
+                        inner.holders.discard(edge)
+                        self._obj_maybe_gc(inner)
 
     # --------------------------------------------------------------- handler
     async def _handle(self, state, msg, reply, reply_err):
@@ -1085,6 +1101,20 @@ class Head:
     # objects --------------------------------------------------------------
     async def _h_obj_created(self, state, msg, reply, reply_err):
         oid = msg["oid"]
+        existing = self.objects.get(oid)
+        if existing is not None:
+            # re-registration (lineage reconstruction re-ran the creating
+            # task, or a second borrower promoted the same object): keep the
+            # holders; adopt the new physical location, free the old one
+            new_name = msg.get("shm_name")
+            new_node = msg.get("node") or state.get("node_id", LOCAL_NODE)
+            if existing.shm_name and existing.shm_name != new_name:
+                self._free_shm_name(existing.shm_name, existing.node_id)
+            existing.shm_name = new_name
+            existing.size = msg.get("size", existing.size)
+            existing.node_id = new_node
+            existing.copies.clear()
+            return
         rec = ObjectRec(
             oid=oid,
             shm_name=msg.get("shm_name"),
@@ -1096,6 +1126,58 @@ class Head:
         rec.holders |= self._early_refs.pop(oid, set())
         self.objects[oid] = rec
         self.stats["objects_created"] += 1
+
+    async def _h_obj_contains(self, state, msg, reply, reply_err):
+        """Register containment edges: the object's payload embeds serialized
+        ObjectRefs, which must outlive it (borrowing, reference_count.h)."""
+        rec = self.objects.get(msg["oid"])
+        refs = msg.get("refs") or []
+        if rec is None:
+            return  # container unknown (already GC'd): nothing to pin
+        edge = f"cnt:{rec.oid.hex()}"
+        if rec.contains:
+            # re-registration (e.g. reconstruction re-ran the creating task):
+            # release the previous edges or the old inner objects leak
+            for r in rec.contains:
+                inner = self.objects.get(r)
+                if inner is not None:
+                    inner.holders.discard(edge)
+                    self._obj_maybe_gc(inner)
+        rec.contains = list(refs)
+        for r in refs:
+            inner = self.objects.get(r)
+            if inner is not None:
+                inner.holders.add(edge)
+            else:
+                self._early_refs.setdefault(r, set()).add(edge)
+
+    async def _h_transit_done(self, state, msg, reply, reply_err):
+        """Receiver ack of in-transit borrowed refs: the receiver now holds
+        its own registration; drop the sender's transit pin.  If the pin
+        hasn't landed yet (different sockets), tombstone the token so the
+        late pin is cancelled instead of leaking a permanent holder."""
+        cid = state.get("client_id", "?")
+        token = msg["token"]
+        seen = False
+        for oid in msg.get("oids") or []:
+            rec = self.objects.get(oid)
+            if rec is not None:
+                rec.holders.add(cid)
+                if token in rec.holders:
+                    seen = True
+                    rec.holders.discard(token)
+                self._obj_maybe_gc(rec)
+            else:
+                early = self._early_refs.get(oid)
+                if early is not None:
+                    early.add(cid)
+                    if token in early:
+                        seen = True
+                        early.discard(token)
+                else:
+                    self._early_refs.setdefault(oid, set()).add(cid)
+        if not seen:
+            self._spent_transit[token] = time.monotonic()
 
     async def _h_obj_copy(self, state, msg, reply, reply_err):
         """A node finished pulling a copy of an object (node-to-node
@@ -1150,13 +1232,17 @@ class Head:
         # as_id: synthetic holder ids ("<cid>#v" value pins keep an arena
         # slice alive while zero-copy views of it outlive the ObjectRef)
         cid = msg.get("as_id") or state.get("client_id", "?")
-        for oid in msg.get("inc", []):
-            rec = self.objects.get(oid)
-            if rec is not None:
-                rec.holders.add(cid)
-            else:
-                # inc may race ahead of obj_created (different sockets)
-                self._early_refs.setdefault(oid, set()).add(cid)
+        if cid in self._spent_transit:
+            # the receiver already acked this transit: the pin is moot
+            del self._spent_transit[cid]
+        else:
+            for oid in msg.get("inc", []):
+                rec = self.objects.get(oid)
+                if rec is not None:
+                    rec.holders.add(cid)
+                else:
+                    # inc may race ahead of obj_created (different sockets)
+                    self._early_refs.setdefault(oid, set()).add(cid)
         for oid in msg.get("dec", []):
             rec = self.objects.get(oid)
             if rec is not None:
@@ -1515,10 +1601,15 @@ class Head:
         # "<cid>#v" value pins) so departed readers can't pin objects forever
         self.subscribers.pop(f"shm_free:{cid}", None)
         pin_id = f"{cid}#v"
+        transit_prefix = f"t:{cid}:"
         for rec in list(self.objects.values()):
-            if cid in rec.holders or pin_id in rec.holders:
-                rec.holders.discard(cid)
-                rec.holders.discard(pin_id)
+            stale = [
+                h
+                for h in rec.holders
+                if h == cid or h == pin_id or h.startswith(transit_prefix)
+            ]
+            if stale:
+                rec.holders.difference_update(stale)
                 self._obj_maybe_gc(rec)
         if state.get("role") == "worker":
             rec = self.workers.get(cid)
@@ -1555,6 +1646,11 @@ class Head:
                     > period * self.config.health_check_failure_threshold
                 ):
                     await self._on_node_death(node)
+            if self._spent_transit:
+                # expire tombstones whose late pin never arrived (sender died)
+                cutoff = now - 60.0
+                for tok in [t for t, ts in self._spent_transit.items() if ts < cutoff]:
+                    del self._spent_transit[tok]
 
     async def run(self):
         await self.server.start()

@@ -67,9 +67,15 @@ class ObjectRef:
     def __reduce__(self):
         # Serialized refs travel through task specs; the receiving process
         # reconstructs a handle registered with its local worker so borrowed
-        # references are counted (reference ownership protocol; the full
-        # borrowing ledger of the reference's reference_count.h lands with the
-        # distributed refcount milestone).
+        # references are counted.  The sender additionally captures every
+        # nested ref it pickles (serialization.ref_capture) and pins it at
+        # the head until the receiver's own registration lands — without the
+        # pin, the sender dropping its handle mid-transit would let the head
+        # GC an object the receiver is about to use (reference_count.h
+        # borrowing protocol, centralized-ownership form).
+        from .serialization import note_serialized_ref
+
+        note_serialized_ref(self.id.binary())
         return (_rehydrate_ref, (type(self).__name__, self.id.binary(), self.owner))
 
 
@@ -86,6 +92,9 @@ class DeviceRef(ObjectRef):
         return f"DeviceRef({self.id.hex()}, owner={self.owner}, spec={self.spec})"
 
     def __reduce__(self):
+        from .serialization import note_serialized_ref
+
+        note_serialized_ref(self.id.binary())
         return (_rehydrate_device_ref, (self.id.binary(), self.owner, self.spec))
 
 

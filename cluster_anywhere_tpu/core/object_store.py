@@ -86,6 +86,12 @@ class MemoryStore:
         with self._lock:
             self._entries.setdefault(oid, _Entry("pending"))
 
+    def reset_pending(self, oid: ObjectID):
+        """Force an entry back to pending (lineage reconstruction re-executes
+        the creating task and refills it)."""
+        with self._lock:
+            self._entries[oid] = _Entry("pending")
+
     def contains(self, oid: ObjectID) -> bool:
         with self._lock:
             e = self._entries.get(oid)

@@ -16,8 +16,10 @@ Wire format of a serialized value (used both inline and in shm):
 
 from __future__ import annotations
 
+import contextlib
 import pickle
-from typing import Any, List, Tuple
+import threading
+from typing import Any, List, Optional, Tuple
 
 import cloudpickle
 import msgpack
@@ -27,6 +29,31 @@ _ALIGN = 64
 
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+# --- nested-ObjectRef capture (borrowed-reference protocol) ---------------
+# ObjectRef.__reduce__ appends the ref's id to the active capture list while
+# a value is being pickled, so senders know which references a serialized
+# value smuggles across the process boundary (reference_count.h borrowing:
+# the sender pins them until the receiver registers its own).
+_capture_tls = threading.local()
+
+
+@contextlib.contextmanager
+def ref_capture():
+    """Collect ids (bytes) of ObjectRefs pickled within the block."""
+    prev = getattr(_capture_tls, "refs", None)
+    _capture_tls.refs = []
+    try:
+        yield _capture_tls.refs
+    finally:
+        _capture_tls.refs = prev
+
+
+def note_serialized_ref(id_bytes: bytes) -> None:
+    refs: Optional[list] = getattr(_capture_tls, "refs", None)
+    if refs is not None:
+        refs.append(id_bytes)
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:

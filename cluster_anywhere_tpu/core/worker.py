@@ -309,6 +309,14 @@ class Worker:
         self.total_resources: Dict[str, float] = {}
         # in-flight node-to-node object pulls, deduped by oid
         self._pulls: Dict[bytes, asyncio.Future] = {}
+        # lineage: task specs of submitted normal tasks, so a lost object can
+        # be recomputed by re-executing its creating task (object_recovery_
+        # manager.h).  Holding the original arg ObjectRefs here pins the
+        # dependency chain (lineage pinning).  FIFO-capped.
+        self._lineage: Dict[bytes, dict] = {}
+        self._lineage_order: deque = deque()
+        self._recon_lock = threading.Lock()
+        self._recon_events: Dict[bytes, threading.Event] = {}
         # device object table: oid-bytes -> live device value (owner side)
         self.device_objects: Dict[bytes, Any] = {}
         self.current_task_id: Optional[TaskID] = None
@@ -495,7 +503,8 @@ class Worker:
             self.device_objects[oid.binary()] = value
             self.memory_store.put_value(oid, value)
             return
-        data, buffers = serialization.serialize(value)
+        with serialization.ref_capture() as nested:
+            data, buffers = serialization.serialize(value)
         raws = [b.raw() for b in buffers]
         total = len(data) + sum(len(r) for r in raws)
         if total < self.config.inline_object_max_bytes:
@@ -503,17 +512,15 @@ class Worker:
         else:
             shm_name, size = self.shm_store.create_and_pack(oid, data, raws)
             self.memory_store.put_shm(oid, shm_name, size)
-
-            def _notify():
-                if self.head and not self.head.closed:
-                    try:
-                        self.head.notify(
-                            "obj_created", oid=oid.binary(), shm_name=shm_name, size=size
-                        )
-                    except Exception:
-                        pass
-
-            self.loop.call_soon_threadsafe(_notify)
+            if nested:
+                self._promote_nested(nested)
+            self._notify_threadsafe(
+                "obj_created", oid=oid.binary(), shm_name=shm_name, size=size
+            )
+            if nested:
+                # borrowed refs inside the stored value live as long as the
+                # containing object (containment edges at the head)
+                self._notify_threadsafe("obj_contains", oid=oid.binary(), refs=nested)
 
     # ------------------------------------------------------------------ get
     def get(self, refs, timeout: Optional[float] = None):
@@ -523,6 +530,8 @@ class Worker:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
         oids = [r.id for r in ref_list]
+        for oid in oids:
+            self._seed_borrowed(oid)
         notified = False
         if self.mode == "worker" and not all(self.memory_store.contains(o) for o in oids):
             self._notify_blocked(True)
@@ -550,6 +559,43 @@ class Worker:
 
         try:
             self.loop.call_soon_threadsafe(_send)
+        except RuntimeError:
+            pass
+
+    def _seed_borrowed(self, oid: ObjectID):
+        """A borrowed handle (deserialized from another process) has no local
+        entry: seed one from the cluster object directory so get()/wait() can
+        resolve it.  Objects not yet created (ref to an unfinished task's
+        return, forwarded ahead of completion) are polled until they appear —
+        the centralized-ownership stand-in for asking the owner
+        (future_resolver.h)."""
+        if self.memory_store.get_entry(oid) is not None:
+            return
+        self.memory_store.mark_pending(oid)
+        oid_b = oid.binary()
+
+        async def _poll():
+            # no deadline: the object may belong to a task still running (ref
+            # forwarded ahead of completion) — the caller's get() timeout
+            # governs.  The poll ends when the entry fills, or when the local
+            # handle is dropped (eviction deletes the entry).
+            interval = 0.02
+            while True:
+                e = self.memory_store.get_entry(oid)
+                if e is None or e.state != "pending":
+                    return  # filled or dropped meanwhile
+                try:
+                    reply = await self.head.call("obj_locate", oid=oid_b)
+                except Exception:
+                    reply = {}
+                if reply.get("found"):
+                    self.memory_store.put_shm(oid, reply["shm_name"], reply["size"])
+                    return
+                await asyncio.sleep(interval)
+                interval = min(interval * 2, 1.0)
+
+        try:
+            self.loop.call_soon_threadsafe(lambda: spawn_bg(_poll()))
         except RuntimeError:
             pass
 
@@ -587,6 +633,20 @@ class Worker:
         return _unpin
 
     def _resolve_entry(self, ref: ObjectRef) -> Any:
+        """Resolve an ObjectRef to its value; a lost object (node death,
+        producer crash) is transparently recomputed from lineage by
+        re-executing its creating task (ObjectRecoveryManager analogue),
+        recursively for lost dependencies."""
+        try:
+            return self._resolve_entry_once(ref)
+        except (ObjectLostError, FileNotFoundError) as err:
+            if not self._reconstruct_object(ref.id):
+                if isinstance(err, ObjectLostError):
+                    raise
+                raise ObjectLostError(f"object {ref.id} lost: {err}") from err
+            return self._resolve_entry_once(ref)
+
+    def _resolve_entry_once(self, ref: ObjectRef) -> Any:
         e = self.memory_store.get_entry(ref.id)
         if e is None:
             raise ObjectLostError(f"object {ref.id} unknown")
@@ -712,11 +772,83 @@ class Worker:
         name, _ = self.run_coro(self._ensure_local_shm(oid_b, shm_name, size))
         return name
 
+    # ------------------------------------------------- lineage reconstruction
+    def _object_available(self, oid: ObjectID) -> bool:
+        """Is the object's data still reachable (locally or in the cluster)?"""
+        e = self.memory_store.get_entry(oid)
+        if e is None or e.state == "error":
+            return False
+        if e.state == "shm":
+            try:
+                reply = self.head_call("obj_locate", oid=oid.binary())
+            except Exception:
+                return False
+            return bool(reply.get("found"))
+        return True  # value/packed/pending/device resolved in-process
+
+    def _reconstruct_object(self, oid: ObjectID, depth: int = 0) -> bool:
+        """Recompute a lost object by re-executing its creating task
+        (lineage-based recovery, object_recovery_manager.h:38).  Blocking;
+        must run on a user thread (it drives RPCs through the IO loop).
+        Returns True when the object's entries were refilled."""
+        try:
+            asyncio.get_running_loop()
+            return False  # on the IO thread: cannot block on reconstruction
+        except RuntimeError:
+            pass
+        if depth > 20 or oid.is_put():
+            return False
+        tid = oid.task_id().binary()
+        rec = self._lineage.get(tid)
+        if rec is None:
+            return False
+        # single-flight per creating task: concurrent getters of its returns
+        # share one re-execution
+        with self._recon_lock:
+            ev = self._recon_events.get(tid)
+            leader = ev is None
+            if leader:
+                ev = self._recon_events[tid] = threading.Event()
+        if not leader:
+            ev.wait(self.config.push_timeout_s)
+            e = self.memory_store.get_entry(oid)
+            return e is not None and e.state not in ("pending", "error")
+        try:
+            if rec["budget"] <= 0:
+                return False
+            rec["budget"] -= 1
+            # dependencies first: a lost arg is recomputed recursively
+            deps = list(rec["args"]) + list(rec["kwargs"].values())
+            for a in deps:
+                if isinstance(a, ObjectRef) and not self._object_available(a.id):
+                    if not self._reconstruct_object(a.id, depth + 1):
+                        return False
+            oids = rec["oids"]
+            for o in oids:
+                self.memory_store.reset_pending(o)
+            task_id = TaskID(tid)
+            self._pump_submit(
+                lambda: self._task_entry(
+                    task_id, rec["fn_id"], None, rec["args"], rec["kwargs"],
+                    rec["opts"], oids,
+                )
+            )
+            ready, not_ready = self.memory_store.wait_ready(
+                oids, len(oids), self.config.push_timeout_s
+            )
+            return not not_ready
+        finally:
+            ev.set()
+            with self._recon_lock:
+                self._recon_events.pop(tid, None)
+
     # ------------------------------------------------------------------ wait
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None):
         ref_list = list(refs)
         if num_returns > len(ref_list):
             raise ValueError("num_returns exceeds number of refs")
+        for r in ref_list:
+            self._seed_borrowed(r.id)
         ready_ids, rest_ids = self.memory_store.wait_ready(
             [r.id for r in ref_list], num_returns, timeout
         )
@@ -741,6 +873,91 @@ class Worker:
         return fut
 
     # ----------------------------------------------------------- arg packing
+    def _notify_threadsafe(self, _method: str, **fields):
+        """head.notify from any thread (the cork needs the running loop)."""
+        def _send():
+            if self.head is not None and not self.head.closed:
+                try:
+                    self.head.notify(_method, **fields)
+                except Exception:
+                    pass
+
+        try:
+            self.loop.call_soon_threadsafe(_send)
+        except RuntimeError:
+            pass
+
+    def _promote_nested(self, nested: List[bytes], depth: int = 0):
+        """Nested refs to inline-only objects have no cluster-visible data
+        (inline values never register at the head): spill them to shm and
+        register, so a borrower on any process/node can locate and read them.
+        Thread-safe; recurses for refs nested inside the promoted values."""
+        if depth > 5:
+            return
+        for oid_b in nested:
+            oid = ObjectID(oid_b)
+            e = self.memory_store.get_entry(oid)
+            if e is None or e.shm_name is not None or e.state not in ("value", "packed"):
+                continue
+            try:
+                if e.state == "packed":
+                    name, mv = self.shm_store.create_for_import(oid, len(e.packed))
+                    mv[:] = e.packed
+                    mv.release()
+                    size = len(e.packed)
+                    sub: List[bytes] = []
+                else:
+                    with serialization.ref_capture() as sub:
+                        data, buffers = serialization.serialize(e.value)
+                    name, size = self.shm_store.create_and_pack(
+                        oid, data, [b.raw() for b in buffers]
+                    )
+            except Exception:
+                continue
+            e.shm_name = name
+            e.size = size
+            self._notify_threadsafe(
+                "obj_created", oid=oid_b, shm_name=name, size=size, node=self.node_id
+            )
+            if sub:
+                self._promote_nested(sub, depth + 1)
+                self._notify_threadsafe("obj_contains", oid=oid_b, refs=list(sub))
+
+    def transit_pin(self, nested: List[bytes]) -> str:
+        """Pin in-transit borrowed refs at the head under a fresh token (the
+        receiver releases it via transit_done).  Also promotes inline-only
+        nested objects to shm so borrowers can actually fetch them."""
+        self._promote_nested(nested)
+        token = f"t:{self.client_id}:{self._put_counter.next()}"
+        self._notify_threadsafe("obj_refs", inc=list(nested), as_id=token)
+        return token
+
+    def _pack_with_transit(self, value: Any) -> dict:
+        """Pack an inline value; if it smuggles ObjectRefs, pin them at the
+        head under a transit token until the receiver acks (transit_done) —
+        the inline half of the borrowed-reference protocol."""
+        with serialization.ref_capture() as nested:
+            blob = serialization.pack(value)
+        if not nested:
+            return {"v": blob}
+        token = self.transit_pin(nested)
+        return {"v": blob, "t": token, "roids": nested}
+
+    def transit_done(self, token: str, roids: List[bytes]) -> None:
+        """Receiver-side ack: register this process as holder of the smuggled
+        refs and release the sender's transit pin (thread-safe)."""
+        def _send():
+            if self.head is not None and not self.head.closed:
+                try:
+                    self.head.notify("transit_done", token=token, oids=roids)
+                except Exception:
+                    pass
+
+        try:
+            self.loop.call_soon_threadsafe(_send)
+        except RuntimeError:
+            pass
+
     async def _build_arg(self, value: Any) -> dict:
         """Build the wire spec for one task argument."""
         if isinstance(value, ObjectRef):
@@ -778,7 +995,7 @@ class Worker:
             # small local value: inline (packed)
             if e.state == "packed":
                 return {"v": e.packed}
-            return {"v": serialization.pack(e.value)}
+            return self._pack_with_transit(e.value)
         # plain value: device values stay on device when this process can
         # serve them (workers/actors); the driver materializes to host.
         if _is_device_value(value):
@@ -792,7 +1009,7 @@ class Worker:
                 "owner": self.serve_addr,
                 "spec": _device_spec(value),
             }
-        return {"v": serialization.pack(value)}
+        return self._pack_with_transit(value)
 
     async def _build_args(self, args: Sequence[Any], kwargs: Dict[str, Any]):
         if not args and not kwargs:
@@ -832,10 +1049,28 @@ class Worker:
             self.reference_counter.add_owned(oid)
         refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
         fn_id, blob = self.fn_manager.export(fn)
+        self._record_lineage(task_id, fn_id, args, kwargs, opts, oids)
         self._pump_submit(
             lambda: self._task_entry(task_id, fn_id, blob, args, kwargs, opts, oids)
         )
         return refs
+
+    def _record_lineage(self, task_id, fn_id, args, kwargs, opts, oids):
+        budget = opts.get("max_retries", self.config.default_max_retries)
+        if budget == 0:
+            return  # max_retries=0 means not reconstructable either
+        tid = task_id.binary()
+        self._lineage[tid] = {
+            "fn_id": fn_id,
+            "args": args,
+            "kwargs": kwargs,
+            "opts": opts,
+            "oids": oids,
+            "budget": budget,
+        }
+        self._lineage_order.append(tid)
+        while len(self._lineage_order) > self.config.lineage_cap:
+            self._lineage.pop(self._lineage_order.popleft(), None)
 
     def _task_entry(self, task_id, fn_id, blob, args, kwargs, opts, oids):
         """Runs on the IO thread.  Fast path: an argless task of an
@@ -983,7 +1218,24 @@ class Worker:
 
                 self.memory_store.put_error(oid, pickle.loads(res["e"]))
             elif "v" in res:
-                self.memory_store.put_packed(oid, res["v"])
+                if "t" in res:
+                    # inline value smuggling ObjectRefs: unpack eagerly so the
+                    # rehydrated handles register before we release the
+                    # sender's transit pin (lazy unpack would leave the
+                    # nested refs unprotected once the sender drops its own)
+                    try:
+                        value = serialization.unpack(res["v"])
+                    except Exception:
+                        # undeserializable here (e.g. worker-only class): keep
+                        # the refs safe by registering this process as holder
+                        # anyway, and let the getter surface the real error
+                        self.transit_done(res["t"], res["roids"])
+                        self.memory_store.put_packed(oid, res["v"])
+                    else:
+                        self.memory_store.put_value(oid, value, size=len(res["v"]))
+                        self.transit_done(res["t"], res["roids"])
+                else:
+                    self.memory_store.put_packed(oid, res["v"])
             elif "shm" in res:
                 self.memory_store.put_shm(oid, res["shm"], res.get("size", 0))
             elif "dev" in res:

@@ -62,7 +62,12 @@ class WorkerProcess:
     # ----------------------------------------------------------- args/results
     def _resolve_arg(self, spec: dict) -> Any:
         if "v" in spec:
-            return serialization.unpack(spec["v"])
+            value = serialization.unpack(spec["v"])
+            if "t" in spec:
+                # ack smuggled refs: our rehydrated handles are registered,
+                # release the sender's transit pin (borrowing protocol)
+                self.worker.transit_done(spec["t"], spec["roids"])
+            return value
         if "shm" in spec:
             name = spec["shm"]
             if not self.worker.shm_store.is_local(name):
@@ -91,25 +96,29 @@ class WorkerProcess:
         if _is_device_value(value):
             self.worker.device_objects[oid_bytes] = value
             return {"dev": oid_bytes, "owner": self.sock_path, "spec": _device_spec(value)}
-        data, buffers = serialization.serialize(value)
+        with serialization.ref_capture() as nested:
+            data, buffers = serialization.serialize(value)
         raws = [b.raw() for b in buffers]
         total = len(data) + sum(len(r) for r in raws)
         if total < self.config.inline_object_max_bytes:
+            if nested:
+                # returned value smuggles ObjectRefs: pin them under a
+                # transit token until the submitter's handles register
+                token = self.worker.transit_pin(nested)
+                return {"v": serialization.pack(value), "t": token, "roids": nested}
             return {"v": serialization.pack(value)}
         oid = ObjectID(oid_bytes)
         shm_name, size = self.worker.shm_store.create_and_pack(oid, data, raws)
-
-        def _notify():
-            # ownership of the returned object belongs to the *submitter*
-            # (reference ownership model): it decides when the segment dies.
-            try:
-                self.worker.head.notify(
-                    "obj_created", oid=oid_bytes, shm_name=shm_name, size=size, owner=owner
-                )
-            except Exception:
-                pass
-
-        self.loop.call_soon_threadsafe(_notify)
+        if nested:
+            self.worker._promote_nested(nested)
+        # ownership of the returned object belongs to the *submitter*
+        # (reference ownership model): it decides when the segment dies.
+        self.worker._notify_threadsafe(
+            "obj_created", oid=oid_bytes, shm_name=shm_name, size=size, owner=owner
+        )
+        if nested:
+            # refs inside the stored value live as long as it does
+            self.worker._notify_threadsafe("obj_contains", oid=oid_bytes, refs=nested)
         return {"shm": shm_name, "size": size}
 
     def _package_results(
@@ -132,7 +141,12 @@ class WorkerProcess:
     def _error_results(self, num_returns: int, exc: BaseException) -> List[dict]:
         import pickle
 
-        if not isinstance(exc, TaskError):
+        from .errors import CAError
+
+        # CAError subclasses keep their type across the wire: the submitter
+        # reacts to them (e.g. ObjectLostError triggers lineage
+        # reconstruction); everything else becomes a TaskError with traceback
+        if not isinstance(exc, CAError):
             exc = TaskError(repr(exc), traceback.format_exc())
         blob = pickle.dumps(exc)
         return [{"e": blob} for _ in range(num_returns)]

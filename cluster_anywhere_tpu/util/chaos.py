@@ -1,0 +1,68 @@
+"""Fault-injection utilities (analogue of the reference's killer actors,
+python/ray/_private/test_utils.py:1512 ResourceKillerActor/WorkerKillerActor,
+and the RPC chaos env described in src/ray/rpc/rpc_chaos.h).
+
+Two layers:
+- RPC chaos: set CA_TESTING_RPC_FAILURE="method=N,method2=M" (or the
+  testing_rpc_failure config field) before init(); the first N sends of each
+  named method raise ConnectionError in the sending process.  Deterministic —
+  the standard way to exercise retry paths.
+- WorkerKiller: kills random pool-worker processes on a cadence while a
+  workload runs, from a thread in the driver (same-host process kill; the
+  multi-node analogue is Cluster.remove_node).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Optional
+
+
+class WorkerKiller:
+    """Kills up to `max_kills` random idle/leased pool workers, one every
+    `period_s`, until stop() or the budget runs out."""
+
+    def __init__(self, period_s: float = 0.5, max_kills: int = 5, seed: int = 0):
+        self.period_s = period_s
+        self.max_kills = max_kills
+        self.kills = 0
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _victims(self):
+        from ..core.worker import global_worker
+
+        workers = global_worker().head_call("list_workers")["workers"]
+        return [
+            w
+            for w in workers
+            if w["state"] in ("idle", "leased") and w["pid"] and w["actor_id"] is None
+        ]
+
+    def _loop(self):
+        while not self._stop.is_set() and self.kills < self.max_kills:
+            try:
+                victims = self._victims()
+                if victims:
+                    victim = self._rng.choice(victims)
+                    os.kill(victim["pid"], signal.SIGKILL)
+                    self.kills += 1
+            except (ProcessLookupError, Exception):
+                pass
+            self._stop.wait(self.period_s)
+
+    def start(self) -> "WorkerKiller":
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="ca-killer")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,232 @@
+"""Autoscaler v2 reconciler tests (reference: autoscaler/v2 Reconciler +
+test_autoscaler_fake_multinode.py).  Unit tests drive Reconciler.step()
+through synthetic cluster states with a fake provider; the integration test
+runs the LocalNodeProvider against a live head, including the
+shrink-while-busy negative-avail hazard at core/head.py _h_update_resources."""
+
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.autoscaler.provider import NodeInfo, NodeProvider, NodeType
+from cluster_anywhere_tpu.autoscaler.reconciler import AutoscalerConfig, Reconciler
+
+
+class FakeProvider(NodeProvider):
+    def __init__(self):
+        self.nodes = {}
+        self._seq = 0
+        self.created = []
+        self.terminated = []
+
+    def create_node(self, node_type: NodeType) -> NodeInfo:
+        self._seq += 1
+        info = NodeInfo(
+            node_id=f"f{self._seq}",
+            node_type=node_type.name,
+            resources=dict(node_type.resources),
+        )
+        self.nodes[info.node_id] = info
+        self.created.append(node_type.name)
+        return info
+
+    def terminate_node(self, node: NodeInfo) -> None:
+        node.state = "terminated"
+        self.terminated.append(node.node_id)
+        self.nodes.pop(node.node_id, None)
+
+    def non_terminated_nodes(self):
+        return [n for n in self.nodes.values() if n.state != "terminated"]
+
+
+def make_reconciler(states, node_types=None, **cfg_kw):
+    """states: mutable dict the test edits between steps."""
+    provider = FakeProvider()
+    config = AutoscalerConfig(node_types=node_types, **cfg_kw)
+    rec = Reconciler(provider, config, state_fn=lambda: dict(states, pending_demands=list(states["pending_demands"])))
+    return provider, rec
+
+
+def test_scale_up_on_unmet_demand():
+    states = {
+        "pending_demands": [{"CPU": 2.0}, {"CPU": 2.0}],
+        "total": {"CPU": 2.0},
+        "available": {"CPU": 0.0},
+        "idle_workers": 0,
+        "n_workers": 2,
+    }
+    provider, rec = make_reconciler(states, node_types=[NodeType("cpu2", {"CPU": 2.0})])
+    out = rec.step()
+    assert out["launched"] == 2
+    assert provider.created == ["cpu2", "cpu2"]
+
+
+def test_no_launch_when_capacity_free():
+    states = {
+        "pending_demands": [{"CPU": 1.0}],
+        "total": {"CPU": 4.0},
+        "available": {"CPU": 3.0},
+        "idle_workers": 2,
+        "n_workers": 2,
+    }
+    provider, rec = make_reconciler(states)
+    assert rec.step()["launched"] == 0
+    assert provider.created == []
+
+
+def test_bin_packing_prefers_small_nodes_and_packs():
+    # 3x {CPU:1} demands fit one cpu2 + one cpu1 (small-first packing)
+    states = {
+        "pending_demands": [{"CPU": 1.0}] * 3,
+        "total": {"CPU": 0.0},
+        "available": {"CPU": 0.0},
+        "idle_workers": 0,
+        "n_workers": 0,
+    }
+    provider, rec = make_reconciler(
+        states,
+        node_types=[NodeType("cpu1", {"CPU": 1.0}), NodeType("cpu4", {"CPU": 4.0})],
+    )
+    out = rec.step()
+    # smallest-first: three cpu1 nodes (each serves one demand)
+    assert out["launched"] == 3
+    assert provider.created == ["cpu1", "cpu1", "cpu1"]
+
+
+def test_max_total_nodes_cap():
+    states = {
+        "pending_demands": [{"CPU": 1.0}] * 10,
+        "total": {"CPU": 0.0},
+        "available": {"CPU": 0.0},
+        "idle_workers": 0,
+        "n_workers": 0,
+    }
+    provider, rec = make_reconciler(
+        states, node_types=[NodeType("cpu1", {"CPU": 1.0}, max_nodes=100)], max_total_nodes=3
+    )
+    assert rec.step()["launched"] == 3
+
+
+def test_idle_terminate_after_timeout():
+    states = {
+        "pending_demands": [{"CPU": 1.0}],
+        "total": {"CPU": 2.0},
+        "available": {"CPU": 0.0},
+        "idle_workers": 0,
+        "n_workers": 2,
+    }
+    provider, rec = make_reconciler(
+        states, node_types=[NodeType("cpu2", {"CPU": 2.0})], idle_timeout_s=0.3
+    )
+    rec.step()
+    assert len(provider.non_terminated_nodes()) == 1
+    # demand drains; capacity grew by the launched node and is now all free
+    states["pending_demands"] = []
+    states["total"] = {"CPU": 4.0}
+    states["available"] = {"CPU": 4.0}
+    assert rec.step()["terminated"] == 0  # idle timer only starts now
+    time.sleep(0.4)
+    assert rec.step()["terminated"] == 1
+    assert provider.non_terminated_nodes() == []
+
+
+def test_no_terminate_while_provider_capacity_busy():
+    provider, rec = make_reconciler(
+        {
+            "pending_demands": [],
+            "total": {"CPU": 4.0},
+            # 3 CPUs used; base (non-provider) capacity is 4-2=2 -> provider
+            # node's capacity is in use
+            "available": {"CPU": 1.0},
+            "idle_workers": 0,
+            "n_workers": 4,
+        },
+        node_types=[NodeType("cpu2", {"CPU": 2.0})],
+        idle_timeout_s=0.0,
+    )
+    provider.create_node(rec.config.node_types[0])
+    for _ in range(3):
+        assert rec.step()["terminated"] == 0
+
+
+def test_requested_min_launches_and_pins():
+    states = {
+        "pending_demands": [],
+        "total": {"CPU": 1.0},
+        "available": {"CPU": 1.0},
+        "idle_workers": 1,
+        "n_workers": 1,
+    }
+    provider, rec = make_reconciler(
+        states, node_types=[NodeType("cpu2", {"CPU": 2.0})], idle_timeout_s=0.0
+    )
+    rec.request_resources({"CPU": 3.0})
+    assert rec.step()["launched"] == 1  # 1 free < 3 requested -> grow
+    states["total"] = {"CPU": 3.0}
+    states["available"] = {"CPU": 3.0}
+    # idle, but the requested minimum pins the node
+    rec.step()
+    time.sleep(0.05)
+    assert rec.step()["terminated"] == 0
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_shrink_while_busy_negative_avail(ca_cluster):
+    """The update_resources hazard flagged in r1: shrinking capacity that is
+    currently leased drives avail negative; the head must not grant into the
+    debt and must recover once the leases release."""
+    import cluster_anywhere_tpu as ca
+
+    @ca.remote
+    def hold(t):
+        time.sleep(t)
+        return 1
+
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    refs = [hold.remote(3.0) for _ in range(4)]  # all 4 CPUs leased
+    deadline = time.time() + 10
+    while time.time() < deadline and ca.available_resources().get("CPU", 4.0) > 0:
+        time.sleep(0.1)
+    assert ca.available_resources().get("CPU", 4.0) == 0.0
+    global_worker().head_call("update_resources", delta={"CPU": -2.0})
+    avail = ca.available_resources().get("CPU", 0.0)
+    assert avail <= 0.0  # in debt: 4 leased vs total 2
+    # nothing new is scheduled while in debt
+    late = hold.remote(0.1)
+    ready, _ = ca.wait([late], num_returns=1, timeout=0.5)
+    assert not ready
+    # when the holders finish, the debt clears and the queued task runs
+    assert ca.get(refs, timeout=30) == [1] * 4
+    assert ca.get(late, timeout=30) == 1
+    # leases drain back after the idle timeout; the debt must clear fully
+    deadline = time.time() + 15
+    while time.time() < deadline and ca.available_resources().get("CPU", 0.0) < 0:
+        time.sleep(0.2)
+    assert ca.available_resources().get("CPU", 0.0) >= 0.0
+
+
+def test_local_provider_end_to_end(ca_cluster):
+    """LocalNodeProvider scale-up: pending demand beyond base capacity causes
+    a launch; the new capacity actually runs the queued tasks."""
+    from cluster_anywhere_tpu.autoscaler.provider import LocalNodeProvider
+
+    provider = LocalNodeProvider(workers_per_node=2)
+    rec = Reconciler(
+        provider,
+        AutoscalerConfig(node_types=[NodeType("cpu2", {"CPU": 2.0})], idle_timeout_s=300),
+    )
+
+    @ca.remote
+    def hold(t):
+        time.sleep(t)
+        return 1
+
+    refs = [hold.remote(2.0) for _ in range(6)]  # 6 demands vs 4 base CPUs
+    time.sleep(0.5)  # let the pending-lease queue form
+    out = rec.step()
+    assert out["launched"] >= 1
+    assert ca.get(refs, timeout=60) == [1] * 6
+    for n in list(provider.non_terminated_nodes()):
+        provider.terminate_node(n)

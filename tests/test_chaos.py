@@ -1,0 +1,102 @@
+"""Chaos / fault-injection tests (reference: src/ray/rpc/rpc_chaos.h
+deterministic RPC failure via RAY_testing_rpc_failure; killer actors in
+python/ray/_private/test_utils.py; test_chaos.py workloads).
+
+The CA_TESTING_RPC_FAILURE spec fails the first N sends of a named RPC method
+in the process that sets it; the WorkerKiller kills random pool workers under
+load.  Both must be absorbed by the retry machinery."""
+
+import os
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.core.protocol import reset_rpc_chaos
+
+
+@pytest.fixture
+def fresh_cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    info = ca.init(num_cpus=4)
+    yield info
+    ca.shutdown()
+    reset_rpc_chaos("")
+
+
+def test_rpc_chaos_task_push_retried(fresh_cluster):
+    """Injected push_task failures are absorbed by the submitter's retry."""
+    reset_rpc_chaos("push_task=3")
+
+    @ca.remote
+    def val(x):
+        return x + 1
+
+    assert ca.get([val.remote(i) for i in range(20)], timeout=60) == list(range(1, 21))
+
+
+def test_rpc_chaos_lease_request(fresh_cluster):
+    """Injected lease-request failures must not lose queued tasks."""
+    reset_rpc_chaos("request_lease=2")
+
+    @ca.remote
+    def one():
+        return 1
+
+    # lease failures surface as task errors OR are retried by resubmission;
+    # the contract tested here: the cluster keeps working and later tasks run
+    results = []
+    for _ in range(5):
+        try:
+            results.append(ca.get(one.remote(), timeout=30))
+        except Exception:
+            results.append(None)
+    assert results[-1] == 1  # budget exhausted -> healthy again
+
+
+def test_worker_killer_under_load(fresh_cluster):
+    """Tasks complete despite workers being SIGKILLed mid-run (retry on
+    WorkerCrashedError; chaos workload analogue of test_chaos.py)."""
+    from cluster_anywhere_tpu.util.chaos import WorkerKiller
+
+    @ca.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.05)
+        return i * i
+
+    killer = WorkerKiller(period_s=0.4, max_kills=4).start()
+    try:
+        refs = [work.remote(i) for i in range(200)]
+        assert ca.get(refs, timeout=120) == [i * i for i in range(200)]
+    finally:
+        killer.stop()
+    assert killer.kills >= 1  # the chaos actually happened
+
+
+def test_actor_restart_under_kill(fresh_cluster):
+    """A killed actor restarts and keeps serving (max_restarts budget)."""
+    import signal
+
+    @ca.remote(max_restarts=2)
+    class Svc:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return os.getpid()
+
+    a = Svc.remote()
+    pid1 = ca.get(a.bump.remote(), timeout=30)
+    os.kill(pid1, signal.SIGKILL)
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ca.get(a.bump.remote(), timeout=10)
+            if pid2 != pid1:
+                break
+        except Exception:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1

@@ -1,0 +1,158 @@
+"""Lineage-based object reconstruction + borrowed references
+(object_recovery_manager.h re-execution semantics; reference_count.h
+borrowing), exercised through the multi-node Cluster fixture and the
+single-node runtime."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.cluster_utils import Cluster
+from cluster_anywhere_tpu.core.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+def test_reconstruct_after_node_death():
+    """An object whose only copy died with its node is transparently
+    recomputed by re-executing the creating task on a surviving node."""
+    c = Cluster(head_resources={"CPU": 2})
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes(2)
+    try:
+
+        @ca.remote  # default max_retries(3) doubles as reconstruction budget
+        def produce():
+            return np.full(1_000_000, 7.0)
+
+        ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True)
+        ).remote()
+        ca.wait([ref], num_returns=1, timeout=60)  # completes; bytes stay remote
+        c.remove_node(nid)
+        time.sleep(1.0)
+        arr = ca.get(ref, timeout=60)  # recomputed, not lost
+        assert arr.shape == (1_000_000,) and arr[0] == 7.0
+    finally:
+        c.shutdown()
+
+
+def test_reconstruct_chain():
+    """Recursive recovery: b depends on a; both lost with the node; get(b)
+    re-executes a then b."""
+    c = Cluster(head_resources={"CPU": 2})
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes(2)
+    try:
+        strat = NodeAffinitySchedulingStrategy(nid, soft=True)
+
+        @ca.remote
+        def base():
+            return np.arange(500_000)
+
+        @ca.remote
+        def double(x):
+            return x * 2
+
+        a = base.options(scheduling_strategy=strat).remote()
+        b = double.options(scheduling_strategy=strat).remote(a)
+        ca.wait([b], num_returns=1, timeout=60)
+        c.remove_node(nid)
+        time.sleep(1.0)
+        out = ca.get(b, timeout=90)
+        assert out[-1] == 2 * 499_999
+    finally:
+        c.shutdown()
+
+
+def test_no_reconstruction_without_budget():
+    """max_retries=0 disables lineage recording: the object stays lost."""
+    c = Cluster(head_resources={"CPU": 2})
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes(2)
+    try:
+        from cluster_anywhere_tpu.core.errors import ObjectLostError
+
+        @ca.remote(max_retries=0)
+        def produce():
+            return np.ones(1_000_000)
+
+        ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+        ).remote()
+        ca.wait([ref], num_returns=1, timeout=60)
+        c.remove_node(nid)
+        time.sleep(1.0)
+        with pytest.raises(ObjectLostError):
+            ca.get(ref, timeout=30)
+    finally:
+        c.shutdown()
+
+
+def test_borrowed_ref_in_nested_arg(ca_cluster):
+    """A ref smuggled inside a container arg survives the owner dropping its
+    handle mid-flight (transit pin + receiver registration)."""
+
+    @ca.remote
+    def use_nested(box):
+        time.sleep(0.8)  # outlive the driver's del of the handle
+        return float(ca.get(box["r"]).sum())
+
+    big = ca.put(np.ones(300_000))  # > inline threshold -> shm-backed
+    fut = use_nested.remote({"r": big})
+    del big  # owner handle gone; the borrow must keep the object alive
+    assert ca.get(fut, timeout=60) == 300_000.0
+
+
+def test_borrowed_ref_returned_from_task(ca_cluster):
+    """A task returning refs nested in a container: the refs outlive the
+    executing worker's local handles (containment edges / transit pins)."""
+
+    @ca.remote
+    def make():
+        inner = ca.put(np.full(200_000, 3.0))
+        return {"inner": inner}
+
+    box = ca.get(make.remote(), timeout=60)
+    time.sleep(1.0)  # let the worker's local handles GC + flush
+    assert float(ca.get(box["inner"], timeout=30).sum()) == 600_000.0
+
+
+def test_borrowed_inline_object_promoted(ca_cluster):
+    """A ref to an INLINE object (below the shm threshold) that crosses a
+    process boundary gets promoted to shm so the borrower can fetch it."""
+
+    @ca.remote
+    def read_nested(box):
+        return ca.get(box["tiny"])
+
+    tiny = ca.put({"k": 42})  # far below inline_object_max_bytes
+    assert ca.get(read_nested.remote({"tiny": tiny}), timeout=60) == {"k": 42}
+
+    @ca.remote
+    def make_tiny():
+        return {"inner": ca.put([1, 2, 3])}
+
+    box = ca.get(make_tiny.remote(), timeout=60)
+    time.sleep(0.8)  # worker-side handles GC + flush
+    assert ca.get(box["inner"], timeout=30) == [1, 2, 3]
+
+
+def test_borrowed_small_inline_ref(ca_cluster):
+    """Same protocol for an inline (non-shm) container value."""
+
+    @ca.remote
+    def hold(box):
+        time.sleep(0.8)
+        return ca.get(box[0])
+
+    small = ca.put(np.ones(200_000))  # shm-backed ref inside inline list
+    fut = hold.remote([small])
+    del small
+    assert ca.get(fut, timeout=60).sum() == 200_000.0
