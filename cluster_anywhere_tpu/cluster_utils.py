@@ -50,10 +50,21 @@ class Cluster:
         self._connected = False
         resources = dict(head_resources or {"CPU": 0.0})
         resources.setdefault("memory", float(self.config.object_store_memory))
+        self._head_resources = resources
+        self._spawn_head()
+        self.head_tcp = open(os.path.join(self.session_dir, "head.addr")).read().strip()
+        if connect:
+            self.connect()
 
+    def _spawn_head(self):
         env = self._base_env()
-        env["CA_RESOURCES"] = json.dumps(resources)
+        env["CA_RESOURCES"] = json.dumps(self._head_resources)
         env["CA_HEAD_PERSIST"] = "1"  # fixture controls teardown, not drivers
+        ready = os.path.join(self.session_dir, "head.ready")
+        try:
+            os.unlink(ready)
+        except FileNotFoundError:
+            pass
         head_log = open(os.path.join(self.session_dir, "head.log"), "ab")
         self._head_proc = subprocess.Popen(
             [sys.executable, "-m", "cluster_anywhere_tpu.core.head"],
@@ -63,10 +74,22 @@ class Cluster:
             start_new_session=True,
         )
         head_log.close()
-        self._wait_for_file(os.path.join(self.session_dir, "head.ready"), 30)
-        self.head_tcp = open(os.path.join(self.session_dir, "head.addr")).read().strip()
-        if connect:
-            self.connect()
+        self._wait_for_file(ready, 30)
+
+    # -------------------------------------------------------- fault injection
+    def kill_head(self):
+        """SIGKILL the head (control-plane crash; state survives in the
+        snapshot, data plane keeps running)."""
+        try:
+            os.kill(self._head_proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self._head_proc.wait(timeout=10)
+
+    def restart_head(self):
+        """Start a fresh head process for the same session: it loads the
+        snapshot and re-adopts live workers, agents, and drivers."""
+        self._spawn_head()
 
     def _base_env(self) -> dict:
         env = dict(os.environ)

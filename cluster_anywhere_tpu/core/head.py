@@ -185,8 +185,19 @@ class Head:
         self._register_waiters: Dict[str, asyncio.Future] = {}
         self.subscribers: Dict[str, List[Any]] = {}  # channel -> [writer]
         host = getattr(config, "head_host", "127.0.0.1")
+        # a restarted head rebinds the SAME tcp port (agents/remote workers
+        # reconnect to the address they were given)
+        port = 0
+        addr_file = os.path.join(session_dir, "head.addr")
+        if os.path.exists(addr_file):
+            try:
+                prev = open(addr_file).read().strip()
+                if prev.startswith("tcp:"):
+                    port = int(prev.rpartition(":")[2])
+            except (OSError, ValueError):
+                pass
         self.server = Server(
-            [self.sock_path, f"tcp:{host}:0"], self._handle, self._on_disconnect
+            [self.sock_path, f"tcp:{host}:{port}"], self._handle, self._on_disconnect
         )
         self.stats = {
             "leases_granted": 0,
@@ -212,6 +223,18 @@ class Head:
         # (the two travel on different sockets): tombstones cancel the late
         # pin instead of leaking a permanent holder
         self._spent_transit: Dict[str, float] = {}
+        # fault tolerance (gcs_server.h StorageType analogue, file-backed):
+        # debounced snapshots of the cluster tables; a restarted head loads
+        # them and re-adopts live workers/agents/drivers
+        self._ckpt_path = os.path.join(session_dir, "head.ckpt")
+        self._dirty = False
+        self._restored = False
+        if os.path.exists(self._ckpt_path):
+            try:
+                self._load_snapshot()
+                self._restored = True
+            except Exception as e:
+                self._log_event("snapshot_load_failed", error=repr(e))
         # pull-side file maps for serving n0's object chunks
         self._pull_maps: Dict[str, Any] = {}
 
@@ -248,6 +271,146 @@ class Head:
             for k, v in n.avail.items():
                 out[k] = out.get(k, 0.0) + v
         return out
+
+    # ------------------------------------------------------ fault tolerance
+    def _save_snapshot(self):
+        """Atomically persist the cluster tables (kill -9 of the head must
+        not lose actors/PGs/KV/object locations; gcs_table_storage.h role)."""
+        import msgpack
+
+        state = {
+            "nodes": [
+                {
+                    "node_id": n.node_id, "addr": n.addr, "total": n.total,
+                    "avail": n.avail, "index": n.index, "state": n.state,
+                    "pid": n.pid,
+                }
+                for n in self.nodes.values()
+            ],
+            "node_index": self._node_index,
+            "workers": [
+                {
+                    "worker_id": w.worker_id, "pid": w.pid, "addr": w.addr,
+                    "node_id": w.node_id, "state": w.state, "purpose": w.purpose,
+                    "pool": w.pool, "lease_id": w.lease_id, "actor_id": w.actor_id,
+                }
+                for w in self.workers.values()
+                if w.state != "dead"
+            ],
+            "spawn_count": self._spawn_count,
+            "actors": [
+                {
+                    "actor_id": a.actor_id, "name": a.name, "fn_id": a.fn_id,
+                    "init_spec": a.init_spec, "resources": a.resources,
+                    "max_restarts": a.max_restarts, "restarts_used": a.restarts_used,
+                    "incarnation": a.incarnation, "state": a.state,
+                    "worker_id": a.worker_id, "addr": a.addr, "detached": a.detached,
+                    "max_concurrency": a.max_concurrency, "death_cause": a.death_cause,
+                    "pg_id": a.pg_id, "bundle_index": a.bundle_index,
+                    "runtime_env": a.runtime_env, "strategy": a.strategy,
+                    "node_id": a.node_id, "charged": a.charged,
+                }
+                for a in self.actors.values()
+            ],
+            "named_actors": self.named_actors,
+            "kv": self.kv,
+            "pgs": [
+                {
+                    "pg_id": p.pg_id, "strategy": p.strategy, "state": p.state,
+                    "bundles": [
+                        {"resources": b.resources, "used": b.used, "node_id": b.node_id}
+                        for b in p.bundles
+                    ],
+                }
+                for p in self.pgs.values()
+            ],
+            "pending_pgs": list(self.pending_pgs),
+            "objects": [
+                {
+                    "oid": r.oid, "shm_name": r.shm_name, "size": r.size,
+                    "owner": r.owner, "node_id": r.node_id, "copies": r.copies,
+                    "holders": list(r.holders), "owner_released": r.owner_released,
+                    "contains": r.contains,
+                }
+                for r in self.objects.values()
+            ],
+            "leases": self.leases,
+            "lease_shapes": self._lease_shapes,
+            "lease_pg": {k: list(v) for k, v in self._lease_pg.items()},
+            "lease_node": self._lease_node,
+            "stats": self.stats,
+        }
+        blob = msgpack.packb(state, use_bin_type=True)
+        tmp = self._ckpt_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._ckpt_path)
+
+    def _load_snapshot(self):
+        import msgpack
+
+        with open(self._ckpt_path, "rb") as f:
+            state = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+        now = time.monotonic()
+        self.nodes = {}
+        for n in state["nodes"]:
+            rec = NodeRec(
+                n["node_id"], n["addr"], n["total"], n["avail"],
+                index=n["index"], state=n["state"], pid=n["pid"],
+            )
+            rec.max_workers = int(rec.total.get("CPU", 4)) * 4 + 4
+            rec.last_heartbeat = now  # grace: agents get time to reconnect
+            self.nodes[rec.node_id] = rec
+        self._node_index = state["node_index"]
+        self._spawn_count = state["spawn_count"]
+        for w in state["workers"]:
+            rec = WorkerRec(
+                w["worker_id"], w["pid"], w["addr"], node_id=w["node_id"],
+                purpose=w["purpose"], pool=w["pool"],
+            )
+            rec.state = w["state"]
+            rec.lease_id = w["lease_id"]
+            rec.actor_id = w["actor_id"]
+            rec.last_heartbeat = now
+            self.workers[rec.worker_id] = rec
+            if rec.state == "idle":
+                node = self.nodes.get(rec.node_id)
+                if node is not None and node.state == "alive":
+                    node.idle[rec.pool].append(rec.worker_id)
+        for a in state["actors"]:
+            self.actors[a["actor_id"]] = ActorRec(**a)
+        self.named_actors = state["named_actors"]
+        self.kv = state["kv"]
+        for p in state["pgs"]:
+            self.pgs[p["pg_id"]] = PGRec(
+                pg_id=p["pg_id"], strategy=p["strategy"], state=p["state"],
+                bundles=[BundleRec(**b) for b in p["bundles"]],
+            )
+        self.pending_pgs = deque(state["pending_pgs"])
+        for r in state["objects"]:
+            rec = ObjectRec(
+                oid=r["oid"], shm_name=r["shm_name"], size=r["size"],
+                owner=r["owner"], node_id=r["node_id"], copies=r["copies"],
+                owner_released=r["owner_released"], contains=r["contains"],
+            )
+            rec.holders = set(r["holders"])
+            self.objects[rec.oid] = rec
+        self.leases = state["leases"]
+        self._lease_shapes = state["lease_shapes"]
+        self._lease_pg = {k: tuple(v) for k, v in state["lease_pg"].items()}
+        self._lease_node = state["lease_node"]
+        self.stats.update(state["stats"])
+
+    async def _persist_loop(self):
+        """Debounced snapshot writer: at most one disk write per interval."""
+        while not self._shutdown.is_set():
+            await asyncio.sleep(0.25)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    self._save_snapshot()
+                except Exception as e:
+                    self._log_event("snapshot_save_failed", error=repr(e))
 
     def _log_event(self, kind: str, **fields):
         import json as _json
@@ -830,12 +993,24 @@ class Head:
                         self._obj_maybe_gc(inner)
 
     # --------------------------------------------------------------- handler
+    _READONLY_METHODS = frozenset(
+        {
+            "heartbeat", "node_heartbeat", "kv_get", "kv_keys", "get_function",
+            "obj_locate", "pull_chunk", "nodes", "cluster_resources", "stats",
+            "list_actors", "list_workers", "list_task_events", "list_objects",
+            "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
+            "get_actor", "subscribe", "publish", "task_events", "metrics_report",
+        }
+    )
+
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
         h = getattr(self, "_h_" + m, None)
         if h is None:
             reply_err(ValueError(f"unknown head method {m}"))
             return
+        if m not in self._READONLY_METHODS:
+            self._dirty = True  # persisted by the debounced snapshot loop
         await h(state, msg, reply, reply_err)
 
     async def _h_register(self, state, msg, reply, reply_err):
@@ -855,6 +1030,11 @@ class Head:
             self._driver_clients.add(client_id)
         if role == "worker":
             rec = self.workers.get(client_id)
+            if rec is not None and rec.state == "dead":
+                # fenced: a worker this head declared dead must not rejoin
+                # (it may hold stale leases/actor state)
+                reply_err(ConnectionError("worker was declared dead; exit"))
+                return
             if rec is None:
                 # externally started worker; register it on its node
                 rec = WorkerRec(
@@ -869,11 +1049,14 @@ class Head:
             rec.last_heartbeat = time.monotonic()
             if rec.purpose == "actor":
                 rec.state = "actor"
-            else:
+            elif rec.state in ("starting", "idle"):
+                # leased workers reconnecting after a head restart keep their
+                # lease; only fresh/idle ones (re)join the pool
                 rec.state = "idle"
                 node = self.nodes.get(rec.node_id)
                 if node is not None and node.state == "alive":
-                    node.idle[rec.pool].append(client_id)
+                    if client_id not in node.idle[rec.pool]:
+                        node.idle[rec.pool].append(client_id)
             fut = self._register_waiters.pop(client_id, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
@@ -889,6 +1072,21 @@ class Head:
         node_id = msg["client_id"]
         existing = self.nodes.get(node_id)
         if existing is not None and existing.state == "alive":
+            if existing.conn is None or existing.conn.closed:
+                # agent reconnecting to a restarted head: re-adopt in place
+                # (resource accounting was restored from the snapshot)
+                existing.addr = msg["addr"]
+                existing.pid = msg.get("pid", existing.pid)
+                existing.last_heartbeat = time.monotonic()
+                state["node_id"] = node_id
+                await self._connect_agent(existing)
+                if existing.state != "alive":
+                    reply_err(ConnectionError(f"head cannot reach agent at {existing.addr}"))
+                    return
+                self._log_event("node_readopted", node_id=node_id)
+                reply(node_id=node_id, session=self.session_name, head_tcp=self.tcp_addr)
+                self._service_queue()
+                return
             reply_err(ValueError(f"node id {node_id!r} already registered"))
             return
         node = self._add_node(
@@ -1038,6 +1236,12 @@ class Head:
 
     def _kill_worker_rec(self, rec: WorkerRec):
         if rec.proc is not None and rec.proc.poll() is None:
+            try:
+                os.kill(rec.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        elif rec.proc is None and rec.node_id == LOCAL_NODE and rec.pid:
+            # re-adopted after head restart: no Popen handle, kill by pid
             try:
                 os.kill(rec.pid, signal.SIGKILL)
             except ProcessLookupError:
@@ -1632,7 +1836,18 @@ class Head:
                     continue
                 if rec.proc is not None and rec.proc.poll() is not None:
                     await self._on_worker_death(rec)
-                elif (
+                    continue
+                if rec.proc is None and rec.node_id == LOCAL_NODE and rec.pid:
+                    # re-adopted after a head restart: no Popen handle, poll
+                    # the pid directly
+                    try:
+                        os.kill(rec.pid, 0)
+                    except ProcessLookupError:
+                        await self._on_worker_death(rec)
+                        continue
+                    except PermissionError:
+                        pass
+                if (
                     rec.state != "starting"
                     and now - rec.last_heartbeat
                     > period * self.config.health_check_failure_threshold
@@ -1653,6 +1868,10 @@ class Head:
                     del self._spent_transit[tok]
 
     async def run(self):
+        try:
+            os.unlink(self.sock_path)  # stale socket from a killed head
+        except FileNotFoundError:
+            pass
         await self.server.start()
         # advertise the TCP endpoint for agents / cross-host clients
         for a in self.server.bound_addrs:
@@ -1660,16 +1879,26 @@ class Head:
                 self.tcp_addr = a
         with open(os.path.join(self.session_dir, "head.addr"), "w") as f:
             f.write(self.tcp_addr or "")
-        # prestart one worker per CPU (worker_pool.h prestart behavior)
-        if self.config.worker_prestart:
+        # prestart one worker per CPU (worker_pool.h prestart behavior);
+        # a restarted head re-adopts its surviving workers instead
+        if self.config.worker_prestart and not self._restored:
             for _ in range(int(self.local_node.total.get("CPU", 1))):
                 self._spawn_worker()
+        if self._restored:
+            self._log_event(
+                "head_restarted",
+                workers=len(self.workers),
+                actors=len(self.actors),
+                nodes=len(self.nodes),
+            )
         monitor = asyncio.ensure_future(self._monitor_loop())
+        persister = asyncio.ensure_future(self._persist_loop())
         # readiness marker for the driver
         with open(os.path.join(self.session_dir, "head.ready"), "w") as f:
             f.write(str(os.getpid()))
         await self._shutdown.wait()
         monitor.cancel()
+        persister.cancel()
         await self._teardown()
 
     async def _teardown(self):
@@ -1683,7 +1912,11 @@ class Head:
                 except Exception:
                     pass
         for rec in self.workers.values():
-            if rec.proc is not None and rec.proc.poll() is None:
+            if rec.state == "dead":
+                continue
+            if rec.proc is not None and rec.proc.poll() is None or (
+                rec.proc is None and rec.node_id == LOCAL_NODE and rec.pid
+            ):
                 try:
                     os.kill(rec.pid, signal.SIGKILL)
                 except ProcessLookupError:

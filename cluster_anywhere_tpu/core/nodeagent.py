@@ -150,18 +150,17 @@ class NodeAgent:
                     except Exception:
                         pass
 
+    async def _on_head_push(self, msg):
+        # the head reaches us both through its own connection (requests)
+        # and as pushes on ours; route pushes through the same handler
+        if "m" in msg:
+            await self._handle({}, msg, lambda **kw: None, lambda e: None)
+
     async def _amain(self):
         await self.server.start()
         self.serve_addr = self.server.bound_addrs[0]
         self.head = await connect_addr(self.head_addr)
-
-        async def _on_push(msg):
-            # the head reaches us both through its own connection (requests)
-            # and as pushes on ours; route pushes through the same handler
-            if "m" in msg:
-                await self._handle({}, msg, lambda **kw: None, lambda e: None)
-
-        self.head.set_push_handler(_on_push)
+        self.head.set_push_handler(self._on_head_push)
         await self.head.call(
             "register",
             role="agent",
@@ -181,12 +180,42 @@ class NodeAgent:
         self._teardown()
 
     async def _watch_head(self):
-        """If the head connection dies, this node is orphaned: kill workers
-        and exit (the reference raylet exits when GCS is unreachable past the
-        grace period)."""
-        while not self.head.closed:
+        """Watch the head connection, redialing through restarts (a restarted
+        head re-adopts this node from its snapshot).  Tear down only when the
+        head stays unreachable past the grace window — the reference raylet's
+        GCS-unreachable exit."""
+        grace = (
+            self.config.health_check_period_s * self.config.health_check_failure_threshold
+            + 10.0
+        )
+        down_since = None
+        while not self._shutdown.is_set():
             await asyncio.sleep(0.2)
-        self._shutdown.set()
+            if not self.head.closed:
+                down_since = None
+                continue
+            now = asyncio.get_running_loop().time()
+            if down_since is None:
+                down_since = now
+            elif now - down_since > grace:
+                self._shutdown.set()
+                return
+            try:
+                conn = await connect_addr(self.head_addr)
+                conn.set_push_handler(self._on_head_push)
+                await conn.call(
+                    "register",
+                    role="agent",
+                    client_id=self.node_id,
+                    addr=self.serve_addr,
+                    resources=self.resources,
+                    pid=os.getpid(),
+                    timeout=5,
+                )
+                self.head = conn
+                down_since = None
+            except Exception:
+                await asyncio.sleep(0.5)
 
     def _teardown(self):
         import shutil

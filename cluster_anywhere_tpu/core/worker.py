@@ -201,24 +201,39 @@ class LeasePool:
         )
 
     async def _request_lease(self):
-        try:
-            kw = {}
-            if self.pg is not None:
-                kw = {"pg_id": self.pg[0], "bundle_index": self.pg[1]}
-            if self.strategy is not None:
-                kw["strategy"] = self.strategy
-            reply = await self.worker.head.call(
-                "request_lease", shape=self.shape, timeout=None, **kw
-            )
+        kw = {}
+        if self.pg is not None:
+            kw = {"pg_id": self.pg[0], "bundle_index": self.pg[1]}
+        if self.strategy is not None:
+            kw["strategy"] = self.strategy
+        attempts = 0
+        while True:
+            try:
+                reply = await self.worker.head.call(
+                    "request_lease", shape=self.shape, timeout=None, **kw
+                )
+            except ConnectionError:
+                # head died mid-request (restart window): re-issue once the
+                # housekeeping loop has reconnected, instead of failing the
+                # queued tasks
+                attempts += 1
+                if self.worker._stopped or self.worker._head_fenced or attempts > 120:
+                    self.requests_outstanding -= 1
+                    self._fail_waiters(ConnectionError("cluster head unreachable"))
+                    return
+                await asyncio.sleep(0.5)
+                continue
+            except Exception as e:
+                # unrecoverable admission errors (e.g. removed placement
+                # group) must surface on the waiting tasks, not spin forever
+                self.requests_outstanding -= 1
+                self._fail_waiters(e)
+                return
             lease = _Lease(reply["lease_id"], reply["worker_id"], reply["addr"])
             self.leases.append(lease)
             self.requests_outstanding -= 1
             self._wake(self.max_inflight)
-        except Exception as e:
-            # unrecoverable admission errors (e.g. removed placement group)
-            # must surface on the waiting tasks, not spin forever
-            self.requests_outstanding -= 1
-            self._fail_waiters(e)
+            return
 
     def _wake(self, n: int = 1):
         while self.waiters and n > 0:
@@ -327,6 +342,7 @@ class Worker:
         self._submit_wakeup_pending = False
         self._submit_lock = threading.Lock()
         self._stopped = False
+        self._head_fenced = False  # head refused re-registration: must exit
         self._external_loop = loop is not None
         if loop is None:
             self.loop = asyncio.new_event_loop()
@@ -435,6 +451,10 @@ class Worker:
         while not self._stopped:
             await asyncio.sleep(period)
             now = time.monotonic()
+            if self.head is not None and self.head.closed and not self._head_fenced:
+                # head died (restart-in-progress): keep redialing; the
+                # restarted head re-adopts us from its snapshot
+                await self._reconnect_head()
             to_return = []
             for pool in self._lease_pools.values():
                 to_return.extend(pool.reap_idle(now, self.config.lease_idle_timeout_s))
@@ -444,6 +464,33 @@ class Worker:
                 except Exception:
                     pass
             self.reference_counter.flush()
+
+    async def _reconnect_head(self) -> bool:
+        """Redial and re-register with the head (gcs_client_reconnection
+        analogue).  Sets _head_fenced if the head refuses us (it declared
+        this worker dead — the process must exit, not retry)."""
+        try:
+            conn = await connect_addr(self.head_sock)
+        except OSError:
+            return False
+        conn.set_push_handler(self._on_push)
+        try:
+            await conn.call(
+                "register",
+                role=self.mode,
+                client_id=self.client_id,
+                pid=os.getpid(),
+                addr=self.serve_addr or "",
+                node_id=self.node_id,
+                timeout=5,
+            )
+        except Exception as e:
+            if "declared dead" in str(e):
+                self._head_fenced = True
+            await conn.close()
+            return False
+        self.head = conn
+        return True
 
     def _flush_refs(self, inc: List[bytes], dec: List[bytes]):
         def _send():
@@ -1398,16 +1445,28 @@ class Worker:
         self._store_error(oids, last_err or ActorDiedError("actor call failed"))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
-        self.run_coro(
-            self.head.call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)
-        )
+        self.head_call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)
 
     def get_actor_info(self, name: Optional[str] = None, actor_id: Optional[str] = None) -> dict:
-        return self.run_coro(self.head.call("get_actor", name=name, actor_id=actor_id))
+        return self.head_call("get_actor", name=name, actor_id=actor_id)
 
     # ------------------------------------------------------------- cluster
     def head_call(self, method: str, **fields) -> dict:
-        return self.run_coro(self.head.call(method, **fields))
+        """Blocking control-plane RPC.  Rides through a head restart: while
+        the housekeeping loop is redialing, retry instead of surfacing
+        ConnectionError (gcs client reconnection semantics)."""
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                return self.run_coro(self.head.call(method, **fields))
+            except ConnectionError:
+                if (
+                    self._stopped
+                    or self._head_fenced
+                    or time.monotonic() > deadline
+                ):
+                    raise
+                time.sleep(0.25)
 
     def shutdown(self, stop_cluster: bool = False):
         self._stopped = True

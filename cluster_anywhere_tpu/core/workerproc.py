@@ -447,13 +447,24 @@ class WorkerProcess:
         await asyncio.Event().wait()
 
     async def _watch_head(self):
-        """Exit when the head connection dies: a worker without a control
-        plane is an orphan (the head also force-closes our connection when it
-        declares us dead — fencing, so a partitioned worker can't act on a
-        stale lease).  Analogue of the raylet-death exit in the reference."""
-        while not self.worker.head.closed:
+        """Watch the head connection.  A dead head gets a reconnect grace
+        window (the Worker housekeeping loop redials; a restarted head
+        re-adopts us from its snapshot).  Exit when (a) the head explicitly
+        fenced us — it declared this worker dead, a stale lease must not keep
+        acting — or (b) the grace expires with no head (orphan reaping)."""
+        grace = self.config.health_check_period_s * self.config.health_check_failure_threshold + 10.0
+        down_since = None
+        while True:
             await asyncio.sleep(0.5)
-        os._exit(1)
+            if self.worker._head_fenced:
+                os._exit(1)
+            if self.worker.head is None or self.worker.head.closed:
+                if down_since is None:
+                    down_since = asyncio.get_running_loop().time()
+                elif asyncio.get_running_loop().time() - down_since > grace:
+                    os._exit(1)
+            else:
+                down_since = None
 
     def main(self):
         asyncio.set_event_loop(self.loop)
