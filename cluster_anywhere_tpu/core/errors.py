@@ -62,5 +62,11 @@ class ObjectStoreFullError(CAError):
     """The shared-memory object store could not allocate."""
 
 
+class StaleObjectError(CAError):
+    """A shared-memory slice was recycled since this reference was taken
+    (its seal sequence no longer matches); the reader must re-resolve the
+    object's current location through the directory."""
+
+
 class PlacementGroupError(CAError):
     """Placement group could not be created or was removed."""

@@ -120,6 +120,11 @@ class ObjectRec:
     # held alive (holder "cnt:<oid>") for as long as this object exists
     # (borrowed-reference containment edges)
     contains: List[bytes] = field(default_factory=list)
+    # spill state (external_storage.py analogue): when set, the bytes live in
+    # a disk file on `node_id`; pending_free is the old shm slice awaiting
+    # reclaim until the last zero-copy pin drops
+    spill_path: Optional[str] = None
+    pending_free: Optional[str] = None
 
 
 @dataclass
@@ -330,7 +335,8 @@ class Head:
                     "oid": r.oid, "shm_name": r.shm_name, "size": r.size,
                     "owner": r.owner, "node_id": r.node_id, "copies": r.copies,
                     "holders": list(r.holders), "owner_released": r.owner_released,
-                    "contains": r.contains,
+                    "contains": r.contains, "spill_path": r.spill_path,
+                    "pending_free": r.pending_free,
                 }
                 for r in self.objects.values()
             ],
@@ -392,6 +398,7 @@ class Head:
                 oid=r["oid"], shm_name=r["shm_name"], size=r["size"],
                 owner=r["owner"], node_id=r["node_id"], copies=r["copies"],
                 owner_released=r["owner_released"], contains=r["contains"],
+                spill_path=r.get("spill_path"), pending_free=r.get("pending_free"),
             )
             rec.holders = set(r["holders"])
             self.objects[rec.oid] = rec
@@ -975,12 +982,30 @@ class Head:
                 except Exception:
                     pass
 
+    def _free_spill(self, path: str, node_id: str):
+        if node_id == LOCAL_NODE:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        else:
+            node = self.nodes.get(node_id)
+            if node is not None and node.conn is not None and not node.conn.closed:
+                try:
+                    node.conn.notify("unlink_spill", path=path)
+                except Exception:
+                    pass
+
     def _obj_maybe_gc(self, rec: ObjectRec):
         if rec.owner_released and not rec.holders:
             self.objects.pop(rec.oid, None)
             self.stats["objects_gc"] += 1
             if rec.shm_name:
                 self._free_shm_name(rec.shm_name, rec.node_id)
+            if rec.pending_free:
+                self._free_shm_name(rec.pending_free, rec.node_id)
+            if rec.spill_path:
+                self._free_spill(rec.spill_path, rec.node_id)
             for nid, name in rec.copies.items():
                 self._free_shm_name(name, nid)
             if rec.contains:
@@ -1406,23 +1431,63 @@ class Head:
         node = self.nodes.get(node_id)
         return node.addr if node is not None and node.state == "alive" else None
 
+    def _locate_fields(self, rec: ObjectRec, caller_node: str) -> dict:
+        if rec.node_id != caller_node and caller_node in rec.copies:
+            return {
+                "found": True, "shm_name": rec.copies[caller_node],
+                "size": rec.size, "owner": rec.owner, "node": caller_node,
+                "pull_addr": None,
+            }
+        return {
+            "found": True, "shm_name": rec.shm_name, "size": rec.size,
+            "owner": rec.owner, "node": rec.node_id,
+            "pull_addr": self._pull_addr_for(rec.node_id),
+            "spill_path": rec.spill_path,
+        }
+
     async def _h_obj_locate(self, state, msg, reply, reply_err):
         rec = self.objects.get(msg["oid"])
         if rec is None:
             reply(found=False)
             return
         # prefer a copy on the caller's node
-        caller_node = state.get("node_id", LOCAL_NODE)
-        if rec.node_id != caller_node and caller_node in rec.copies:
-            reply(
-                found=True, shm_name=rec.copies[caller_node], size=rec.size,
-                owner=rec.owner, node=caller_node, pull_addr=None,
-            )
+        reply(**self._locate_fields(rec, state.get("node_id", LOCAL_NODE)))
+
+    async def _h_obj_spilled(self, state, msg, reply, reply_err):
+        """Producer moved an object's bytes to disk under memory pressure
+        (local_object_manager.h spill).  The old shm slice is reclaimed
+        immediately when nothing holds a zero-copy view of it; otherwise the
+        reclaim waits for the last pin to drop."""
+        rec = self.objects.get(msg["oid"])
+        if rec is None:
+            reply(found=False, free_now=False)
             return
-        reply(
-            found=True, shm_name=rec.shm_name, size=rec.size, owner=rec.owner,
-            node=rec.node_id, pull_addr=self._pull_addr_for(rec.node_id),
-        )
+        old = rec.shm_name
+        rec.spill_path = msg["path"]
+        rec.shm_name = None
+        rec.copies.clear()
+        pinned = any(h.endswith("#v") for h in rec.holders)
+        if old is None:
+            reply(found=True, free_now=False)
+        elif pinned:
+            rec.pending_free = old
+            reply(found=True, free_now=False)
+        else:
+            # the producer frees its slice synchronously (it needs the space
+            # now); no reclaim broadcast needed
+            reply(found=True, free_now=True)
+        self.stats["objects_spilled"] = self.stats.get("objects_spilled", 0) + 1
+
+    async def _h_obj_pin(self, state, msg, reply, reply_err):
+        """Confirmed zero-copy pin: registering the pin and learning the
+        object's CURRENT location is one atomic head-side step, so a reader
+        can never map a slice that spilling is about to recycle."""
+        rec = self.objects.get(msg["oid"])
+        if rec is None:
+            reply(found=False)
+            return
+        rec.holders.add(msg["as_id"])
+        reply(**self._locate_fields(rec, state.get("node_id", LOCAL_NODE)))
 
     async def _h_pull_chunk(self, state, msg, reply, reply_err):
         """Serve a chunk of one of n0's objects for node-to-node transfer
@@ -1453,6 +1518,14 @@ class Head:
                 rec.holders.discard(cid)
                 if cid == rec.owner:
                     rec.owner_released = True
+                if (
+                    rec.pending_free
+                    and cid.endswith("#v")
+                    and not any(h.endswith("#v") for h in rec.holders)
+                ):
+                    # last zero-copy pin on a spilled object's old slice gone
+                    self._free_shm_name(rec.pending_free, rec.node_id)
+                    rec.pending_free = None
                 self._obj_maybe_gc(rec)
             else:
                 early = self._early_refs.get(oid)
@@ -1930,18 +2003,33 @@ class Head:
 
 
 def read_shm_chunk(session_name: str, map_cache: Dict[str, Any], shm_name: str, off: int, length: int) -> bytes:
-    """Read one chunk of a local shm object for node-to-node transfer.
-    Shared by the head (serving n0) and node agents (serving their node).
-    The name is validated against the session namespace (no path escapes)."""
+    """Read one chunk of a local object for node-to-node transfer.  Shared by
+    the head (serving n0) and node agents (serving their node).  Serves shm
+    arena slices (seal-sequence verified), dedicated segments, and spilled
+    disk files ("spill:<path>").  Names/paths are validated against the
+    session namespace (no path escapes)."""
     import mmap as _mmap
 
+    from .errors import StaleObjectError
+    from .object_store import _SLICE_HDR, ShmObjectStore
+
+    if shm_name.startswith("spill:"):
+        path = shm_name[len("spill:"):]
+        if f"/{session_name}/" not in path or ".." in path or "/spill/" not in path:
+            raise ValueError(f"invalid spill path {path!r}")
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            m = _mmap.mmap(fd, os.fstat(fd).st_size, prot=_mmap.PROT_READ)
+            return bytes(memoryview(m)[off : off + length])
+        finally:
+            os.close(fd)
     if not shm_name.startswith(session_name + "/") or ".." in shm_name:
         raise ValueError(f"invalid shm name {shm_name!r}")
     file_name = shm_name.split("@", 1)[0]
     base = 0
+    seq = 0
     if "@" in shm_name:
-        rest = shm_name.split("@", 1)[1]
-        base = int(rest.partition("+")[0])
+        _, base, _size, seq = ShmObjectStore.parse_slice(shm_name)
     m = map_cache.get(file_name)
     if m is None:
         fd = os.open(os.path.join("/dev/shm", file_name), os.O_RDONLY)
@@ -1950,6 +2038,11 @@ def read_shm_chunk(session_name: str, map_cache: Dict[str, Any], shm_name: str, 
         finally:
             os.close(fd)
         map_cache[file_name] = m
+    if seq:
+        cur = int.from_bytes(bytes(m[base : base + _SLICE_HDR]), "little")
+        if cur != seq:
+            raise StaleObjectError(f"slice {shm_name} recycled while serving")
+        base += _SLICE_HDR
     return bytes(memoryview(m)[base + off : base + off + length])
 
 

@@ -124,6 +124,13 @@ class NodeAgent:
                     os.unlink(os.path.join("/dev/shm", name))
                 except OSError:
                     pass
+        elif m == "unlink_spill":
+            path = msg["path"]
+            if f"/{self.session_name}/" in path and "/spill/" in path and ".." not in path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         elif m == "node_shutdown":
             self._shutdown.set()
         elif m == "ping":

@@ -22,10 +22,17 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import serialization
-from .errors import ObjectStoreFullError, TaskError
+from .errors import ObjectStoreFullError, StaleObjectError, TaskError
 from .ids import ObjectID
 
 SHM_DIR = "/dev/shm"
+
+# Arena slices carry an 8-byte seal sequence ahead of the payload; slice
+# names embed the same sequence ("arena@off+size#seq").  A reader whose name
+# no longer matches the in-memory sequence is holding a RECYCLED slice and
+# gets StaleObjectError instead of silently reading another object's bytes
+# (the store then re-resolves through the head: GC'd-and-reused, or spilled).
+_SLICE_HDR = 8
 
 
 @dataclass
@@ -150,7 +157,11 @@ class MemoryStore:
 
 _PAGE = 4096
 _ARENA_DEFAULT = 256 * 1024 * 1024  # first arena size
-_ARENA_MAX_OBJ = 1 << 31  # larger objects get dedicated segments
+# Objects up to this size ride the pre-faulted arena path (puts pay memcpy
+# only); larger ones get dedicated segments.  16 GiB keeps multi-GiB objects
+# (the reference's 100 GiB-object envelope is stitched from such puts) off
+# the first-touch-fault path.
+_ARENA_MAX_OBJ = 1 << 34
 
 
 def _align_up(n: int, a: int = _PAGE) -> int:
@@ -264,12 +275,24 @@ class _Arena:
 class ShmObjectStore:
     """Producer/consumer interface to the node-local shared-memory store.
 
-    Objects live as slices of pre-faulted arena files (shm_name
-    "<arena>@<offset>+<size>") or, above _ARENA_MAX_OBJ, as dedicated sealed
-    segments.  Segment layout = serialization.pack() format, written in place.
+    Objects live as seal-sequenced slices of pre-faulted arena files
+    (shm_name "<arena>@<offset>+<size>#<seq>") or, above _ARENA_MAX_OBJ, as
+    dedicated sealed segments.  Segment layout = serialization.pack() format,
+    written in place behind the 8-byte slice header.
+
+    Memory management (plasma eviction_policy.h / external_storage.py
+    analogue): `budget_bytes` caps total arena footprint; when growth would
+    exceed it, `spill_cb(bytes_needed)` is invoked (the Worker spills the
+    oldest live slices to disk via the head) before falling back to growth.
     """
 
-    def __init__(self, session_name: str, owner_tag: Optional[str] = None, node_id: str = "n0"):
+    def __init__(
+        self,
+        session_name: str,
+        owner_tag: Optional[str] = None,
+        node_id: str = "n0",
+        budget_bytes: int = 0,
+    ):
         self.session_name = session_name
         self.node_id = node_id
         # per-node namespace: objects living in another node's namespace are
@@ -288,6 +311,27 @@ class ShmObjectStore:
         self._arenas: Dict[str, _Arena] = {}
         self._arena_seq = 0
         self._grow_lock = threading.Lock()  # one arena creation at a time
+        # live slices this process sealed, insertion-ordered (spill picks the
+        # oldest): name -> (alloc_offset, alloc_size, oid_bytes)
+        self._live_slices: Dict[str, Tuple[int, int, bytes]] = {}
+        self._slice_seq = 0
+        self.budget_bytes = budget_bytes  # 0 = uncapped
+        self.spill_cb = None  # set by the Worker; fn(bytes_needed) -> None
+
+    def arena_bytes(self) -> int:
+        with self._lock:
+            return sum(a.size for a in self._arenas.values())
+
+    def live_slices_oldest_first(self) -> List[Tuple[str, int, bytes]]:
+        """Spill-candidate view: (shm_name, payload_size, oid) oldest first.
+        Only primary slices qualify — pulled copies are droppable, not
+        spillable, and carry an empty oid tag."""
+        with self._lock:
+            return [
+                (name, alloc - _SLICE_HDR, oid)
+                for name, (off, alloc, oid) in self._live_slices.items()
+                if oid
+            ]
 
     # -- native acceleration ------------------------------------------------
     def _native_lib(self):
@@ -306,13 +350,19 @@ class ShmObjectStore:
         return f"{self.ns}/obj_{oid.hex()}"
 
     def is_local(self, shm_name: str) -> bool:
-        """True if this shm name lives in this node's namespace (directly
-        mappable); False means it must be fetched node-to-node."""
+        """True if this name lives in this node's namespace (directly
+        mappable); False means it must be fetched node-to-node.  Spilled
+        locations ("spill:<path>") are local when the path is under this
+        node's spill directory."""
+        if shm_name.startswith("spill:"):
+            return f"/spill/{self.node_id}/" in shm_name
         return shm_name.startswith(self.ns + "/")
 
     def warm(self, capacity: int = _ARENA_DEFAULT):
         """Pre-create (and background-prefault) an arena so first puts pay
         memcpy only — the plasma analogue of pre-allocated store memory."""
+        if self.budget_bytes:
+            capacity = min(capacity, self.budget_bytes)
         with self._lock:
             if self._arenas:
                 return
@@ -338,6 +388,20 @@ class ShmObjectStore:
         got = self._try_alloc(size)
         if got is not None:
             return got
+        # over-budget growth first tries to spill old slices to disk (the
+        # plasma-eviction analogue); only then does the arena set grow
+        if (
+            self.budget_bytes
+            and self.spill_cb is not None
+            and self.arena_bytes() + size > self.budget_bytes
+        ):
+            try:
+                self.spill_cb(size)
+            except Exception:
+                pass
+            got = self._try_alloc(size)
+            if got is not None:
+                return got
         # growth is serialized: concurrent put bursts must not each create a
         # full-size arena, and a prefault thread transiently reserving chunks
         # must not fake an out-of-space condition
@@ -372,6 +436,22 @@ class ShmObjectStore:
             off = arena.alloc(size)
             return (arena, off) if off is not None else None
 
+    def _seal_slice(
+        self, arena: _Arena, off: int, payload_size: int, oid: ObjectID, primary: bool
+    ) -> Tuple[str, memoryview]:
+        """Stamp a fresh allocation's seal sequence and register it live.
+        Returns (shm_name, payload view)."""
+        with self._lock:
+            self._slice_seq += 1
+            seq = self._slice_seq
+        arena.mm[off : off + _SLICE_HDR] = seq.to_bytes(_SLICE_HDR, "little")
+        name = f"{arena.name}@{off}+{payload_size}#{seq}"
+        with self._lock:
+            self._live_slices[name] = (
+                off, _align_up(payload_size + _SLICE_HDR), oid.binary() if primary else b"",
+            )
+        return name, memoryview(arena.mm)[off + _SLICE_HDR : off + _SLICE_HDR + payload_size]
+
     def _pack_into(self, mv, data: bytes, raws: List[Any]):
         native = self._native_lib()
         if native is not None:
@@ -384,15 +464,15 @@ class ShmObjectStore:
         shm_name addresses either an arena slice or a dedicated segment."""
         size = serialization.packed_size(data, raws)
         if size <= _ARENA_MAX_OBJ:
-            got = self._arena_alloc(size)
+            got = self._arena_alloc(_align_up(size + _SLICE_HDR))
             if got is not None:
                 arena, off = got
-                mv = memoryview(arena.mm)[off : off + size]
+                name, mv = self._seal_slice(arena, off, size, oid, primary=True)
                 try:
                     self._pack_into(mv, data, raws)
                 finally:
                     mv.release()
-                return f"{arena.name}@{off}+{size}", size
+                return name, size
         # dedicated segment path (huge objects, or arena creation failed)
         name = self.name_for(oid)
         path = os.path.join(SHM_DIR, name)
@@ -415,16 +495,16 @@ class ShmObjectStore:
         os.rename(tmp, path)  # atomic seal
         return name, size
 
-    def create_for_import(self, oid: ObjectID, size: int) -> Tuple[str, memoryview]:
-        """Allocate local space for a verbatim copy of a remote object
-        (node-to-node transfer: the packed bytes are copied as-is).  Returns
-        (local shm_name, writable view of exactly `size` bytes); the caller
-        writes the pulled chunks into the view and releases it."""
+    def create_for_import(self, oid: ObjectID, size: int, primary: bool = False) -> Tuple[str, memoryview]:
+        """Allocate local space for a verbatim copy of an object's packed
+        bytes (node-to-node transfer, or primary promotion of an inline
+        value).  Returns (local shm_name, writable view of exactly `size`
+        bytes); the caller writes into the view and releases it."""
         if size <= _ARENA_MAX_OBJ:
-            got = self._arena_alloc(size)
+            got = self._arena_alloc(_align_up(size + _SLICE_HDR))
             if got is not None:
                 arena, off = got
-                return f"{arena.name}@{off}+{size}", memoryview(arena.mm)[off : off + size]
+                return self._seal_slice(arena, off, size, oid, primary=primary)
         name = f"{self.ns}/import_{oid.hex()}"
         path = os.path.join(SHM_DIR, name)
         fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
@@ -437,20 +517,35 @@ class ShmObjectStore:
             self._open_maps[name] = (m, size)
         return name, memoryview(m)
 
+    @staticmethod
+    def parse_slice(shm_name: str):
+        """'arena@off+size#seq' -> (arena_name, off, payload_size, seq).
+        seq is 0 for legacy names without a seal sequence."""
+        arena_name, _, rest = shm_name.partition("@")
+        off_s, _, rest = rest.partition("+")
+        size_s, _, seq_s = rest.partition("#")
+        return arena_name, int(off_s), int(size_s), int(seq_s or 0)
+
     def free_local(self, shm_name: str):
         """Owner-side reclaim of an arena slice (called when the head GCs the
-        object); no-op for names this process doesn't own."""
+        object); no-op for names this process doesn't own.  Idempotent: a
+        slice already freed (e.g. spilled synchronously, then the head's
+        reclaim broadcast arrives) is skipped — double-free would corrupt the
+        coalescing free list."""
         if "@" not in shm_name:
             return
-        arena_name, _, rest = shm_name.partition("@")
+        try:
+            arena_name, off, size, _seq = self.parse_slice(shm_name)
+        except ValueError:
+            return
+        with self._lock:
+            entry = self._live_slices.pop(shm_name, None)
+        if entry is None:
+            return  # unknown or already freed
         arena = self._arenas.get(arena_name)
         if arena is None:
             return
-        off_s, _, size_s = rest.partition("+")
-        try:
-            arena.free_slice(int(off_s), int(size_s))
-        except ValueError:
-            pass
+        arena.free_slice(entry[0], entry[1])
 
     def put(self, oid: ObjectID, value: Any) -> Tuple[str, int]:
         data, buffers = serialization.serialize(value)
@@ -486,13 +581,36 @@ class ShmObjectStore:
         return m
 
     def open(self, shm_name: str) -> memoryview:
-        """Zero-copy read view of an object (arena slice or segment)."""
+        """Zero-copy read view of an object (arena slice or segment).
+        Raises StaleObjectError if the slice was recycled since the name was
+        minted (seal sequence mismatch) — the caller re-resolves through the
+        head (the object was GC'd+reused, or spilled to disk)."""
+        if shm_name.startswith("spill:"):
+            return self.open_spill(shm_name[len("spill:"):])
         if "@" in shm_name:
-            file_name, _, rest = shm_name.partition("@")
-            off_s, _, size_s = rest.partition("+")
-            off, size = int(off_s), int(size_s)
-            return memoryview(self._map_file(file_name))[off : off + size]
+            file_name, off, size, seq = self.parse_slice(shm_name)
+            m = self._map_file(file_name)
+            if seq:
+                cur = int.from_bytes(bytes(m[off : off + _SLICE_HDR]), "little")
+                if cur != seq:
+                    raise StaleObjectError(
+                        f"slice {shm_name} recycled (seq {cur} != {seq})"
+                    )
+                off += _SLICE_HDR
+            return memoryview(m)[off : off + size]
         return memoryview(self._map_file(shm_name))
+
+    def open_spill(self, path: str) -> memoryview:
+        """Read view of a spilled object (disk file, serialization.pack
+        format).  The mapping keeps the data alive even if the file is
+        unlinked by GC while views exist."""
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            size = os.fstat(fd).st_size
+            m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        return memoryview(m)
 
     def get(self, shm_name: str) -> Any:
         return serialization.unpack(self.open(shm_name))

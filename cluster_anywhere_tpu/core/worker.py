@@ -28,6 +28,7 @@ from .errors import (
     CAError,
     GetTimeoutError,
     ObjectLostError,
+    StaleObjectError,
     TaskError,
     WorkerCrashedError,
 )
@@ -301,8 +302,12 @@ class Worker:
         self.node_id = os.environ.get("CA_NODE_ID", "n0")
         self.memory_store = MemoryStore()
         self.shm_store = ShmObjectStore(
-            self.session_name, owner_tag=self.client_id, node_id=self.node_id
+            self.session_name,
+            owner_tag=self.client_id,
+            node_id=self.node_id,
+            budget_bytes=(config or get_config()).object_store_memory,
         )
+        self.shm_store.spill_cb = self._spill_bytes
         if mode == "driver":
             # plasma-style pre-allocation: warm an arena while the driver is
             # still bootstrapping so early puts land in pre-faulted pages
@@ -706,29 +711,70 @@ class Worker:
             self.memory_store.put_value(ref.id, value, size=e.size)
             return value
         if e.state == "shm":
-            if not self.shm_store.is_local(e.shm_name):
-                # primary copy lives on another node: pull it into the local
-                # namespace first (chunked node-to-node transfer)
-                local_name, _ = self.run_coro(
-                    self._ensure_local_shm(ref.id.binary(), e.shm_name, e.size)
-                )
-                e.shm_name = local_name
-            pin_cb = None
-            if "@" in e.shm_name:
-                # arena slice: hold a synthetic "<cid>#v" holder at the head
-                # until every zero-copy view of this value is gone, so the
-                # owner's allocator cannot recycle the slice under a live view
-                pin_cb = self._make_value_pin(ref.id)
-            value = serialization.unpack(self.shm_store.open(e.shm_name), pin_cb=pin_cb)
-            # cache the value; e.shm_name is kept so args can still be passed
-            # by shm reference instead of re-packing
-            e.value = value
-            e.state = "value"
-            return value
+            return self._read_shm_entry(ref, e)
         if e.state == "device":
             # device value owned by another process: explicit materialization
             return self._fetch_remote(ref, e)
         raise ObjectLostError(f"object {ref.id} in unexpected state {e.state}")
+
+    def _on_io_thread(self) -> bool:
+        try:
+            asyncio.get_running_loop()
+            return True
+        except RuntimeError:
+            return False
+
+    def _pin_unref_cb(self, oid_b: bytes):
+        pin_id = f"{self.client_id}#v"
+
+        def _unpin():
+            self._notify_threadsafe("obj_refs", inc=[], dec=[oid_b], as_id=pin_id)
+
+        return _unpin
+
+    def _read_shm_entry(self, ref: ObjectRef, e: _Entry) -> Any:
+        """Materialize a shm-backed entry: confirmed pin + authoritative
+        location from the head (atomic, so spilling can never recycle a slice
+        under the mapping), node-to-node pull when remote, disk read when
+        spilled, and relocation retry on stale slices."""
+        oid_b = ref.id.binary()
+        on_loop = self._on_io_thread()
+        last_err: Optional[BaseException] = None
+        for _ in range(3):
+            name = e.shm_name
+            pin_cb = None
+            loc = None
+            if on_loop:
+                # rare loop-thread resolution (serving fetch_object): the
+                # notify-based pin accepts a tiny pin-vs-spill race
+                if "@" in name:
+                    pin_cb = self._make_value_pin(ref.id)
+            else:
+                loc = self.head_call(
+                    "obj_pin", oid=oid_b, as_id=f"{self.client_id}#v"
+                )
+                if not loc.get("found"):
+                    raise ObjectLostError(f"object {ref.id} not in the directory")
+                pin_cb = self._pin_unref_cb(oid_b)
+                if loc.get("spill_path"):
+                    name = "spill:" + loc["spill_path"]
+                elif loc.get("node") == self.node_id and loc.get("shm_name"):
+                    name = loc["shm_name"]
+            try:
+                if not self.shm_store.is_local(name):
+                    name, _ = self.run_coro(self._ensure_local_shm(oid_b, name, e.size))
+                value = serialization.unpack(self.shm_store.open(name), pin_cb=pin_cb)
+                if not name.startswith("spill:"):
+                    e.shm_name = name
+                e.value = value
+                e.state = "value"
+                return value
+            except (StaleObjectError, FileNotFoundError) as err:
+                last_err = err
+                if pin_cb is not None:
+                    pin_cb()  # release this attempt's pin before retrying
+                continue  # re-pin for a fresh location
+        raise ObjectLostError(f"object {ref.id} unreadable after relocation: {last_err}")
 
     def _fetch_remote(self, ref: ObjectRef, e: _Entry) -> Any:
         owner_addr = e.shm_name  # device entries store owner addr here
@@ -774,9 +820,14 @@ class Worker:
             raise ObjectLostError(
                 f"object {oid_b.hex()} not found in the cluster (node lost?)"
             )
-        name, total = reply["shm_name"], reply["size"]
+        total = reply["size"]
+        name = reply.get("shm_name")
+        if reply.get("spill_path"):
+            name = "spill:" + reply["spill_path"]
+        if name is None:
+            raise ObjectLostError(f"object {oid_b.hex()} has no readable location")
         if self.shm_store.is_local(name):
-            return name, total  # a copy already lives on this node
+            return name, total  # a copy (or local spill file) on this node
         pull_addr = reply.get("pull_addr")
         if not pull_addr:
             raise ObjectLostError(
@@ -934,6 +985,60 @@ class Worker:
         except RuntimeError:
             pass
 
+    # ------------------------------------------------------------- spilling
+    def _spill_bytes(self, need: int):
+        """Move the oldest live slices of this process to disk until `need`
+        bytes are freed (plus a batch margin), keeping the arena footprint
+        inside the budget (LocalObjectManager spill analogue).  The head
+        arbitrates: a slice under zero-copy pins is relocated but its memory
+        reclaim is deferred to the last pin drop."""
+        try:
+            asyncio.get_running_loop()
+            return  # IO-loop context (pull imports): cannot block on RPCs
+        except RuntimeError:
+            pass
+        if self.head is None or self.head.closed:
+            return
+        spill_dir = os.path.join(self.session_dir, "spill", self.node_id)
+        os.makedirs(spill_dir, exist_ok=True)
+        target = max(need, self.shm_store.budget_bytes // 8)
+        freed = 0
+        for name, size, oid_b in self.shm_store.live_slices_oldest_first():
+            if freed >= target:
+                break
+            try:
+                mv = self.shm_store.open(name)
+            except Exception:
+                continue
+            path = os.path.join(spill_dir, f"{oid_b.hex()}.bin")
+            try:
+                with open(path, "wb") as f:
+                    f.write(mv)
+            except OSError:
+                mv.release()
+                return  # disk full: stop spilling
+            finally:
+                try:
+                    mv.release()
+                except Exception:
+                    pass
+            try:
+                reply = self.head_call("obj_spilled", oid=oid_b, path=path, size=size)
+            except Exception:
+                return
+            if not reply.get("found"):
+                # object already GC'd: drop the file, reclaim the slice
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                self.shm_store.free_local(name)
+                freed += size
+            elif reply.get("free_now"):
+                self.shm_store.free_local(name)
+                freed += size
+            # pinned: relocated but memory comes back later (pin drop)
+
     def _promote_nested(self, nested: List[bytes], depth: int = 0):
         """Nested refs to inline-only objects have no cluster-visible data
         (inline values never register at the head): spill them to shm and
@@ -948,7 +1053,9 @@ class Worker:
                 continue
             try:
                 if e.state == "packed":
-                    name, mv = self.shm_store.create_for_import(oid, len(e.packed))
+                    name, mv = self.shm_store.create_for_import(
+                        oid, len(e.packed), primary=True
+                    )
                     mv[:] = e.packed
                     mv.release()
                     size = len(e.packed)

@@ -69,6 +69,8 @@ class WorkerProcess:
                 self.worker.transit_done(spec["t"], spec["roids"])
             return value
         if "shm" in spec:
+            from .errors import StaleObjectError
+
             name = spec["shm"]
             if not self.worker.shm_store.is_local(name):
                 # arg lives on another node: pull it over (runs on the
@@ -76,7 +78,15 @@ class WorkerProcess:
                 name = self.worker.ensure_local_shm_blocking(
                     spec["oid"], name, spec.get("size", 0)
                 )
-            return self.worker.shm_store.get(name)
+            try:
+                return self.worker.shm_store.get(name)
+            except (StaleObjectError, FileNotFoundError):
+                # the slice moved since the spec was minted (GC+recycle or
+                # spill): re-resolve through the directory
+                name = self.worker.ensure_local_shm_blocking(
+                    spec["oid"], None, spec.get("size", 0)
+                )
+                return self.worker.shm_store.get(name)
         if "dev" in spec:
             oid = spec["dev"]
             if spec.get("owner") == self.sock_path and oid in self.worker.device_objects:
