@@ -64,6 +64,8 @@ class ActorHandle:
     def _submit(self, method: str, args, kwargs, opts: Dict[str, Any]):
         w = global_worker()
         merged = {"max_task_retries": self._max_task_retries, **opts}
+        if merged.get("num_returns") == "streaming":
+            return w.submit_streaming_actor_task(self._actor_id, method, args, kwargs, merged)
         refs = w.submit_actor_task(self._actor_id, method, args, kwargs, merged)
         return refs[0] if merged.get("num_returns", 1) == 1 else refs
 

@@ -55,6 +55,7 @@ class CAConfig:
     # --- tasks / actors ---
     default_max_retries: int = 3
     lineage_cap: int = 8192  # task specs kept for object reconstruction
+    streaming_backpressure: int = 8  # unconsumed items before a generator blocks
     default_actor_max_restarts: int = 0
     actor_restart_backoff_s: float = 0.2
     push_timeout_s: float = 60.0

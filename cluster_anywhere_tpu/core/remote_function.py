@@ -34,8 +34,8 @@ def _check_options(opts: Dict[str, Any]):
     if unknown:
         raise ValueError(f"unknown option(s): {sorted(unknown)}")
     nr = opts.get("num_returns")
-    if nr is not None and (not isinstance(nr, int) or nr < 1):
-        raise ValueError("num_returns must be a positive int")
+    if nr is not None and nr != "streaming" and (not isinstance(nr, int) or nr < 1):
+        raise ValueError('num_returns must be a positive int or "streaming"')
 
 
 def _normalize_pg(opts: Dict[str, Any]) -> Dict[str, Any]:
@@ -85,6 +85,8 @@ class RemoteFunction:
 
     def _remote(self, args, kwargs, opts):
         w = global_worker()
+        if opts.get("num_returns") == "streaming":
+            return w.submit_streaming_task(self._function, args, kwargs, _normalize_pg(opts))
         refs = w.submit_task(self._function, args, kwargs, _normalize_pg(opts))
         return refs[0] if opts.get("num_returns", 1) == 1 else refs
 

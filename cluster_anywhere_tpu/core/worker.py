@@ -329,6 +329,8 @@ class Worker:
         self.total_resources: Dict[str, float] = {}
         # in-flight node-to-node object pulls, deduped by oid
         self._pulls: Dict[bytes, asyncio.Future] = {}
+        # in-flight streaming generators (ObjectRefGenerator consumers)
+        self._streams: Dict[bytes, Any] = {}
         # lineage: task specs of submitted normal tasks, so a lost object can
         # be recomputed by re-executing its creating task (object_recovery_
         # manager.h).  Holding the original arg ObjectRefs here pins the
@@ -524,6 +526,7 @@ class Worker:
         self._connecting[addr] = fut
         try:
             conn = await connect_addr(addr)
+            conn.set_push_handler(self._on_peer_push)
             self._conns[addr] = conn
             fut.set_result(conn)
             return conn
@@ -532,6 +535,117 @@ class Worker:
             raise
         finally:
             del self._connecting[addr]
+
+    # -------------------------------------------------------------- streaming
+    async def _on_peer_push(self, msg):
+        """Unsolicited frames from direct worker connections: streamed
+        generator items (stream_item) land here in production order."""
+        if msg.get("m") != "stream_item":
+            return
+        st = self._streams.get(msg["task_id"])
+        if st is None:
+            return  # stream abandoned
+        idx = msg["idx"]
+        oid = ObjectID.for_return(st.task_id, idx)
+        self.reference_counter.add_owned(oid)
+        self._store_results([oid], [msg["res"]], st.addr or "")
+        st.on_item(idx)
+
+    def stream_ack(self, st) -> None:
+        """Consumer took one ref off the generator: advance the producer's
+        backpressure window (thread-safe)."""
+        def _send():
+            conn = self._conns.get(st.addr)
+            if conn is not None and not conn.closed:
+                try:
+                    conn.notify(
+                        "stream_ack",
+                        task_id=st.task_id.binary(),
+                        consumed=st.next_read,
+                    )
+                except Exception:
+                    pass
+
+        try:
+            self.loop.call_soon_threadsafe(_send)
+        except RuntimeError:
+            pass
+
+    def submit_streaming_task(self, fn, args, kwargs, opts: Dict[str, Any]):
+        """Submit a generator task; returns an ObjectRefGenerator
+        (_raylet.pyx ObjectRefGenerator analogue)."""
+        from .streaming import ObjectRefGenerator, StreamState
+
+        task_id = TaskID.for_normal_task(self.job_id)
+        st = StreamState(task_id)
+        self._streams[task_id.binary()] = st
+        fn_id, blob = self.fn_manager.export(fn)
+        self._pump_submit(
+            lambda: self._submit_stream(task_id, st, fn_id, blob, args, kwargs, opts, None)
+        )
+        return ObjectRefGenerator(self, st, self.client_id)
+
+    def submit_streaming_actor_task(self, actor_id: ActorID, method: str, args, kwargs, opts):
+        from .streaming import ObjectRefGenerator, StreamState
+
+        task_id = TaskID.for_actor_task(actor_id)
+        st = StreamState(task_id)
+        self._streams[task_id.binary()] = st
+        opts = dict(opts, method=method)
+        self._pump_submit(
+            lambda: self._submit_stream(
+                task_id, st, None, None, args, kwargs, opts, actor_id.hex()
+            )
+        )
+        return ObjectRefGenerator(self, st, self.client_id)
+
+    async def _submit_stream(self, task_id, st, fn_id, blob, args, kwargs, opts, actor_hex):
+        """Slow-path push of a streaming task (no retries: replaying a
+        partially consumed stream would duplicate side effects)."""
+        lease = None
+        pool = None
+        try:
+            if blob is not None:
+                await self.head.call("register_function", fn_id=fn_id, blob=blob)
+                self.fn_manager.mark_exported(fn_id)
+            specs, kwspecs = await self._build_args(args, kwargs)
+            if actor_hex is None:
+                pool = self._lease_pool(opts)
+                lease = await pool.acquire()
+                addr = lease.addr
+            else:
+                addr = await self._actor_addr(actor_hex)
+            st.addr = addr
+            conn = await self.conn_to(addr)
+            fields = dict(
+                task_id=task_id.binary(),
+                owner=self.client_id,
+                args=specs,
+                kwargs=kwspecs,
+                num_returns="streaming",
+                timeout=None,
+            )
+            if actor_hex is None:
+                reply = await conn.call(
+                    "push_task", fn_id=fn_id,
+                    runtime_env=opts.get("runtime_env"), **fields,
+                )
+            else:
+                reply = await conn.call(
+                    "actor_call", actor_id=actor_hex, method=opts["method"], **fields
+                )
+            err = None
+            if reply.get("stream_error") is not None:
+                import pickle
+
+                err = pickle.loads(reply["stream_error"])
+            st.on_end(err)
+        except BaseException as e:
+            st.on_end(e if isinstance(e, CAError) else TaskError(repr(e)))
+        finally:
+            if lease is not None:
+                pool.release(lease, dead=False)
+            self._streams.pop(task_id.binary(), None)
 
     # ------------------------------------------------------------------ put
     def new_owned_ref(self) -> ObjectRef:

@@ -55,6 +55,9 @@ class WorkerProcess:
         )
         self.actor: Optional[ActorContext] = None
         self._exiting = False
+        # producer-side backpressure state per streaming task:
+        # task_id -> {"acked": int, "event": threading.Event}
+        self._streams: Dict[bytes, dict] = {}
         # task events buffered here, flushed to the head by the heartbeat loop
         # (analogue of core_worker/task_event_buffer.h -> GcsTaskManager)
         self._task_events: List[dict] = []
@@ -274,6 +277,63 @@ class WorkerProcess:
             )
             return self._error_results(num_returns, e)
 
+    # ------------------------------------------------------------- streaming
+    def _exec_streaming(self, fn, msg, writer, actor_id: Optional[str]):
+        """Run a generator task on the executor thread, streaming each yield
+        to the submitter with bounded unconsumed items (generator_waiter.h
+        backpressure).  Returns the frames-level terminal reply fields."""
+        import time as _time
+
+        task_id = msg.get("task_id") or os.urandom(16)
+        owner = msg.get("owner", "")
+        limit = self.config.streaming_backpressure
+        stream = {"acked": 0, "event": threading.Event()}
+        self._streams[task_id] = stream
+        t0 = _time.time()
+        idx = 0
+        try:
+            args, kwargs = self._resolve_args(msg["args"], msg.get("kwargs"))
+            w = self.worker
+            w.current_task_id = TaskID(task_id)
+            try:
+                gen = fn(*args, **kwargs)
+                for item in gen:
+                    # backpressure: wait for the consumer before running ahead
+                    while idx - stream["acked"] >= limit:
+                        stream["event"].clear()
+                        if not stream["event"].wait(self.config.push_timeout_s):
+                            raise TaskError(
+                                "streaming consumer stalled past the timeout"
+                            )
+                    res = self._package_result(
+                        ObjectID.for_return(TaskID(task_id), idx).binary(), item, owner
+                    )
+
+                    def _push(res=res, i=idx):
+                        write_frame(
+                            writer,
+                            {"m": "stream_item", "task_id": task_id, "idx": i, "res": res},
+                        )
+
+                    self.loop.call_soon_threadsafe(_push)
+                    idx += 1
+            finally:
+                w.current_task_id = None
+            self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, True)
+            return {"results": [], "stream_end": True, "count": idx}
+        except BaseException as e:
+            self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, False)
+            err = self._error_results(1, e)[0]["e"]
+            return {"results": [], "stream_end": True, "count": idx, "stream_error": err}
+        finally:
+            self._streams.pop(task_id, None)
+
+    def _h_stream_ack(self, msg):
+        stream = self._streams.get(msg["task_id"])
+        if stream is not None:
+            stream["acked"] = max(stream["acked"], msg["consumed"])
+            stream["event"].set()
+
     # --------------------------------------------------------------- handlers
     def _fast_handle(self, state, msg, writer) -> bool:
         """Synchronous hot path run directly in the server read loop: execute
@@ -282,6 +342,8 @@ class WorkerProcess:
         Returns False to fall back to the general async handler (async
         methods, uncached functions, control RPCs)."""
         m = msg.get("m")
+        if msg.get("num_returns") == "streaming":
+            return False  # generator tasks take the streaming path
         if m == "actor_call":
             ctx = self.actor
             if ctx is None or ctx.actor_id != msg.get("actor_id"):
@@ -301,6 +363,9 @@ class WorkerProcess:
             self._submit_fast(
                 fn, msg, writer, None, "task", getattr(fn, "__name__", "task")
             )
+            return True
+        if m == "stream_ack":
+            self._h_stream_ack(msg)
             return True
         return False
 
@@ -349,7 +414,17 @@ class WorkerProcess:
 
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
-        if m == "push_task":
+        if msg.get("num_returns") == "streaming" and m in ("push_task", "actor_call"):
+            fn = await self._resolve_callable(msg, is_actor_call=(m == "actor_call"))
+            if isinstance(fn, dict):  # resolution error -> terminal reply
+                reply(**fn)
+                return
+            out = await self.loop.run_in_executor(
+                self.executor, self._exec_streaming, fn, msg, state["writer"],
+                msg.get("actor_id"),
+            )
+            reply(**out)
+        elif m == "push_task":
             results = await self._execute(msg, is_actor_call=False)
             reply(results=results)
         elif m == "actor_call":
@@ -357,6 +432,8 @@ class WorkerProcess:
             reply(results=results)
             if self._exiting:
                 await self._graceful_exit()
+        elif m == "stream_ack":
+            self._h_stream_ack(msg)
         elif m == "spawn_actor":
             try:
                 await self._spawn_actor(msg)
@@ -377,6 +454,23 @@ class WorkerProcess:
             reply()
         else:
             reply_err(ValueError(f"unknown worker method {m}"))
+
+    async def _resolve_callable(self, msg, is_actor_call: bool):
+        """Resolve the task function / actor method for the streaming path.
+        Returns the callable, or a terminal-reply dict on failure."""
+        try:
+            if is_actor_call:
+                if self.actor is None or self.actor.actor_id != msg["actor_id"]:
+                    raise TaskError(f"actor {msg.get('actor_id')} not hosted here")
+                return getattr(self.actor.instance, msg["method"])
+            fn = self.worker.fn_manager.get(msg["fn_id"])
+            if fn is None:
+                reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
+                fn = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
+            return fn
+        except BaseException as e:
+            err = self._error_results(1, e)[0]["e"]
+            return {"results": [], "stream_end": True, "count": 0, "stream_error": err}
 
     async def _spawn_actor(self, msg):
         cls = self.worker.fn_manager.get(msg["fn_id"])

@@ -29,7 +29,7 @@ from .datasource import (
     TextDatasource,
 )
 from .iterator import DataIterator
-from .plan import LogicalPlan, Read
+from .plan import LogicalPlan, Read, ReadIterator
 
 
 def _from_source(source: Datasource, parallelism: int = -1) -> Dataset:
@@ -73,6 +73,13 @@ def from_arrow(tables) -> Dataset:
 
 def read_datasource(source: Datasource, *, parallelism: int = -1) -> Dataset:
     return _from_source(source, parallelism)
+
+
+def from_generator(gen_fn, *, rows_per_block: int = 256) -> Dataset:
+    """Dataset fed lazily by a python generator running as ONE streaming
+    remote task (num_returns="streaming"): blocks materialize with
+    producer-side backpressure as iter_batches consumes them."""
+    return Dataset(LogicalPlan([ReadIterator(gen_fn, rows_per_block)]))
 
 
 def read_parquet(paths, *, columns: Optional[List[str]] = None, parallelism: int = -1, **kw) -> Dataset:
@@ -119,6 +126,7 @@ __all__ = [
     "range",
     "range_tensor",
     "from_items",
+    "from_generator",
     "from_numpy",
     "from_pandas",
     "from_arrow",

@@ -23,7 +23,7 @@ import numpy as np
 
 from ..core import api as ca
 from .block import Block, BlockAccessor, build_block
-from .plan import AllToAll, InputData, Limit, LogicalPlan, MapLike, Read, UnionOp, ZipOp
+from .plan import AllToAll, InputData, Limit, LogicalPlan, MapLike, Read, ReadIterator, UnionOp, ZipOp
 
 
 class RefBundle:
@@ -70,6 +70,39 @@ def _apply_chain(chain: List[Dict[str, Any]], block: Block) -> Block:
     if not blocks:
         return []
     return BlockAccessor.concat(blocks)
+
+
+def _gen_blocks(gen_fn, rows_per_block: int):
+    """Streaming-read driver: run the user generator on a worker, batch its
+    rows into blocks, and yield (meta, block) pairs as streamed returns."""
+    import numpy as np
+
+    from .block import ITEM_COL, BlockAccessor, build_block
+
+    def emit(rows):
+        if rows and all(isinstance(r, dict) for r in rows):
+            keys = list(rows[0].keys())
+            if all(list(r.keys()) == keys for r in rows):
+                return build_block({k: np.asarray([r[k] for r in rows]) for k in keys})
+        try:
+            return build_block({ITEM_COL: np.asarray(rows)})
+        except Exception:
+            return rows
+
+    buf: List[Any] = []
+    for row in gen_fn():
+        buf.append(row)
+        if len(buf) >= rows_per_block:
+            block = emit(buf)
+            acc = BlockAccessor.for_block(block)
+            yield {"num_rows": acc.num_rows(), "size_bytes": acc.size_bytes()}
+            yield block
+            buf = []
+    if buf:
+        block = emit(buf)
+        acc = BlockAccessor.for_block(block)
+        yield {"num_rows": acc.num_rows(), "size_bytes": acc.size_bytes()}
+        yield block
 
 
 def _read_and_map(read_task, chain: List[Dict[str, Any]]):
@@ -150,6 +183,9 @@ class StreamingExecutor:
                 chain, i2 = self._collect_chain(ops, i)
                 segments.append(self._map_segment(chain))
                 i = i2
+            elif isinstance(op, ReadIterator):
+                segments.append(self._iterator_segment(op))
+                i += 1
             elif isinstance(op, AllToAll):
                 segments.append(self._all_to_all_segment(op))
                 i += 1
@@ -217,6 +253,28 @@ class StreamingExecutor:
             inner = run
             actor_seg = self._map_segment(actor_ops)
             return lambda stream: actor_seg(inner(stream))
+        return run
+
+    def _iterator_segment(self, op) -> Callable:
+        """Streaming-generator source: ONE remote task yields blocks with
+        producer-side backpressure; the consumer pulls them through
+        iter_batches at its own pace (ObjectRefGenerator wiring)."""
+
+        def run(_: Iterator[RefBundle]) -> Iterator[RefBundle]:
+            t0 = time.monotonic()
+            gen = ca.remote(_gen_blocks).options(num_returns="streaming").remote(
+                op.gen_fn, op.rows_per_block
+            )
+            rows = nblocks = 0
+            it = iter(gen)
+            for meta_ref in it:
+                block_ref = next(it)
+                meta = ca.get(meta_ref)
+                rows += meta["num_rows"]
+                nblocks += 1
+                yield RefBundle(block_ref, meta["num_rows"], meta["size_bytes"])
+            self.stats.add(op.name, time.monotonic() - t0, nblocks, rows)
+
         return run
 
     def _map_segment(self, chain: List[MapLike]) -> Callable:

@@ -94,6 +94,17 @@ class InputData(LogicalOp):
     name = "input"
 
 
+@dataclass
+class ReadIterator(LogicalOp):
+    """Blocks produced lazily by ONE remote generator task with streaming
+    returns: end-to-end backpressure from iter_batches down to the producing
+    python generator (num_returns='streaming')."""
+
+    gen_fn: Any  # picklable generator function yielding rows or dict batches
+    rows_per_block: int = 256
+    name = "ReadIterator"
+
+
 class LogicalPlan:
     def __init__(self, ops: List[LogicalOp]):
         self.ops = ops

@@ -133,6 +133,41 @@ class _InferenceWorker:
         result["generated_text"] = np.asarray(texts, dtype=object)
         return result
 
+    def stream(
+        self,
+        prompt: str,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+    ):
+        """Token-by-token decoding of one prompt; a generator meant to run as
+        a num_returns="streaming" actor call, so clients receive tokens as
+        they are sampled (the streaming-decode path of the reference's serve
+        LLM engines)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import stream_generate
+
+        cfg = self.cfg
+        max_new_tokens = cfg.max_new_tokens if max_new_tokens is None else max_new_tokens
+        encoded = self.tok.encode(prompt)[: cfg.max_prompt_len]
+        ids = np.full((1, cfg.max_prompt_len), self.tok.pad_id, np.int32)
+        ids[0, cfg.max_prompt_len - len(encoded):] = encoded
+        self._step += 1
+        for tok in stream_generate(
+            self.params,
+            jnp.asarray(ids),
+            jax.random.key(cfg.model.seed * 1000003 + self._step),
+            cfg=self.tcfg,
+            max_new_tokens=max_new_tokens,
+            temperature=cfg.temperature if temperature is None else temperature,
+            top_k=cfg.top_k if top_k is None else top_k,
+            prompt_lens=jnp.asarray([len(encoded)], np.int32),
+        ):
+            tid = int(tok[0])
+            yield {"token_id": tid, "text": self.tok.decode([tid])}
+
 
 class Processor:
     """Callable dataset -> dataset pipeline."""

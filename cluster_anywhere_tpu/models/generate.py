@@ -208,3 +208,52 @@ def generate(
     # tokens: the N-1 follow-on samples; prepend the prefill sample
     out = jnp.concatenate([first[None], tokens], axis=0)
     return out.T  # [B, N]
+
+
+@functools.lru_cache(maxsize=8)
+def _stream_fns(cfg: TransformerConfig, t_prompt: int, t_max: int, temperature: float, top_k: int):
+    """Jitted prefill+sample and single-decode-step closures for streaming
+    decoding (compiled once per shape/config)."""
+
+    def _prefill(params, ids, pad, rng):
+        logits, cache = prefill(params, ids, cfg, t_max, pad)
+        return _sample(logits, rng, temperature, top_k), cache
+
+    def _step(params, cache, token, pos, pad, rng):
+        logits, cache = decode_one(params, cache, token, pos, cfg, pad)
+        return _sample(logits, rng, temperature, top_k), cache
+
+    return jax.jit(_prefill), jax.jit(_step)
+
+
+def stream_generate(
+    params,
+    prompt_ids,
+    rng,
+    *,
+    cfg: TransformerConfig,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    prompt_lens: Optional[jax.Array] = None,
+):
+    """Python generator yielding one [B] int32 token array per decode step.
+
+    The interactive/streaming counterpart of generate(): a host loop over a
+    jitted single decode step, so each token is observable as soon as it is
+    sampled (wired to num_returns="streaming" actor methods by the LLM
+    layer).  generate()'s scanned loop remains the throughput path."""
+    import numpy as np
+
+    b, t_prompt = prompt_ids.shape
+    t_max = t_prompt + max_new_tokens
+    pad = None if prompt_lens is None else (t_prompt - prompt_lens).astype(jnp.int32)
+    pre, step = _stream_fns(cfg, t_prompt, t_max, float(temperature), int(top_k))
+    rngs = jax.random.split(rng, max_new_tokens)
+    token, cache = pre(params, prompt_ids, pad, rngs[0])
+    yield np.asarray(token)
+    pos = t_prompt
+    for i in range(1, max_new_tokens):
+        token, cache = step(params, cache, token, jnp.int32(pos), pad, rngs[i])
+        pos += 1
+        yield np.asarray(token)
