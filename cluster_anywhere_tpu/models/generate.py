@@ -110,20 +110,14 @@ def _prefill_block(bp, x, pad, cfg: TransformerConfig, t_max: int):
     k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
     v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
     # causal attention within the prompt (q already has full heads; only
-    # k/v need the GQA repeat)
-    qr = q
+    # k/v need the GQA repeat).  Dispatches to the pad-masked Pallas flash
+    # kernel on TPU when the prompt tiles (ops/attention.py), so long-prompt
+    # prefill never materializes the [T, T] score matrix.
+    from ..ops.attention import attention as _attn
+
     kr = _gqa_repeat(k, cfg)
     vr = _gqa_repeat(v, cfg)
-    scale = cfg.d_head ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qr.astype(jnp.float32), kr.astype(jnp.float32)) * scale
-    mask = jnp.tril(jnp.ones((t, t), bool))[None, None]  # [1,1,T,T]
-    if pad is not None:
-        key_ok = jnp.arange(t)[None, :] >= pad[:, None]  # [B,T]
-        mask = mask & key_ok[:, None, None, :]
-    logits = jnp.where(mask, logits, -1e30)
-    attn = jnp.einsum(
-        "bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1).astype(x.dtype), vr
-    ).reshape(b, t, -1)
+    attn = _attn(q, kr, vr, causal=True, pad=pad).reshape(b, t, -1).astype(x.dtype)
     x = x + attn @ bp["wo"].astype(x.dtype)
     return _mlp(bp, x, cfg), (k_cache, v_cache)
 

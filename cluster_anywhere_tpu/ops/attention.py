@@ -49,7 +49,13 @@ def _platform() -> str:
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_k, has_pad):
+    if has_pad:
+        pad_ref, o_ref, lse_ref = refs
+        pad_val = pad_ref[0]
+    else:
+        (o_ref, lse_ref) = refs
+        pad_val = None
     block_q, d = q_ref.shape
     t_kv = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -79,18 +85,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
             )
             * scale
         )
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
+        if causal or has_pad:
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            ok = None
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                ok = q_pos >= k_pos
+            if has_pad:
+                # left-padded rows: keys before pad_val are pad tokens
+                k_ok = k_pos >= pad_val
+                ok = k_ok if ok is None else (ok & k_ok)
+            s = jnp.where(ok, s, NEG_INF)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
-        if causal:
+        if causal or has_pad:
             p = jnp.where(s <= NEG_INF, 0.0, p)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
@@ -113,8 +126,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal, block_k
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs, scale, causal, block_k, has_pad
 ):
+    if has_pad:
+        pad_ref, dq_ref = refs
+        pad_val = pad_ref[0]
+    else:
+        (dq_ref,) = refs
+        pad_val = None
     block_q, d = q_ref.shape
     t_kv = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -141,15 +160,23 @@ def _bwd_dq_kernel(
             )
             * scale
         )
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
+        if causal or has_pad:
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            ok = None
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                ok = q_pos >= k_pos
+            if has_pad:
+                k_ok = k_pos >= pad_val
+                ok = k_ok if ok is None else (ok & k_ok)
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
+        if causal or has_pad:
+            p = jnp.where(s <= NEG_INF, 0.0, p)
         dp = lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -163,8 +190,14 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, block_q
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs, scale, causal, block_q, has_pad
 ):
+    if has_pad:
+        pad_ref, dk_ref, dv_ref = refs
+        pad_val = pad_ref[0]
+    else:
+        dk_ref, dv_ref = refs
+        pad_val = None
     block_k, d = k_ref.shape
     t_q = q_ref.shape[0]
     ki = pl.program_id(1)
@@ -190,15 +223,23 @@ def _bwd_dkv_kernel(
             )
             * scale
         )
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
+        if causal or has_pad:
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            ok = None
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                ok = q_pos >= k_pos
+            if has_pad:
+                k_ok = k_pos >= pad_val
+                ok = k_ok if ok is None else (ok & k_ok)
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse_blk[:, None])  # [bq, bk]
+        if causal or has_pad:
+            p = jnp.where(s <= NEG_INF, 0.0, p)
         dv_new = dv + lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -234,23 +275,35 @@ def _from_bhtd(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+def _pad_bh(pad, h):
+    """[B] per-row left-pad counts -> [B*H, 1] int32 (one scalar per grid
+    row, matching the B*H-flattened kernel grid)."""
+    return jnp.repeat(pad.astype(jnp.int32), h)[:, None]
+
+
+def _fwd_impl(q, k, v, pad, causal, scale, block_q, block_k, interpret):
     b, t, h, d = q.shape
     t_kv = k.shape[1]
     qf, kf, vf = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
     bh = b * h
     nq = t // block_q
     grid = (bh, nq)
+    has_pad = pad is not None
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
+        pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
+        pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
+    ]
+    args = [qf, kf, vf]
+    if has_pad:
+        in_specs.append(pl.BlockSpec((None, 1), lambda bi, qi: (bi, 0)))
+        args.append(_pad_bh(pad, h))
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, block_k=block_k
+            _fwd_kernel, scale=scale, causal=causal, block_k=block_k, has_pad=has_pad
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
-            pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
-            pl.BlockSpec((None, t_kv, d), lambda bi, qi: (bi, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
             # (1, t) full-row blocks: TPU lowering requires the last two block
@@ -262,11 +315,11 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return _from_bhtd(out, b, h), lse.reshape(b, h, t)
 
 
-def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret, dlse=None):
+def _bwd_impl(q, k, v, o, lse, do, pad, causal, scale, block_q, block_k, interpret, dlse=None):
     b, t, h, d = q.shape
     t_kv = k.shape[1]
     qf, kf, vf = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
@@ -279,10 +332,13 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret, d
     if dlse is not None:
         delta = delta - dlse.reshape(bh, t).astype(jnp.float32)
     delta = delta.reshape(bh, 1, t)
+    has_pad = pad is not None
+    pad_arg = [_pad_bh(pad, h)] if has_pad else []
+    pad_spec = [pl.BlockSpec((None, 1), lambda bi, qi: (bi, 0))] if has_pad else []
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, block_k=block_k
+            _bwd_dq_kernel, scale=scale, causal=causal, block_k=block_k, has_pad=has_pad
         ),
         grid=(bh, t // block_q),
         in_specs=[
@@ -292,15 +348,15 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret, d
             pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
             pl.BlockSpec((None, 1, t), lambda bi, qi: (bi, 0, 0)),
             pl.BlockSpec((None, 1, t), lambda bi, qi: (bi, 0, 0)),
-        ],
+        ] + pad_spec,
         out_specs=pl.BlockSpec((None, block_q, d), lambda bi, qi: (bi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lsef, delta, *pad_arg)
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, has_pad=has_pad
         ),
         grid=(bh, t_kv // block_k),
         in_specs=[
@@ -310,7 +366,7 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret, d
             pl.BlockSpec((None, t, d), lambda bi, ki: (bi, 0, 0)),
             pl.BlockSpec((None, 1, t), lambda bi, ki: (bi, 0, 0)),
             pl.BlockSpec((None, 1, t), lambda bi, ki: (bi, 0, 0)),
-        ],
+        ] + pad_spec,
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda bi, ki: (bi, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda bi, ki: (bi, ki, 0)),
@@ -320,53 +376,53 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret, d
             jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lsef, delta, *pad_arg)
     return _from_bhtd(dq, b, h), _from_bhtd(dk, b, h), _from_bhtd(dv, b, h)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, pad, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd_impl(q, k, v, pad, causal, scale, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, pad, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd_impl(q, k, v, pad, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, pad, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
+    q, k, v, pad, out, lse = res
     dq, dk, dv = _bwd_impl(
-        q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret
+        q, k, v, out, lse, do, pad, causal, scale, block_q, block_k, interpret
     )
-    return dq, dk, dv
+    return dq, dk, dv, None  # pad is integer-valued: no cotangent
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_with_lse(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_with_lse(q, k, v, pad, causal, scale, block_q, block_k, interpret):
+    return _fwd_impl(q, k, v, pad, causal, scale, block_q, block_k, interpret)
 
 
-def _flash_with_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
-    return (out, lse), (q, k, v, out, lse)
+def _flash_with_lse_fwd(q, k, v, pad, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd_impl(q, k, v, pad, causal, scale, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, pad, out, lse)
 
 
 def _flash_with_lse_bwd(causal, scale, block_q, block_k, interpret, res, cts):
     """Cotangent of lse folds into the delta term: d(lse)/ds = p per row, so
     ds = p*(dp - delta + dlse) — pass (delta - dlse) where the kernels expect
     delta (the ring merge differentiates through lse)."""
-    q, k, v, out, lse = res
+    q, k, v, pad, out, lse = res
     do, dlse = cts
     dq, dk, dv = _bwd_impl(
-        q, k, v, out, lse, do, causal, scale, block_q, block_k, interpret,
+        q, k, v, out, lse, do, pad, causal, scale, block_q, block_k, interpret,
         dlse=dlse,
     )
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
@@ -379,12 +435,17 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
+    pad: Optional[jax.Array] = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
 ):
     """Pallas flash attention.  q: [B, T, H, D]; k, v: [B, T_kv, H, D].
+
+    pad: optional [B] int32 per-row LEFT-pad counts — keys at positions
+    < pad[b] are masked out (the left-padded-prompt mask the LLM prefill
+    needs; models/generate.py _prefill_block).
 
     Requires T % block_q == 0 and T_kv % block_k == 0 (the dispatcher
     `attention()` falls back to the jnp reference otherwise).  With
@@ -398,8 +459,8 @@ def flash_attention(
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
     if return_lse:
-        return _flash_with_lse(q, k, v, causal, scale, block_q, block_k, interpret)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+        return _flash_with_lse(q, k, v, pad, causal, scale, block_q, block_k, interpret)
+    return _flash(q, k, v, pad, causal, scale, block_q, block_k, interpret)
 
 
 def merge_attention(o1, lse1, o2, lse2):
@@ -421,8 +482,9 @@ def merge_attention(o1, lse1, o2, lse2):
     return o, jnp.where(tot == 0.0, NEG_INF, lse)
 
 
-def reference_attention(q, k, v, causal=True, scale=None):
-    """Dense jnp attention (fallback + test oracle): [B,T,H,D] -> [B,T,H,D]."""
+def reference_attention(q, k, v, causal=True, scale=None, pad=None):
+    """Dense jnp attention (fallback + test oracle): [B,T,H,D] -> [B,T,H,D].
+    pad: optional [B] left-pad counts (keys < pad[b] masked)."""
     d = q.shape[-1]
     if scale is None:
         scale = d ** -0.5
@@ -430,15 +492,20 @@ def reference_attention(q, k, v, causal=True, scale=None):
         jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
         * scale
     )
+    t_q, t_k = s.shape[-2], s.shape[-1]
+    mask = None
     if causal:
-        t_q, t_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))[None, None]
+    if pad is not None:
+        key_ok = (jnp.arange(t_k)[None, :] >= pad[:, None])[:, None, None, :]
+        mask = key_ok if mask is None else (mask & key_ok)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+def attention(q, k, v, causal: bool = True, scale: Optional[float] = None, pad=None):
     """Dispatcher: Pallas flash kernel on TPU when shapes tile cleanly, else
     the jnp reference (XLA still fuses that well on CPU test meshes)."""
     t, t_kv = q.shape[1], k.shape[1]
@@ -451,5 +518,5 @@ def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
         and t_kv >= 128
     )
     if use_flash:
-        return flash_attention(q, k, v, causal=causal, scale=scale)
-    return reference_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale, pad=pad)
+    return reference_attention(q, k, v, causal=causal, scale=scale, pad=pad)

@@ -14,17 +14,21 @@ import functools
 import jax
 from jax import lax
 
-from .ring_attention import reference_attention
+from ..ops.attention import attention as _dispatch_attention
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True, attn_fn=None):
     """Call inside shard_map. q,k,v: [B, T_local, H, D] (heads complete,
-    sequence sharded). Requires H % sp == 0."""
+    sequence sharded). Requires H % sp == 0.
+
+    Default attention over the gathered full sequence goes through the
+    dispatcher: the Pallas flash kernel on TPU whenever the (full) sequence
+    tiles, jnp reference otherwise."""
     n = lax.psum(1, axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(f"heads {q.shape[2]} not divisible by {axis_name}={n}")
     if attn_fn is None:
-        attn_fn = functools.partial(reference_attention, causal=causal)
+        attn_fn = functools.partial(_dispatch_attention, causal=causal)
 
     def scatter_heads(x):
         # [B, T/sp, H, D] -> [B, T, H/sp, D]

@@ -103,3 +103,74 @@ def test_flash_bf16_inputs():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
     )
+
+
+def test_flash_pad_mask_matches_reference():
+    """Pad-masked flash kernel (interpret mode) vs the dense masked oracle:
+    forward and gradients, left-padded rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_anywhere_tpu.ops.attention import flash_attention, reference_attention
+
+    b, t, h, d = 2, 32, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, t, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, t, h, d), jnp.float32)
+    pad = jnp.asarray([5, 0], jnp.int32)  # row 0 left-padded by 5
+
+    got = flash_attention(q, k, v, causal=True, pad=pad, block_q=8, block_k=8, interpret=True)
+    want = reference_attention(q, k, v, causal=True, pad=pad)
+    # pad-query rows (positions < pad) are undefined garbage in both paths;
+    # compare real rows only
+    import numpy as np
+
+    for row, p in enumerate([5, 0]):
+        np.testing.assert_allclose(
+            np.asarray(got[row, p:]), np.asarray(want[row, p:]), atol=2e-5, rtol=2e-5
+        )
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=True, pad=pad, block_q=8, block_k=8, interpret=True)
+        return (out[0, 5:].astype(jnp.float32) ** 2).sum() + (
+            out[1].astype(jnp.float32) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        out = reference_attention(q, k, v, causal=True, pad=pad)
+        return (out[0, 5:].astype(jnp.float32) ** 2).sum() + (
+            out[1].astype(jnp.float32) ** 2
+        ).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4, rtol=5e-4)
+
+
+def test_prefill_uses_pad_dispatcher():
+    """LLM prefill produces identical logits whether prompts are left-padded
+    or not (the pad mask flows through the attention dispatcher)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cluster_anywhere_tpu.models.generate import prefill
+    from cluster_anywhere_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2, d_head=16,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = np.array([3, 9, 27, 11, 5], np.int32)
+    # unpadded: [1, 5]; padded: [1, 8] with 3 left pads
+    logits_a, _ = prefill(params, jnp.asarray(toks[None]), cfg, 16, None)
+    padded = np.concatenate([np.zeros(3, np.int32), toks])[None]
+    logits_b, _ = prefill(
+        params, jnp.asarray(padded), cfg, 16, jnp.asarray([3], jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_a[0]), np.asarray(logits_b[0]), atol=1e-4, rtol=1e-4
+    )
