@@ -1,6 +1,6 @@
 """cluster_anywhere_tpu.rl: reinforcement learning on the actor runtime
 (compact analogue of the reference's RLlib, rllib/ — Algorithm/
-AlgorithmConfig, EnvRunner actors, jax Learners; PPO + DQN).
+AlgorithmConfig, EnvRunner actors, jax Learners; PPO + DQN + IMPALA).
 
     from cluster_anywhere_tpu import rl
     algo = rl.AlgorithmConfig("PPO").environment("CartPole-v1").env_runners(2).build()
@@ -12,7 +12,7 @@ from .algorithm import Algorithm, AlgorithmConfig
 from .buffer import ReplayBuffer
 from .env import CartPole, Env, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
-from .learner import DQNLearner, PPOLearner, compute_gae
+from .learner import DQNLearner, IMPALALearner, PPOLearner, compute_gae
 from .module import DiscretePolicyModule, QModule
 
 __all__ = [
@@ -26,6 +26,7 @@ __all__ = [
     "EnvRunner",
     "PPOLearner",
     "DQNLearner",
+    "IMPALALearner",
     "compute_gae",
     "ReplayBuffer",
     "DiscretePolicyModule",

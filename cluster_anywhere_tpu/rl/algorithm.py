@@ -15,7 +15,7 @@ from ..core import api as ca
 from ..core.actor import kill
 from .env import make_env
 from .env_runner import EnvRunner
-from .learner import DQNLearner, PPOLearner, compute_gae
+from .learner import DQNLearner, IMPALALearner, PPOLearner, compute_gae
 from .module import DiscretePolicyModule, QModule
 
 
@@ -69,7 +69,7 @@ class Algorithm:
         self.config = config
         probe = make_env(config.env)
         obs_dim, num_actions = probe.observation_dim, probe.num_actions
-        kind = "policy" if config.algo == "PPO" else "q"
+        kind = "policy" if config.algo in ("PPO", "IMPALA") else "q"
         module_spec = {
             "kind": kind,
             "obs_dim": obs_dim,
@@ -87,6 +87,16 @@ class Algorithm:
                 minibatches=config.minibatches,
                 seed=config.seed,
             )
+        elif config.algo == "IMPALA":
+            self.module = DiscretePolicyModule(obs_dim, num_actions, config.hidden)
+            self.learner = IMPALALearner(
+                self.module,
+                lr=config.lr,
+                gamma=config.gamma,
+                entropy_coeff=config.entropy_coeff,
+                seed=config.seed,
+            )
+            self._pending: Dict[Any, int] = {}  # in-flight sample ref -> runner idx
         elif config.algo == "DQN":
             from .buffer import ReplayBuffer
 
@@ -130,8 +140,53 @@ class Algorithm:
         eps = getattr(self, "epsilon", None)
         ca.get([r.set_weights.remote(self.learner.get_weights(), eps) for r in self.runners])
 
+    def _train_impala(self) -> Dict[str, Any]:
+        """One IMPALA iteration: consume one rollout per runner AS THEY
+        ARRIVE (actor-learner decoupling — runners keep sampling with lagged
+        weights; V-trace corrects), update after each, resubmit immediately
+        with fresh weights.  Reference rllib/algorithms/impala/ async mode."""
+        cfg = self.config
+        t0 = time.monotonic()
+        if not self._pending:
+            self._pending = {
+                r.sample.remote(cfg.rollout_length): i
+                for i, r in enumerate(self.runners)
+            }
+        stats: Dict[str, Any] = {}
+        episodes, ep_returns = 0, []
+        for _ in range(len(self.runners)):
+            ready, _ = ca.wait(list(self._pending), num_returns=1, timeout=120)
+            ref = ready[0]
+            idx = self._pending.pop(ref)
+            ro = ca.get(ref)
+            m = ro.pop("metrics")
+            episodes += m.get("episodes", 0)
+            if "episode_return_mean" in m:
+                ep_returns.append(m["episode_return_mean"])
+            stats = self.learner.update(ro)
+            runner = self.runners[idx]
+            runner.set_weights.remote(self.learner.get_weights(), None)
+            self._pending[runner.sample.remote(cfg.rollout_length)] = idx
+        self.iteration += 1
+        out = dict(stats)
+        out.update(
+            {
+                "training_iteration": self.iteration,
+                "episodes_this_iter": episodes,
+                "env_steps_this_iter": cfg.rollout_length
+                * cfg.num_envs_per_runner
+                * cfg.num_env_runners,
+                "time_this_iter_s": time.monotonic() - t0,
+            }
+        )
+        if ep_returns:
+            out["episode_return_mean"] = float(np.mean(ep_returns))
+        return out
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
+        if cfg.algo == "IMPALA":
+            return self._train_impala()
         t0 = time.monotonic()
         rollouts = ca.get(
             [r.sample.remote(cfg.rollout_length) for r in self.runners]

@@ -150,6 +150,106 @@ class DQNLearner:
         return {"loss": float(loss)}
 
 
+class IMPALALearner:
+    """V-trace actor-critic (reference rllib/algorithms/impala/): rollouts
+    arrive from asynchronously sampling runners whose policies lag the
+    learner; importance-weighted V-trace targets correct the off-policy gap.
+    The whole update — target logp/value forward pass, reverse-scan V-trace,
+    policy-gradient + value + entropy losses — is one jitted XLA program."""
+
+    def __init__(
+        self,
+        module,
+        *,
+        lr: float = 3e-4,
+        gamma: float = 0.99,
+        vf_coeff: float = 0.5,
+        entropy_coeff: float = 0.01,
+        rho_clip: float = 1.0,
+        c_clip: float = 1.0,
+        seed: int = 0,
+    ):
+        import optax
+
+        self.module = module
+        self.opt = optax.adam(lr)
+        self.params = module.init(jax.random.key(seed))
+        self.opt_state = self.opt.init(self.params)
+
+        def loss_fn(params, batch):
+            # batch arrays are [T, N, ...] time-major; bootstrap obs [N, ...]
+            obs, actions = batch["obs"], batch["actions"]
+            T, N = actions.shape
+            flat_obs = obs.reshape(T * N, -1)
+            logits = module.logits(params, flat_obs).reshape(T, N, -1)
+            values = module.value(params, flat_obs).reshape(T, N)
+            boot_value = module.value(params, batch["next_obs"])  # [N]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, actions[..., None], -1)[..., 0]
+            rho = jnp.exp(logp - batch["logp"])
+            rho_bar = jnp.minimum(rho, rho_clip)
+            c_bar = jnp.minimum(rho, c_clip)
+            discounts = gamma * (1.0 - batch["dones"])
+            next_values = jnp.concatenate([values[1:], boot_value[None]], axis=0)
+            deltas = rho_bar * (batch["rewards"] + discounts * next_values - values)
+
+            def scan_fn(acc, xs):
+                delta_t, disc_t, c_t = xs
+                acc = delta_t + disc_t * c_t * acc
+                return acc, acc
+
+            _, vs_minus_v = jax.lax.scan(
+                scan_fn,
+                jnp.zeros((N,), jnp.float32),
+                (deltas, discounts, c_bar),
+                reverse=True,
+            )
+            vs = vs_minus_v + values
+            next_vs = jnp.concatenate([vs[1:], boot_value[None]], axis=0)
+            pg_adv = rho_bar * (batch["rewards"] + discounts * next_vs - values)
+            pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+            vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+            return total, {
+                "pi_loss": pg_loss,
+                "vf_loss": vf_loss,
+                "entropy": entropy,
+                "mean_rho": jnp.mean(rho),
+            }
+
+        def update_step(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update_step)
+
+    def get_weights(self):
+        return self.params
+
+    def update(self, rollout: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """rollout: time-major [T, N] arrays obs/actions/rewards/dones/logp
+        plus bootstrap next_obs [N]."""
+        jb = {
+            "obs": jnp.asarray(rollout["obs"], jnp.float32),
+            "actions": jnp.asarray(rollout["actions"], jnp.int32),
+            "rewards": jnp.asarray(rollout["rewards"], jnp.float32),
+            "dones": jnp.asarray(rollout["dones"], jnp.float32),
+            "logp": jnp.asarray(rollout["logp"], jnp.float32),
+            "next_obs": jnp.asarray(rollout["next_obs"], jnp.float32),
+        }
+        self.params, self.opt_state, loss, aux = self._update(
+            self.params, self.opt_state, jb
+        )
+        out = {"loss": float(loss)}
+        out.update({k: float(v) for k, v in aux.items()})
+        return out
+
+
 def compute_gae(rollout: Dict[str, np.ndarray], gamma: float, lam: float):
     """rollout arrays [T, N]; returns flat advantages/returns [T*N]."""
     rewards, values, dones = rollout["rewards"], rollout["values"], rollout["dones"]
