@@ -162,6 +162,20 @@ def cmd_metrics(args):
     ca.shutdown()
 
 
+def cmd_dashboard(args):
+    """Print the running cluster's dashboard URL."""
+    import os
+
+    from cluster_anywhere_tpu.core.api import _find_session
+    from cluster_anywhere_tpu.core.config import get_config
+
+    sdir = _find_session(args.address or "auto", get_config().session_dir_root)
+    path = os.path.join(sdir, "dashboard.addr")
+    if not os.path.exists(path):
+        raise SystemExit("no dashboard.addr in the session (head predates it?)")
+    print(open(path).read().strip())
+
+
 def cmd_microbenchmark(args):
     """Single-node microbenchmarks (reference _private/ray_perf.py main)."""
     import cluster_anywhere_tpu as ca
@@ -271,6 +285,10 @@ def main(argv=None):
     sp = sub.add_parser("metrics", help="Prometheus metrics snapshot")
     addr(sp)
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("dashboard", help="print the dashboard URL")
+    addr(sp)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("microbenchmark", help="single-node perf microbenchmarks")
     sp.add_argument("-n", type=int, default=2000)

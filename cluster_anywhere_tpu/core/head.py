@@ -1964,6 +1964,19 @@ class Head:
                 actors=len(self.actors),
                 nodes=len(self.nodes),
             )
+        # HTTP dashboard (dashboard/head.py analogue): zero extra process,
+        # the head answers from its own tables
+        self.dashboard = None
+        try:
+            from ..dashboard import Dashboard
+
+            self.dashboard = Dashboard(self)
+            await self.dashboard.start(
+                getattr(self.config, "head_host", "127.0.0.1"),
+                int(os.environ.get("CA_DASHBOARD_PORT", "0")),
+            )
+        except Exception as e:
+            self._log_event("dashboard_failed", error=repr(e))
         monitor = asyncio.ensure_future(self._monitor_loop())
         persister = asyncio.ensure_future(self._persist_loop())
         # readiness marker for the driver
@@ -1972,6 +1985,8 @@ class Head:
         await self._shutdown.wait()
         monitor.cancel()
         persister.cancel()
+        if self.dashboard is not None:
+            await self.dashboard.stop()
         await self._teardown()
 
     async def _teardown(self):

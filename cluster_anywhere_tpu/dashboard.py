@@ -1,0 +1,217 @@
+"""HTTP dashboard served by the head process (compact analogue of the
+reference's dashboard/head.py + state aggregator modules: cluster status over
+HTTP for humans and tools).
+
+Endpoints:
+  GET /               single-page HTML UI (auto-refreshing)
+  GET /api/summary    nodes/resources/stats in one call
+  GET /api/nodes      node table
+  GET /api/actors     actor table
+  GET /api/workers    worker table
+  GET /api/objects    object directory sample
+  GET /api/tasks      recent task events
+  GET /api/pgs        placement groups
+  GET /metrics        Prometheus text (user + runtime metrics)
+
+Zero extra process: the head owns every table locally, so requests are
+answered without RPC.  The listen address is written to
+<session>/dashboard.addr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Any
+
+_PAGE = """<!doctype html>
+<html><head><title>cluster_anywhere_tpu dashboard</title>
+<style>
+body { font-family: ui-monospace, monospace; margin: 24px; background: #101418; color: #d8dee6; }
+h1 { font-size: 18px; } h2 { font-size: 14px; margin: 18px 0 6px; color: #8ab4f8; }
+table { border-collapse: collapse; width: 100%%; font-size: 12px; }
+th, td { text-align: left; padding: 3px 10px; border-bottom: 1px solid #2a3038; }
+th { color: #9aa5b1; font-weight: 600; }
+.ok { color: #7ee787; } .bad { color: #ff7b72; }
+#res { font-size: 13px; margin: 8px 0; }
+</style></head><body>
+<h1>cluster_anywhere_tpu</h1>
+<div id="res"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Workers</h2><table id="workers"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<script>
+function row(cells, tag) {
+  return "<tr>" + cells.map(c => "<" + (tag||"td") + ">" + c + "</" + (tag||"td") + ">").join("") + "</tr>";
+}
+async function refresh() {
+  const s = await (await fetch("/api/summary")).json();
+  document.getElementById("res").innerHTML =
+    "CPU " + (s.total.CPU - (s.available.CPU||0)).toFixed(1) + "/" + (s.total.CPU||0) +
+    " &nbsp; nodes " + s.stats.n_nodes + " &nbsp; workers " + s.stats.n_workers +
+    " &nbsp; actors " + s.stats.n_actors + " &nbsp; objects " + s.stats.n_objects +
+    " &nbsp; pending leases " + s.stats.pending_leases;
+  const nodes = await (await fetch("/api/nodes")).json();
+  document.getElementById("nodes").innerHTML = row(["node", "alive", "head", "CPU avail/total", "workers"], "th") +
+    nodes.map(n => row([n.node_id, n.alive ? "<span class=ok>yes</span>" : "<span class=bad>DEAD</span>",
+      n.is_head_node ? "*" : "", (n.available.CPU||0) + "/" + (n.resources.CPU||0), n.n_workers])).join("");
+  const actors = await (await fetch("/api/actors")).json();
+  document.getElementById("actors").innerHTML = row(["actor", "name", "state", "node", "restarts"], "th") +
+    actors.slice(0, 50).map(a => row([a.actor_id.slice(0, 12), a.name||"", a.state, a.node_id||"", a.incarnation])).join("");
+  const workers = await (await fetch("/api/workers")).json();
+  document.getElementById("workers").innerHTML = row(["worker", "pid", "state", "node"], "th") +
+    workers.slice(0, 80).map(w => row([w.worker_id, w.pid, w.state, w.node_id])).join("");
+  const tasks = await (await fetch("/api/tasks?limit=30")).json();
+  document.getElementById("tasks").innerHTML = row(["name", "type", "state", "worker", "ms"], "th") +
+    tasks.reverse().map(t => row([t.name, t.type, t.state, t.worker_id,
+      ((t.end - t.start) * 1000).toFixed(1)])).join("");
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class Dashboard:
+    def __init__(self, head):
+        self.head = head
+        self._server = None
+        self.addr = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        h, p = self._server.sockets[0].getsockname()[:2]
+        self.addr = f"http://{h}:{p}"
+        with open(os.path.join(self.head.session_dir, "dashboard.addr"), "w") as f:
+            f.write(self.addr)
+        return self.addr
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ---------------------------------------------------------------- http
+    async def _on_client(self, reader, writer):
+        try:
+            req = await asyncio.wait_for(reader.readline(), 10)
+            parts = req.decode("latin1").split()
+            if len(parts) < 2 or parts[0] != "GET":
+                await self._respond(writer, 405, "text/plain", b"GET only")
+                return
+            path = parts[1]
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            status, ctype, body = self._route(path)
+            await self._respond(writer, status, ctype, body)
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _respond(self, writer, status: int, ctype: str, body: bytes):
+        reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}.get(status, "OK")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\nContent-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+        )
+        writer.write(body)
+        await writer.drain()
+
+    def _route(self, path: str):
+        if "?" in path:
+            path, _, query = path.partition("?")
+        else:
+            query = ""
+        params = dict(p.partition("=")[::2] for p in query.split("&") if p)
+        h = self.head
+        if path == "/":
+            return 200, "text/html", _PAGE.encode()
+        if path == "/api/summary":
+            return self._json(
+                {
+                    "total": h._agg_total(),
+                    "available": h._agg_avail(),
+                    "stats": dict(
+                        h.stats,
+                        pending_leases=len(h.pending_leases),
+                        n_workers=sum(1 for w in h.workers.values() if w.state != "dead"),
+                        n_actors=len(h.actors),
+                        n_objects=len(h.objects),
+                        n_nodes=len(h._alive_nodes()),
+                    ),
+                }
+            )
+        if path == "/api/nodes":
+            return self._json(
+                [
+                    {
+                        "node_id": n.node_id,
+                        "alive": n.state == "alive",
+                        "is_head_node": n.is_local,
+                        "resources": n.total,
+                        "available": n.avail,
+                        "n_workers": sum(
+                            1
+                            for w in h.workers.values()
+                            if w.node_id == n.node_id and w.state != "dead"
+                        ),
+                    }
+                    for n in h.nodes.values()
+                ]
+            )
+        if path == "/api/actors":
+            return self._json([h._actor_info(a) for a in h.actors.values()])
+        if path == "/api/workers":
+            return self._json(
+                [
+                    {
+                        "worker_id": w.worker_id, "pid": w.pid, "state": w.state,
+                        "node_id": w.node_id, "actor_id": w.actor_id,
+                    }
+                    for w in h.workers.values()
+                ]
+            )
+        if path == "/api/objects":
+            limit = int(params.get("limit", 200))
+            out = []
+            for rec in list(h.objects.values())[:limit]:
+                out.append(
+                    {
+                        "object_id": rec.oid.hex(), "size": rec.size,
+                        "node_id": rec.node_id, "holders": len(rec.holders),
+                        "spilled": rec.spill_path is not None,
+                    }
+                )
+            return self._json(out)
+        if path == "/api/tasks":
+            limit = int(params.get("limit", 100))
+            return self._json(list(h.task_events)[-limit:])
+        if path == "/api/pgs":
+            return self._json(
+                [
+                    {
+                        "pg_id": p.pg_id, "strategy": p.strategy, "state": p.state,
+                        "bundle_nodes": [b.node_id for b in p.bundles],
+                    }
+                    for p in h.pgs.values()
+                ]
+            )
+        if path == "/metrics":
+            from .util.metrics import render_prometheus
+
+            try:
+                text = render_prometheus(h.metrics)
+            except Exception:
+                text = ""
+            return 200, "text/plain; version=0.0.4", text.encode()
+        return 404, "text/plain", b"not found"
+
+    @staticmethod
+    def _json(obj: Any):
+        return 200, "application/json", json.dumps(obj, default=str).encode()

@@ -234,7 +234,12 @@ def get_metrics_snapshot() -> Dict[str, dict]:
 
 def prometheus_text() -> str:
     """Prometheus exposition format of the cluster metrics snapshot."""
-    snap = get_metrics_snapshot()
+    return render_prometheus(get_metrics_snapshot())
+
+
+def render_prometheus(snap: Dict[str, dict]) -> str:
+    """Render a metrics snapshot dict (head-side table or RPC copy) to the
+    Prometheus exposition format."""
     lines: List[str] = []
     for name, rec in sorted(snap.items()):
         if rec.get("desc"):

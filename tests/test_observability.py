@@ -194,3 +194,46 @@ def test_tracing_spans():
 def test_get_log():
     log = state.get_log()  # head log exists
     assert isinstance(log, str)
+
+
+def test_dashboard_http(ca_cluster_module):
+    """The head serves the HTTP dashboard: HTML page, JSON state endpoints,
+    Prometheus text (dashboard/head.py analogue)."""
+    import json
+    import os
+    import urllib.request
+
+    import cluster_anywhere_tpu as ca
+
+    @ca.remote
+    def one():
+        return 1
+
+    assert ca.get(one.remote()) == 1
+
+    from cluster_anywhere_tpu.core import api as capi
+
+    addr_file = os.path.join(capi._session_dir, "dashboard.addr")
+    assert os.path.exists(addr_file)
+    base = open(addr_file).read().strip()
+
+    html = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+    assert "cluster_anywhere_tpu" in html
+
+    summary = json.load(urllib.request.urlopen(base + "/api/summary", timeout=10))
+    assert summary["stats"]["n_nodes"] >= 1
+    assert summary["total"].get("CPU", 0) > 0
+
+    nodes = json.load(urllib.request.urlopen(base + "/api/nodes", timeout=10))
+    assert any(n["is_head_node"] for n in nodes)
+
+    workers = json.load(urllib.request.urlopen(base + "/api/workers", timeout=10))
+    assert len(workers) >= 1
+
+    tasks = json.load(urllib.request.urlopen(base + "/api/tasks?limit=10", timeout=10))
+    assert isinstance(tasks, list)
+
+    met = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+    assert isinstance(met, str)  # may be empty before any report
+
+    assert urllib.request.urlopen(base + "/api/pgs", timeout=10).status == 200
