@@ -185,9 +185,29 @@ def bench_model():
         log(f"model bench skipped: {type(e).__name__}: {e}")
 
 
+def _device_probe_ok(timeout_s: float = 180) -> bool:
+    """Probe accelerator availability in a subprocess: a wedged device tunnel
+    makes jax.devices() hang forever, which must not take the whole bench
+    down with it."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     _, best_actor, _ = bench_core()
-    bench_model()
+    if _device_probe_ok():
+        bench_model()
+    else:
+        log("model bench skipped: accelerator runtime unreachable (probe hung)")
     print(
         json.dumps(
             {
