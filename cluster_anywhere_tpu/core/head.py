@@ -1465,6 +1465,10 @@ class Head:
         old = rec.shm_name
         rec.spill_path = msg["path"]
         rec.shm_name = None
+        # secondary copies are droppable outright — free them on their nodes
+        # before forgetting them, or their arena slices leak
+        for nid, name in rec.copies.items():
+            self._free_shm_name(name, nid)
         rec.copies.clear()
         pinned = any(h.endswith("#v") for h in rec.holders)
         if old is None:

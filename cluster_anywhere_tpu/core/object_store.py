@@ -419,9 +419,14 @@ class ShmObjectStore:
             if got is not None:
                 return got
             # genuinely out of space: new arena, geometric in object size and
-            # total footprint so sustained bursts create O(log) arenas
+            # total footprint so sustained bursts create O(log) arenas.
+            # Under a budget, over-budget growth (spill couldn't free a
+            # contiguous fit) is sized to the request, not the geometric
+            # schedule — the overshoot stays proportional to one object.
             total = sum(a.size for a in arenas)
             cap = max(_ARENA_DEFAULT, total)
+            if self.budget_bytes and total + cap > self.budget_bytes:
+                cap = max(self.budget_bytes - total, size * 2)
             while cap < size * 2:
                 cap *= 2
             with self._lock:

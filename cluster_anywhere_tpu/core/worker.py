@@ -329,6 +329,8 @@ class Worker:
         self.total_resources: Dict[str, float] = {}
         # in-flight node-to-node object pulls, deduped by oid
         self._pulls: Dict[bytes, asyncio.Future] = {}
+        # slices already spilled but whose memory awaits the last pin drop
+        self._spilled_pinned: set = set()
         # in-flight streaming generators (ObjectRefGenerator consumers)
         self._streams: Dict[bytes, Any] = {}
         # lineage: task specs of submitted normal tasks, so a lost object can
@@ -868,6 +870,19 @@ class Worker:
                     "obj_pin", oid=oid_b, as_id=f"{self.client_id}#v"
                 )
                 if not loc.get("found"):
+                    # obj_created may still be in flight on the producer's
+                    # socket while our entry (from the task reply) is already
+                    # readable locally: read it directly — spilling cannot
+                    # touch an unregistered object.  The notify-style pin
+                    # lands in the head's early-refs buffer.
+                    if name and self.shm_store.is_local(name):
+                        pin_cb = self._make_value_pin(ref.id) if "@" in name else None
+                        value = serialization.unpack(
+                            self.shm_store.open(name), pin_cb=pin_cb
+                        )
+                        e.value = value
+                        e.state = "value"
+                        return value
                     raise ObjectLostError(f"object {ref.id} not in the directory")
                 pin_cb = self._pin_unref_cb(oid_b)
                 if loc.get("spill_path"):
@@ -1120,6 +1135,11 @@ class Worker:
         for name, size, oid_b in self.shm_store.live_slices_oldest_first():
             if freed >= target:
                 break
+            if name in self._spilled_pinned:
+                # already relocated to disk; its memory comes back only when
+                # the last zero-copy pin drops — re-spilling would just
+                # rewrite the same file for nothing
+                continue
             try:
                 mv = self.shm_store.open(name)
             except Exception:
@@ -1151,7 +1171,10 @@ class Worker:
             elif reply.get("free_now"):
                 self.shm_store.free_local(name)
                 freed += size
-            # pinned: relocated but memory comes back later (pin drop)
+            else:
+                # pinned: relocated but memory comes back later (pin drop);
+                # never pick it as a spill candidate again
+                self._spilled_pinned.add(name)
 
     def _promote_nested(self, nested: List[bytes], depth: int = 0):
         """Nested refs to inline-only objects have no cluster-visible data

@@ -301,6 +301,8 @@ class WorkerProcess:
                     # backpressure: wait for the consumer before running ahead
                     while idx - stream["acked"] >= limit:
                         stream["event"].clear()
+                        if idx - stream["acked"] < limit:
+                            break  # ack landed between check and clear
                         if not stream["event"].wait(self.config.push_timeout_s):
                             raise TaskError(
                                 "streaming consumer stalled past the timeout"
@@ -505,7 +507,13 @@ class WorkerProcess:
             e = self.worker.memory_store.get_entry(ObjectID(oid))
             if e is None or e.state == "pending":
                 raise KeyError(f"object {oid.hex()} not found on this worker")
-            value = self.worker._resolve_entry(ObjectRef(ObjectID(oid)))
+            # resolve on an executor thread, NOT the IO loop: the full
+            # recovery path (confirmed pins, relocation after spill,
+            # reconstruction) drives RPCs through the loop and would
+            # deadlock/degrade if entered from it
+            value = await self.loop.run_in_executor(
+                None, self.worker._resolve_entry, ObjectRef(ObjectID(oid))
+            )
         if _is_device_value(value):
             import jax
 

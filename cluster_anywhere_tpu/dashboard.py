@@ -30,7 +30,7 @@ _PAGE = """<!doctype html>
 <style>
 body { font-family: ui-monospace, monospace; margin: 24px; background: #101418; color: #d8dee6; }
 h1 { font-size: 18px; } h2 { font-size: 14px; margin: 18px 0 6px; color: #8ab4f8; }
-table { border-collapse: collapse; width: 100%%; font-size: 12px; }
+table { border-collapse: collapse; width: 100%; font-size: 12px; }
 th, td { text-align: left; padding: 3px 10px; border-bottom: 1px solid #2a3038; }
 th { color: #9aa5b1; font-weight: 600; }
 .ok { color: #7ee787; } .bad { color: #ff7b72; }

@@ -156,6 +156,11 @@ class Algorithm:
         episodes, ep_returns = 0, []
         for _ in range(len(self.runners)):
             ready, _ = ca.wait(list(self._pending), num_returns=1, timeout=120)
+            if not ready:
+                raise TimeoutError(
+                    "IMPALA: no env-runner produced a rollout within 120s "
+                    f"({len(self._pending)} in flight)"
+                )
             ref = ready[0]
             idx = self._pending.pop(ref)
             ro = ca.get(ref)
