@@ -13,7 +13,7 @@ modules (`parallel`, `ops`, `models`) are used.
 
 from ._version import version as __version__
 from .core import errors as exceptions
-from .core.actor import ActorHandle, exit_actor, get_actor, kill
+from .core.actor import ActorHandle, exit_actor, get_actor, kill, method
 from .core.api import (
     available_resources,
     timeline,
@@ -65,6 +65,7 @@ __all__ = [
     "DeviceRef",
     "ActorHandle",
     "get_actor",
+    "method",
     "kill",
     "exit_actor",
     "nodes",

@@ -23,11 +23,28 @@ _VALID_ACTOR_OPTIONS = {
     "max_restarts",
     "max_task_retries",
     "max_concurrency",
+    "concurrency_groups",
     "placement_group",
     "placement_group_bundle_index",
     "scheduling_strategy",
     "runtime_env",
 }
+
+
+def method(**options):
+    """Per-method options decorator (analogue of ray.method): currently
+    num_returns and concurrency_group (see `concurrency_groups` actor
+    option; reference concurrency_group_manager.h)."""
+    allowed = {"num_returns", "concurrency_group"}
+    unknown = set(options) - allowed
+    if unknown:
+        raise ValueError(f"unknown method option(s): {sorted(unknown)}")
+
+    def wrap(fn):
+        fn.__ca_method_options__ = options
+        return fn
+
+    return wrap
 
 
 class ActorMethod:

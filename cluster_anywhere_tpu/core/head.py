@@ -93,6 +93,7 @@ class ActorRec:
     addr: Optional[str] = None
     detached: bool = False
     max_concurrency: int = 1
+    concurrency_groups: Optional[dict] = None
     death_cause: str = ""
     pg_id: Optional[str] = None
     bundle_index: int = -1
@@ -310,7 +311,9 @@ class Head:
                     "max_restarts": a.max_restarts, "restarts_used": a.restarts_used,
                     "incarnation": a.incarnation, "state": a.state,
                     "worker_id": a.worker_id, "addr": a.addr, "detached": a.detached,
-                    "max_concurrency": a.max_concurrency, "death_cause": a.death_cause,
+                    "max_concurrency": a.max_concurrency,
+                    "concurrency_groups": a.concurrency_groups,
+                    "death_cause": a.death_cause,
                     "pg_id": a.pg_id, "bundle_index": a.bundle_index,
                     "runtime_env": a.runtime_env, "strategy": a.strategy,
                     "node_id": a.node_id, "charged": a.charged,
@@ -785,6 +788,7 @@ class Head:
                 fn_id=a.fn_id,
                 init_spec=a.init_spec,
                 max_concurrency=a.max_concurrency,
+                concurrency_groups=a.concurrency_groups,
                 incarnation=a.incarnation,
                 runtime_env=a.runtime_env,
             )
@@ -1213,6 +1217,7 @@ class Head:
             max_restarts=msg.get("max_restarts", 0),
             detached=msg.get("detached", False),
             max_concurrency=msg.get("max_concurrency", 1),
+            concurrency_groups=msg.get("concurrency_groups"),
             pg_id=msg.get("pg_id"),
             bundle_index=msg.get("bundle_index", -1),
             runtime_env=msg.get("runtime_env"),

@@ -1562,6 +1562,7 @@ class Worker:
                 max_restarts=opts.get("max_restarts", self.config.default_actor_max_restarts),
                 detached=(opts.get("lifetime") == "detached"),
                 max_concurrency=opts.get("max_concurrency", 1),
+                concurrency_groups=opts.get("concurrency_groups"),
                 pg_id=opts.get("placement_group"),
                 bundle_index=opts.get("placement_group_bundle_index", -1),
                 runtime_env=wire_env,

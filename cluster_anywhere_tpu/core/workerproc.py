@@ -35,6 +35,9 @@ class ActorContext:
         self.instance = instance
         self.max_concurrency = max_concurrency
         self.incarnation = incarnation
+        # concurrency groups (concurrency_group_manager.h): named thread
+        # pools; methods are routed by their @method(concurrency_group=...)
+        self.group_executors: Dict[str, Any] = {}
 
 
 class WorkerProcess:
@@ -245,7 +248,7 @@ class WorkerProcess:
                     self._record_event(task_id, ev_name, "actor_task", t0, True)
                     return out
                 out = await self.loop.run_in_executor(
-                    self.executor, self._exec_sync, method, msg, task_id, msg["actor_id"]
+                    self._executor_for(method), self._exec_sync, method, msg, task_id, msg["actor_id"]
                 )
                 self._record_event(task_id, ev_name, "actor_task", t0, True)
                 return out
@@ -371,6 +374,17 @@ class WorkerProcess:
             return True
         return False
 
+    def _executor_for(self, fn):
+        """Route a method to its concurrency group's thread pool (default:
+        the actor's main executor)."""
+        if self.actor is not None and self.actor.group_executors:
+            group = getattr(fn, "__ca_method_options__", {}).get("concurrency_group")
+            if group is not None:
+                ex = self.actor.group_executors.get(group)
+                if ex is not None:
+                    return ex
+        return self.executor
+
     def _submit_fast(self, fn, msg, writer, actor_id, kind, ev_name):
         import time as _time
 
@@ -412,7 +426,7 @@ class WorkerProcess:
 
             self.loop.call_soon_threadsafe(finish)
 
-        self.executor.submit(job)
+        self._executor_for(fn).submit(job)
 
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
@@ -485,6 +499,12 @@ class WorkerProcess:
             self.executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=max_concurrency, thread_name_prefix="ca-exec"
             )
+        group_executors = {
+            name: concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, int(n)), thread_name_prefix=f"ca-cg-{name}"
+            )
+            for name, n in (msg.get("concurrency_groups") or {}).items()
+        }
 
         def _make():
             if msg.get("runtime_env"):
@@ -499,6 +519,7 @@ class WorkerProcess:
         self.actor = ActorContext(
             msg["actor_id"], instance, max_concurrency, msg.get("incarnation", 0)
         )
+        self.actor.group_executors = group_executors
         self.worker.current_actor_id = ActorID.from_hex(msg["actor_id"])
 
     async def _fetch_object(self, oid: bytes) -> bytes:

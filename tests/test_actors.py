@@ -289,3 +289,38 @@ def test_pending_pg_created_when_resources_free(ca_cluster):
     assert ca.get(actor_box["actor"].ping.remote(), timeout=15) == 1
     ca.kill(actor_box["actor"])
     ca.remove_placement_group(pg)
+
+
+def test_concurrency_groups(ca_cluster_module):
+    """Methods in different concurrency groups run in parallel even while the
+    default group is busy; a single-slot group serializes its methods
+    (reference concurrency_group_manager.h + @ray.method)."""
+    import threading
+    import time as _t
+
+    @ca.remote(concurrency_groups={"io": 2, "slow": 1})
+    class Split:
+        def __init__(self):
+            self.order = []
+
+        @ca.method(concurrency_group="slow")
+        def block(self):
+            _t.sleep(1.0)
+            return "blocked-done"
+
+        @ca.method(concurrency_group="io")
+        def ping(self):
+            return "pong"
+
+        def default_m(self):
+            return "default"
+
+    a = Split.remote()
+    blocked = a.block.remote()
+    _t.sleep(0.2)
+    # io-group and default-group methods answer while "slow" is busy
+    t0 = _t.monotonic()
+    assert ca.get(a.ping.remote(), timeout=10) == "pong"
+    assert ca.get(a.default_m.remote(), timeout=10) == "default"
+    assert _t.monotonic() - t0 < 0.7, "groups did not run concurrently"
+    assert ca.get(blocked, timeout=10) == "blocked-done"
