@@ -47,6 +47,21 @@ def method(**options):
     return wrap
 
 
+def _collect_method_options(cls) -> Dict[str, dict]:
+    """Gather @method(**opts) annotations from an actor class (method name ->
+    options dict); drives caller-side num_returns and creation-time
+    concurrency-group validation."""
+    out: Dict[str, dict] = {}
+    for name in dir(cls):
+        if name.startswith("__"):
+            continue
+        fn = getattr(cls, name, None)
+        opts = getattr(fn, "__ca_method_options__", None)
+        if opts:
+            out[name] = dict(opts)
+    return out
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
         self._handle = handle
@@ -65,14 +80,21 @@ class ActorMethod:
 
         return ClassMethodNode(self._handle, self._method_name, args, kwargs)
 
-    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: Optional[int] = None, **_ignored) -> "ActorMethod":
+        n = self._num_returns if num_returns is None else num_returns
+        return ActorMethod(self._handle, self._method_name, n)
 
 
 class ActorHandle:
-    def __init__(self, actor_id: ActorID, max_task_retries: int = 0):
+    def __init__(
+        self,
+        actor_id: ActorID,
+        max_task_retries: int = 0,
+        method_options: Optional[Dict[str, dict]] = None,
+    ):
         self._actor_id = actor_id
         self._max_task_retries = max_task_retries
+        self._method_options = method_options or {}
 
     @property
     def actor_id(self) -> ActorID:
@@ -89,13 +111,17 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        n = self._method_options.get(name, {}).get("num_returns", 1)
+        return ActorMethod(self, name, num_returns=n)
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._max_task_retries))
+        return (
+            ActorHandle,
+            (self._actor_id, self._max_task_retries, self._method_options),
+        )
 
 
 class ActorClass:
@@ -118,8 +144,28 @@ class ActorClass:
 
     def _remote(self, args, kwargs, opts) -> ActorHandle:
         w = global_worker()
-        actor_id, _addr = w.create_actor(self._cls, args, kwargs, _normalize_pg(opts))
-        return ActorHandle(actor_id, max_task_retries=opts.get("max_task_retries", 0))
+        method_options = _collect_method_options(self._cls)
+        declared = set(opts.get("concurrency_groups") or {})
+        referenced = {
+            o["concurrency_group"]
+            for o in method_options.values()
+            if o.get("concurrency_group") is not None
+        }
+        undeclared = referenced - declared
+        if undeclared:
+            raise ValueError(
+                f"concurrency group(s) {sorted(undeclared)} used by @method but "
+                f"not declared in the actor's concurrency_groups option "
+                f"(declared: {sorted(declared)})"
+            )
+        wire_opts = dict(_normalize_pg(opts))
+        wire_opts["method_options"] = method_options or None
+        actor_id, _addr = w.create_actor(self._cls, args, kwargs, wire_opts)
+        return ActorHandle(
+            actor_id,
+            max_task_retries=opts.get("max_task_retries", 0),
+            method_options=method_options,
+        )
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -136,7 +182,10 @@ def get_actor(name: str) -> ActorHandle:
     """Look up a named actor (python/ray/_private/worker.py get_actor)."""
     w = global_worker()
     info = w.get_actor_info(name=name)
-    return ActorHandle(ActorID.from_hex(info["actor_id"]))
+    return ActorHandle(
+        ActorID.from_hex(info["actor_id"]),
+        method_options=info.get("method_options"),
+    )
 
 
 def kill(actor: ActorHandle, no_restart: bool = True):

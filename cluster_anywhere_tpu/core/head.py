@@ -94,6 +94,7 @@ class ActorRec:
     detached: bool = False
     max_concurrency: int = 1
     concurrency_groups: Optional[dict] = None
+    method_options: Optional[dict] = None  # method name -> @method(**opts)
     death_cause: str = ""
     pg_id: Optional[str] = None
     bundle_index: int = -1
@@ -313,6 +314,7 @@ class Head:
                     "worker_id": a.worker_id, "addr": a.addr, "detached": a.detached,
                     "max_concurrency": a.max_concurrency,
                     "concurrency_groups": a.concurrency_groups,
+                    "method_options": a.method_options,
                     "death_cause": a.death_cause,
                     "pg_id": a.pg_id, "bundle_index": a.bundle_index,
                     "runtime_env": a.runtime_env, "strategy": a.strategy,
@@ -811,6 +813,7 @@ class Head:
             "name": a.name,
             "death_cause": a.death_cause,
             "node_id": a.node_id,
+            "method_options": a.method_options,
         }
 
     async def _on_worker_death(self, rec: WorkerRec):
@@ -1218,6 +1221,7 @@ class Head:
             detached=msg.get("detached", False),
             max_concurrency=msg.get("max_concurrency", 1),
             concurrency_groups=msg.get("concurrency_groups"),
+            method_options=msg.get("method_options"),
             pg_id=msg.get("pg_id"),
             bundle_index=msg.get("bundle_index", -1),
             runtime_env=msg.get("runtime_env"),

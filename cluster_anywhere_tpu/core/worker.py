@@ -1563,6 +1563,7 @@ class Worker:
                 detached=(opts.get("lifetime") == "detached"),
                 max_concurrency=opts.get("max_concurrency", 1),
                 concurrency_groups=opts.get("concurrency_groups"),
+                method_options=opts.get("method_options"),
                 pg_id=opts.get("placement_group"),
                 bundle_index=opts.get("placement_group_bundle_index", -1),
                 runtime_env=wire_env,

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import os
 import sys
 import threading
@@ -38,6 +39,9 @@ class ActorContext:
         # concurrency groups (concurrency_group_manager.h): named thread
         # pools; methods are routed by their @method(concurrency_group=...)
         self.group_executors: Dict[str, Any] = {}
+        # same bound for async methods, which run on the event loop rather
+        # than a thread pool (fiber-concurrency analogue)
+        self.group_semaphores: Dict[str, Any] = {}
 
 
 class WorkerProcess:
@@ -236,7 +240,9 @@ class WorkerProcess:
                     args, kwargs = await self.loop.run_in_executor(
                         None, self._resolve_args, msg["args"], msg.get("kwargs")
                     )
-                    value = await method(*args, **kwargs)
+                    sem = self._semaphore_for(method)
+                    async with sem if sem is not None else contextlib.nullcontext():
+                        value = await method(*args, **kwargs)
                     out = await self.loop.run_in_executor(
                         None,
                         self._package_results,
@@ -247,9 +253,12 @@ class WorkerProcess:
                     )
                     self._record_event(task_id, ev_name, "actor_task", t0, True)
                     return out
-                out = await self.loop.run_in_executor(
-                    self._executor_for(method), self._exec_sync, method, msg, task_id, msg["actor_id"]
-                )
+                sem = self._semaphore_for(method)
+                async with sem if sem is not None else contextlib.nullcontext():
+                    out = await self.loop.run_in_executor(
+                        self._executor_for(method),
+                        self._exec_sync, method, msg, task_id, msg["actor_id"],
+                    )
                 self._record_event(task_id, ev_name, "actor_task", t0, True)
                 return out
             fn = self.worker.fn_manager.get(msg["fn_id"])
@@ -357,6 +366,10 @@ class WorkerProcess:
             if name == "__ca_exec__":
                 return False
             fn = getattr(ctx.instance, name, None)
+            if fn is not None and self._semaphore_for(fn) is not None:
+                # grouped methods take the slow path so the group semaphore
+                # is the single width gate across sync/async/streaming
+                return False
             if fn is None or asyncio.iscoroutinefunction(fn):
                 return False
             self._submit_fast(fn, msg, writer, msg["actor_id"], "actor_task", name)
@@ -384,6 +397,18 @@ class WorkerProcess:
                 if ex is not None:
                     return ex
         return self.executor
+
+    def _semaphore_for(self, fn):
+        """Concurrency-group bound for async methods: thread pools can't cap
+        coroutines, so declared groups get an asyncio.Semaphore of the same
+        width. Ungrouped async methods stay unbounded (interleaving is the
+        point of an async actor)."""
+        if self.actor is None or not self.actor.group_semaphores:
+            return None
+        group = getattr(fn, "__ca_method_options__", {}).get("concurrency_group")
+        if group is None:
+            return None
+        return self.actor.group_semaphores.get(group)
 
     def _submit_fast(self, fn, msg, writer, actor_id, kind, ev_name):
         import time as _time
@@ -435,10 +460,12 @@ class WorkerProcess:
             if isinstance(fn, dict):  # resolution error -> terminal reply
                 reply(**fn)
                 return
-            out = await self.loop.run_in_executor(
-                self.executor, self._exec_streaming, fn, msg, state["writer"],
-                msg.get("actor_id"),
-            )
+            sem = self._semaphore_for(fn)
+            async with sem if sem is not None else contextlib.nullcontext():
+                out = await self.loop.run_in_executor(
+                    self._executor_for(fn), self._exec_streaming, fn, msg,
+                    state["writer"], msg.get("actor_id"),
+                )
             reply(**out)
         elif m == "push_task":
             results = await self._execute(msg, is_actor_call=False)
@@ -520,6 +547,10 @@ class WorkerProcess:
             msg["actor_id"], instance, max_concurrency, msg.get("incarnation", 0)
         )
         self.actor.group_executors = group_executors
+        self.actor.group_semaphores = {
+            name: asyncio.Semaphore(max(1, int(n)))
+            for name, n in (msg.get("concurrency_groups") or {}).items()
+        }
         self.worker.current_actor_id = ActorID.from_hex(msg["actor_id"])
 
     async def _fetch_object(self, oid: bytes) -> bytes:

@@ -46,11 +46,24 @@ def ca_cluster():
 
 
 @pytest.fixture(scope="module")
-def ca_cluster_module():
+def _ca_cluster_module_lifecycle():
     import cluster_anywhere_tpu as ca
 
     if ca.is_initialized():
         ca.shutdown()
-    info = ca.init(num_cpus=4)
-    yield info
-    ca.shutdown()
+    box = {"info": ca.init(num_cpus=4)}
+    yield box
+    if ca.is_initialized():
+        ca.shutdown()
+
+
+@pytest.fixture
+def ca_cluster_module(_ca_cluster_module_lifecycle):
+    """Module-lifetime cluster, but re-initialized if an interleaved
+    function-scoped test (ca_cluster) tore the shared cluster down; the box
+    keeps the yielded info current across re-inits."""
+    import cluster_anywhere_tpu as ca
+
+    if not ca.is_initialized():
+        _ca_cluster_module_lifecycle["info"] = ca.init(num_cpus=4)
+    yield _ca_cluster_module_lifecycle["info"]

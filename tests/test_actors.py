@@ -324,3 +324,164 @@ def test_concurrency_groups(ca_cluster_module):
     assert ca.get(a.default_m.remote(), timeout=10) == "default"
     assert _t.monotonic() - t0 < 0.7, "groups did not run concurrently"
     assert ca.get(blocked, timeout=10) == "blocked-done"
+    ca.kill(a)
+
+
+def test_method_num_returns(ca_cluster_module):
+    """@ca.method(num_returns=N) yields N ObjectRefs from the plain .remote()
+    call, survives handle serialization, and is visible through get_actor
+    (reference @ray.method num_returns)."""
+
+    @ca.remote
+    class Pair:
+        @ca.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+        def one(self):
+            return "single"
+
+    a = Pair.options(name="pair-mo").remote()
+    r1, r2 = a.two.remote()
+    assert ca.get(r1, timeout=10) == 1
+    assert ca.get(r2, timeout=10) == 2
+    assert ca.get(a.one.remote(), timeout=10) == "single"
+
+    # a handle fetched by name carries the same per-method metadata
+    h = ca.get_actor("pair-mo")
+    x, y = h.two.remote()
+    assert ca.get([x, y], timeout=10) == [1, 2]
+
+    # and a handle that crossed a task boundary does too
+    @ca.remote
+    def via_task(handle):
+        p, q = handle.two.remote()
+        return ca.get([p, q], timeout=10)
+
+    assert ca.get(via_task.remote(a), timeout=15) == [1, 2]
+    ca.kill(a)
+
+
+def test_undeclared_concurrency_group_rejected(ca_cluster_module):
+    """A @method tagged with a concurrency group the actor never declared
+    fails at creation time instead of silently running in the default
+    executor (reference errors on undeclared groups)."""
+    import pytest
+
+    @ca.remote(concurrency_groups={"io": 2})
+    class Typo:
+        @ca.method(concurrency_group="oi")
+        def ping(self):
+            return 1
+
+    with pytest.raises(ValueError, match="oi"):
+        Typo.remote()
+
+    @ca.remote
+    class NoGroups:
+        @ca.method(concurrency_group="io")
+        def ping(self):
+            return 1
+
+    with pytest.raises(ValueError, match="io"):
+        NoGroups.remote()
+
+
+def test_async_concurrency_group_bound(ca_cluster_module):
+    """Declared groups bound async methods too (via a loop semaphore): a
+    1-slot group serializes its coroutines while ungrouped async methods
+    still interleave freely."""
+    import asyncio
+    import time as _t
+
+    @ca.remote(concurrency_groups={"one": 1})
+    class A:
+        @ca.method(concurrency_group="one")
+        async def slow(self):
+            await asyncio.sleep(0.4)
+            return "s"
+
+        async def fast(self):
+            await asyncio.sleep(0.4)
+            return "f"
+
+    a = A.remote()
+    # two grouped calls serialize: >= 0.8s total
+    t0 = _t.monotonic()
+    assert ca.get([a.slow.remote(), a.slow.remote()], timeout=15) == ["s", "s"]
+    assert _t.monotonic() - t0 >= 0.75, "1-slot group did not serialize coroutines"
+    # two ungrouped calls interleave: well under 0.8s
+    t0 = _t.monotonic()
+    assert ca.get([a.fast.remote(), a.fast.remote()], timeout=15) == ["f", "f"]
+    assert _t.monotonic() - t0 < 0.75, "ungrouped async methods did not interleave"
+    ca.kill(a)
+
+
+def test_method_options_preserved_through_options(ca_cluster_module):
+    """ActorMethod.options() without num_returns keeps the @method-declared
+    value instead of reverting to 1."""
+
+    @ca.remote
+    class P:
+        @ca.method(num_returns=2)
+        def two(self):
+            return 5, 6
+
+    a = P.remote()
+    r = a.two.options().remote()
+    assert isinstance(r, list) and len(r) == 2
+    assert ca.get(r, timeout=10) == [5, 6]
+    ca.kill(a)
+
+
+def test_mixed_sync_async_group_width(ca_cluster_module):
+    """A width-1 group is a single admission gate across sync AND async
+    methods: one of each submitted together serialize (not 2x parallel)."""
+    import time as _t
+
+    @ca.remote(concurrency_groups={"db": 1})
+    class Mixed:
+        @ca.method(concurrency_group="db")
+        def s(self):
+            _t.sleep(0.4)
+            return "sync"
+
+        @ca.method(concurrency_group="db")
+        async def a(self):
+            import asyncio
+
+            await asyncio.sleep(0.4)
+            return "async"
+
+    m = Mixed.remote()
+    t0 = _t.monotonic()
+    assert sorted(ca.get([m.s.remote(), m.a.remote()], timeout=15)) == ["async", "sync"]
+    assert _t.monotonic() - t0 >= 0.75, "sync+async width-1 group ran 2-wide"
+    ca.kill(m)
+
+
+def test_streaming_method_in_group(ca_cluster_module):
+    """A grouped generator method streams from its group's pool, leaving the
+    default executor free for other methods mid-stream."""
+    import time as _t
+
+    @ca.remote(concurrency_groups={"io": 1})
+    class S:
+        @ca.method(concurrency_group="io")
+        def gen(self, n):
+            for i in range(n):
+                _t.sleep(0.15)
+                yield i
+
+        def ping(self):
+            return "pong"
+
+    s = S.remote()
+    stream = s.gen.options(num_returns="streaming").remote(6)
+    _t.sleep(0.2)  # stream is running now
+    t0 = _t.monotonic()
+    assert ca.get(s.ping.remote(), timeout=10) == "pong"
+    assert _t.monotonic() - t0 < 0.6, "default method blocked behind grouped stream"
+    got = [ca.get(r, timeout=10) for r in stream]
+    assert got == list(range(6))
+    ca.kill(s)
