@@ -28,7 +28,12 @@ class ObjectRef:
         self.owner = owner  # worker/actor address owning the primary copy
         self._worker = worker
         if worker is not None:
-            worker.reference_counter.add_local_ref(self.id)
+            if worker.reference_counter.add_local_ref(self.id) == 1:
+                # a handle came back for an object whose local refs all died
+                # (e.g. returned from an actor): its producing task's lineage
+                # must no longer count it dead, or the spec could be dropped
+                # while this ref still needs it for reconstruction
+                worker.lineage_revive(self.id)
 
     def hex(self) -> str:
         return self.id.hex()

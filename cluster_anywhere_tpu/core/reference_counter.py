@@ -41,12 +41,18 @@ class ReferenceCounter:
         with self._lock:
             self._owned.add(oid)
 
-    def add_local_ref(self, oid: ObjectID):
+    def remove_owned(self, oid: ObjectID):
+        with self._lock:
+            self._owned.discard(oid)
+
+    def add_local_ref(self, oid: ObjectID) -> int:
+        """Returns the new count (1 = this ref revived the object locally)."""
         with self._lock:
             n = self._counts.get(oid, 0)
             self._counts[oid] = n + 1
             if n == 0:
                 self._pending_inc.append(oid.binary())
+            return n + 1
 
     def remove_local_ref(self, oid: ObjectID):
         flush = None

@@ -771,8 +771,39 @@ class Worker:
         e = self.memory_store.get_entry(oid)
         if e is None:
             return
-        if e.shm_name or not self.reference_counter.is_owned(oid):
-            self.memory_store.delete(oid)
+        # Always safe to drop the local entry at local-zero:
+        #  - shm-backed / borrowed: the head (cluster refcount) owns lifetime;
+        #    this is just a read-cache eviction.
+        #  - owned, never promoted to shm: inline-only objects are invisible
+        #    to every other process (escaping refs get promoted by
+        #    _promote_nested), so nothing can ever resolve this oid again —
+        #    retaining it leaked one entry per completed task.
+        self.memory_store.delete(oid)
+        if self.reference_counter.is_owned(oid):
+            self.reference_counter.remove_owned(oid)
+            self.device_objects.pop(oid.binary(), None)
+            # lineage is only useful while some ref could still ask for
+            # reconstruction: when every return object of the producing task
+            # has dropped to zero local refs, the task spec can go too
+            # (otherwise the table pins 8k specs of long-dead tasks)
+            if not oid.is_put():
+                rec = self._lineage.get(oid.task_id().binary())
+                if rec is not None:
+                    dead = rec.setdefault("dead", set())
+                    dead.add(oid)
+                    if len(dead) >= len(rec["oids"]):
+                        self._lineage.pop(oid.task_id().binary(), None)
+
+    def lineage_revive(self, oid: ObjectID):
+        """A new local handle appeared for `oid` (count 0 -> 1): un-mark it
+        dead so its producing task's spec stays reconstruction-eligible."""
+        if oid.is_put():
+            return
+        rec = self._lineage.get(oid.task_id().binary())
+        if rec is not None:
+            d = rec.get("dead")
+            if d is not None:
+                d.discard(oid)
 
     def _make_value_pin(self, oid: ObjectID):
         """Register a value-holder for an arena-backed object and return the
@@ -1051,8 +1082,19 @@ class Worker:
                     if not self._reconstruct_object(a.id, depth + 1):
                         return False
             oids = rec["oids"]
+            reset = []
             for o in oids:
-                self.memory_store.reset_pending(o)
+                # only resurrect siblings somebody can still read — a dead
+                # sibling refilled here would pin an unevictable entry (and
+                # _store_results would refuse to fill it, so waiting on it
+                # below would stall the full push timeout)
+                if (
+                    o == oid
+                    or self.memory_store.get_entry(o) is not None
+                    or self.reference_counter.local_count(o) > 0
+                ):
+                    self.memory_store.reset_pending(o)
+                    reset.append(o)
             task_id = TaskID(tid)
             self._pump_submit(
                 lambda: self._task_entry(
@@ -1061,7 +1103,7 @@ class Worker:
                 )
             )
             ready, not_ready = self.memory_store.wait_ready(
-                oids, len(oids), self.config.push_timeout_s
+                reset, len(reset), self.config.push_timeout_s
             )
             return not not_ready
         finally:
@@ -1504,6 +1546,31 @@ class Worker:
 
     def _store_results(self, oids: List[ObjectID], results: List[dict], exec_addr: str):
         for oid, res in zip(oids, results):
+            if (
+                self.memory_store.get_entry(oid) is None
+                and self.reference_counter.local_count(oid) == 0
+                and oid.task_id().binary() not in self._streams
+            ):
+                # (stream items are exempt: they arrive before the consumer
+                # creates a ref — the StreamState, not a ref count, keeps
+                # them alive until read or the stream is abandoned)
+                # fire-and-forget: every local handle died before the result
+                # arrived (local-zero eviction already ran), so storing would
+                # resurrect an entry nothing can ever read or evict again.
+                # Smuggled refs still need their transit pin released: ack as
+                # holder, then drop the holds we just acquired — but ONLY for
+                # roids with no live local ref (holders is a set at the head,
+                # so a dec here would erase a legitimate concurrent hold)
+                if "t" in res:
+                    self.transit_done(res["t"], res["roids"])
+                    dec = [
+                        r
+                        for r in res["roids"]
+                        if self.reference_counter.local_count(ObjectID(r)) == 0
+                    ]
+                    if dec:
+                        self._notify_threadsafe("obj_refs", inc=[], dec=dec)
+                continue
             if "e" in res:
                 import pickle
 
@@ -1532,6 +1599,11 @@ class Worker:
             elif "dev" in res:
                 e = _Entry("device", value=res.get("spec"), shm_name=res.get("owner", exec_addr))
                 self.memory_store._store(oid, e)
+            if self.reference_counter.local_count(oid) == 0 and not self.reference_counter.is_owned(oid):
+                # the last handle died between the guard above and the store
+                # (eviction already ran and found nothing): drop the entry we
+                # just resurrected
+                self.memory_store.delete(oid)
 
     # ------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, opts: Dict[str, Any]) -> Tuple[ActorID, str]:

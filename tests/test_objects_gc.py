@@ -132,3 +132,55 @@ def test_shm_value_still_readable_while_ref_held(ca_cluster):
     for _ in range(3):
         out = ca.get(ref)
         assert out[-1] == 499_999
+
+
+def test_driver_tables_drain_after_refs_die(ca_cluster):
+    """Owned in-memory results, owned marks, and lineage specs must all be
+    released once their ObjectRefs are garbage collected — a 16k-task run
+    used to pin one memstore entry + owned mark + task spec per task,
+    degrading every later submission (GC scan + dict weight)."""
+    import gc
+
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    @ca.remote
+    def noop():
+        return None
+
+    w = global_worker()
+    ca.get([noop.remote() for _ in range(50)], timeout=60)  # settle pools
+    gc.collect()
+    base = (
+        len(w.memory_store._entries),
+        len(w.reference_counter._owned),
+        len(w._lineage),
+    )
+    refs = [noop.remote() for _ in range(500)]
+    assert ca.get(refs, timeout=60) == [None] * 500
+    # while refs are alive everything is retained (reconstruction possible)
+    assert len(w._lineage) >= 500
+    del refs
+    gc.collect()
+    after = (
+        len(w.memory_store._entries),
+        len(w.reference_counter._owned),
+        len(w._lineage),
+    )
+    assert all(a <= b for a, b in zip(after, base)), (
+        f"driver tables leaked: {base} -> {after}"
+    )
+
+    # fire-and-forget: refs dropped BEFORE results arrive must not resurrect
+    # unevictable entries when the results land
+    for _ in range(200):
+        noop.remote()
+    time.sleep(2.0)  # let all results arrive
+    gc.collect()
+    ff = (
+        len(w.memory_store._entries),
+        len(w.reference_counter._owned),
+        len(w._lineage),
+    )
+    assert all(a <= b for a, b in zip(ff, base)), (
+        f"fire-and-forget resurrected entries: {base} -> {ff}"
+    )

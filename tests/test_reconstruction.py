@@ -156,3 +156,35 @@ def test_borrowed_small_inline_ref(ca_cluster):
     fut = hold.remote([small])
     del small
     assert ca.get(fut, timeout=60).sum() == 200_000.0
+
+
+def test_reconstruct_with_dead_sibling():
+    """Reconstruction of one return of a multi-return task must not stall
+    waiting for a sibling whose refs already died (the dead sibling is
+    neither reset to pending nor refilled by _store_results)."""
+    import gc
+
+    c = Cluster(head_resources={"CPU": 2})
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes(2)
+    try:
+        strat = NodeAffinitySchedulingStrategy(nid, soft=True)
+
+        @ca.remote
+        def pair():
+            return np.full(400_000, 3.0), np.full(400_000, 4.0)
+
+        a, b = pair.options(num_returns=2, scheduling_strategy=strat).remote()
+        ca.wait([a, b], num_returns=2, timeout=60)
+        del b
+        gc.collect()
+        c.remove_node(nid)
+        time.sleep(1.0)
+        t0 = time.monotonic()
+        arr = ca.get(a, timeout=60)
+        assert arr[0] == 3.0
+        # a push_timeout_s (60s) stall on the dead sibling would blow this
+        assert time.monotonic() - t0 < 30
+    finally:
+        c.shutdown()
