@@ -55,6 +55,14 @@ def _sweep_stale_sessions(root: str):
             except (ProcessLookupError, PermissionError):
                 pass
         if not alive:
+            # dead head, but a recently-touched dir may be a cluster mid
+            # head-restart (head FT): leave young sessions alone — a later
+            # init will sweep them once they are genuinely abandoned
+            try:
+                if time.time() - os.path.getmtime(path) < 120:
+                    continue
+            except OSError:
+                continue
             shutil.rmtree(path, ignore_errors=True)
             shutil.rmtree(os.path.join("/dev/shm", name), ignore_errors=True)
 

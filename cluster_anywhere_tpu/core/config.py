@@ -51,6 +51,10 @@ class CAConfig:
     health_check_period_s: float = 2.0
     health_check_failure_threshold: int = 5
     worker_register_timeout_s: float = 30.0
+    # node memory monitor (memory_monitor.h analogue): kill a worker when
+    # node used/total exceeds the threshold; 0 disables the monitor
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 250
 
     # --- tasks / actors ---
     default_max_retries: int = 3

@@ -56,6 +56,7 @@ class NodeRec:
     idle: Dict[str, deque] = field(default_factory=lambda: {"cpu": deque(), "tpu": deque()})
     conn: Optional[Connection] = None  # head -> agent connection
     max_workers: int = 64
+    mem_pressured: bool = False  # agent-reported memory pressure (monitor)
 
     @property
     def is_local(self) -> bool:
@@ -76,6 +77,7 @@ class WorkerRec:
     actor_id: Optional[str] = None
     last_heartbeat: float = field(default_factory=time.monotonic)
     blocked: bool = False  # blocked in get(); its cpus are released
+    busy_since: float = 0.0  # monotonic time the current lease/actor began
 
 
 @dataclass
@@ -106,6 +108,13 @@ class ActorRec:
     # against double-crediting when a PG is removed before the actor's
     # worker-death event is processed
     charged: Optional[str] = None
+
+    @property
+    def can_restart(self) -> bool:
+        """Restart budget remains (max_restarts=-1 means unlimited)."""
+        return self.max_restarts != 0 and (
+            self.max_restarts < 0 or self.restarts_used < self.max_restarts
+        )
 
 
 @dataclass
@@ -217,7 +226,18 @@ class Head:
             "nodes_joined": 0,
             "nodes_died": 0,
             "objects_transferred": 0,
+            "oom_kills": 0,
         }
+        # node memory monitor (memory_monitor.h:52): the head watches its own
+        # node; agents report pressure in heartbeats and the head picks the
+        # victim (worker_killing_policy.h) since only it knows worker state
+        self.mem_monitor = None
+        if config.memory_monitor_refresh_ms > 0 and config.memory_usage_threshold > 0:
+            from .memory_monitor import MemoryMonitor
+
+            self.mem_monitor = MemoryMonitor(config.memory_usage_threshold)
+        self._last_mem_check = 0.0
+        self._last_dir_touch = 0.0
         self._shutdown = asyncio.Event()
         self._driver_clients: set = set()
         # observability: task-event ring buffer (GcsTaskManager analogue) and
@@ -620,6 +640,7 @@ class Head:
                 self._take(node.avail, req.shape)
             lease_id = f"l{os.urandom(6).hex()}"
             rec.state = "leased"
+            rec.busy_since = time.monotonic()
             rec.lease_id = lease_id
             self.leases[lease_id] = wid
             self._lease_shapes[lease_id] = dict(req.shape)
@@ -874,9 +895,7 @@ class Head:
                     if anode is not None and anode.state == "alive":
                         self._give(anode.avail, a.resources)
                 a.charged = None
-                if a.max_restarts != 0 and (
-                    a.max_restarts < 0 or a.restarts_used < a.max_restarts
-                ):
+                if a.can_restart:
                     a.restarts_used += 1
                     a.incarnation += 1
                     a.state = "restarting"
@@ -1081,6 +1100,7 @@ class Head:
             rec.last_heartbeat = time.monotonic()
             if rec.purpose == "actor":
                 rec.state = "actor"
+                rec.busy_since = time.monotonic()
             elif rec.state in ("starting", "idle"):
                 # leased workers reconnecting after a head restart keep their
                 # lease; only fresh/idle ones (re)join the pool
@@ -1147,6 +1167,8 @@ class Head:
         node = self.nodes.get(msg.get("node_id", state.get("node_id")))
         if node is not None:
             node.last_heartbeat = time.monotonic()
+            if "mem_pressured" in msg:
+                node.mem_pressured = bool(msg["mem_pressured"])
 
     async def _h_worker_exit(self, state, msg, reply, reply_err):
         """Node agent reports one of its worker processes exited."""
@@ -1952,6 +1974,66 @@ class Head:
                 cutoff = now - 60.0
                 for tok in [t for t, ts in self._spent_transit.items() if ts < cutoff]:
                     del self._spent_transit[tok]
+            if (
+                self.mem_monitor is not None
+                and now - self._last_mem_check
+                >= self.config.memory_monitor_refresh_ms / 1000.0
+            ):
+                self._last_mem_check = now
+                self._memory_pressure_check()
+            if now - self._last_dir_touch > 30.0:
+                # liveness marker: concurrent inits skip sweeping session
+                # dirs with a recent mtime, protecting idle clusters and the
+                # head-restart window from _sweep_stale_sessions
+                self._last_dir_touch = now
+                try:
+                    os.utime(self.session_dir)
+                except OSError:
+                    pass
+
+    def _memory_pressure_check(self):
+        """Kill at most one worker per pressured node per refresh period
+        (worker_killing_policy.h).  The retry/restart machinery turns the
+        SIGKILL into a task retry or actor restart downstream."""
+        from . import memory_monitor as mm
+
+        for node in self.nodes.values():
+            if node.state != "alive":
+                continue
+            if node.is_local:
+                if not self.mem_monitor.is_pressured():
+                    continue
+            elif node.mem_pressured:
+                node.mem_pressured = False  # re-armed by the next heartbeat
+            else:
+                continue
+            cands = []
+            for rec in self.workers.values():
+                if rec.node_id != node.node_id or rec.state not in (
+                    "idle",
+                    "leased",
+                    "actor",
+                ):
+                    continue
+                a = self.actors.get(rec.actor_id) if rec.actor_id else None
+                cands.append(mm.Candidate(
+                    worker=rec,
+                    is_idle=rec.state == "idle",
+                    retriable=rec.state == "leased"
+                    or (a is not None and a.can_restart),
+                    busy_since=rec.busy_since,
+                ))
+            victim = mm.pick_victim(cands)
+            if victim is None:
+                continue
+            self.stats["oom_kills"] += 1
+            self._log_event(
+                "worker_oom_killed",
+                worker_id=victim.worker_id,
+                node_id=node.node_id,
+                state=victim.state,
+            )
+            self._kill_worker_rec(victim)
 
     async def run(self):
         try:

@@ -47,6 +47,11 @@ class NodeAgent:
         self.shm_ns_dir = os.path.join("/dev/shm", self.session_name, self.node_id)
         os.makedirs(self.shm_ns_dir, exist_ok=True)
         self.server = Server([self.serve_addr_spec], self._handle)
+        self.mem_monitor = None
+        if self.config.memory_monitor_refresh_ms > 0 and self.config.memory_usage_threshold > 0:
+            from .memory_monitor import MemoryMonitor
+
+            self.mem_monitor = MemoryMonitor(self.config.memory_usage_threshold)
         self.head = None
         self.procs: Dict[str, subprocess.Popen] = {}  # wid -> proc
         self._pull_maps: Dict[str, Any] = {}
@@ -144,7 +149,10 @@ class NodeAgent:
         while not self._shutdown.is_set():
             await asyncio.sleep(min(period, 1.0))
             try:
-                self.head.notify("node_heartbeat", node_id=self.node_id)
+                hb = {"node_id": self.node_id}
+                if self.mem_monitor is not None:
+                    hb["mem_pressured"] = self.mem_monitor.is_pressured()
+                self.head.notify("node_heartbeat", **hb)
             except Exception:
                 pass
             # reap exited worker processes and report them (the head cannot
