@@ -1,0 +1,183 @@
+"""TPU accelerator manager: chip/topology detection feeding the resource model.
+
+Reference parity: ``python/ray/_private/accelerators/tpu.py:70``
+(TPUAcceleratorManager) and ``python/ray/util/accelerators/tpu.py`` (pod
+helpers).  Detection is env/device-file driven and never calls a metadata
+service (zero-egress environments) — a GKE/GCE-style deployment sets the
+standard ``TPU_*`` variables, a bare libtpu host exposes ``/dev/accel*``, and
+the axon dev tunnel advertises ``PALLAS_AXON_TPU_GEN``.
+
+Detected topology surfaces as schedulable resources at ``init``:
+  TPU                  chips on this host (the reference's TPU resource)
+  TPU-<GEN>            accelerator-type marker, e.g. TPU-V5E (1 per chip)
+  TPU-<pod_type>-head  exactly one, on worker 0 of a pod slice — lets a
+                       driver pin one task per pod for SPMD launch
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+RESOURCE_NAME = "TPU"
+VALID_CHIP_REQUESTS = (1, 2, 4, 8)  # whole-host or sub-host chip groups
+
+VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+NOSET_VISIBLE_CHIPS_ENV = "CA_EXPERIMENTAL_NOSET_TPU_VISIBLE_CHIPS"
+ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v5e-16" (pod type)
+CHIPS_PER_HOST_BOUNDS_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"  # e.g. "2,2,1"
+HOST_BOUNDS_ENV = "TPU_HOST_BOUNDS"
+WORKER_ID_ENV = "TPU_WORKER_ID"
+POD_NAME_ENV = "TPU_NAME"
+_AXON_GEN_ENV = "PALLAS_AXON_TPU_GEN"  # dev tunnel: one chip of this gen
+
+
+def visible_chip_ids() -> Optional[list]:
+    """Chip ids this process may use, or None when unrestricted
+    (get_current_process_visible_accelerator_ids analogue)."""
+    v = os.environ.get(VISIBLE_CHIPS_ENV)
+    if v is None or v == "":
+        return None
+    return [s for s in v.split(",") if s != ""]
+
+
+def num_tpu_chips() -> int:
+    """TPU chips on this host.  Priority: visible-chips restriction, explicit
+    host-bounds env, /dev/accel* device files, axon dev-tunnel marker."""
+    vis = visible_chip_ids()
+    if vis is not None:
+        return len(vis)
+    bounds = os.environ.get(CHIPS_PER_HOST_BOUNDS_ENV)
+    if bounds:
+        try:
+            n = 1
+            for part in bounds.split(","):
+                n *= int(part)
+            return n
+        except ValueError:
+            pass
+    dev = glob.glob("/dev/accel*")
+    if dev:
+        return len(dev)
+    if os.environ.get(_AXON_GEN_ENV):
+        return 1
+    return 0
+
+
+def pod_type() -> Optional[str]:
+    """TPU pod/slice type, e.g. "v5e-16" (_get_current_node_tpu_pod_type)."""
+    t = os.environ.get(ACCELERATOR_TYPE_ENV)
+    if t:
+        return t
+    gen = os.environ.get(_AXON_GEN_ENV)
+    if gen:
+        return f"{gen}-{max(num_tpu_chips(), 1)}"
+    return None
+
+
+def accelerator_type() -> Optional[str]:
+    """Marker-resource name, e.g. "TPU-V5E" (get_current_node_accelerator_type)."""
+    t = pod_type()
+    if not t:
+        return None
+    return "TPU-" + t.split("-")[0].upper()
+
+
+def worker_id() -> Optional[int]:
+    v = os.environ.get(WORKER_ID_ENV)
+    try:
+        return int(v) if v is not None else None
+    except ValueError:
+        return None
+
+
+def pod_name() -> Optional[str]:
+    return os.environ.get(POD_NAME_ENV)
+
+
+def _cores_per_chip(gen: str) -> int:
+    # pod-type suffixes count TensorCores on v2-v4/v5p (2 per chip) but
+    # chips on the single-core-per-chip efficiency gens (v5e/v6e)
+    return 1 if gen in ("v5e", "v5litepod", "v6e") else 2
+
+
+def num_workers_in_pod() -> Optional[int]:
+    """Hosts in this pod slice = slice cores-or-chips / per-host equivalent
+    (get_num_workers_in_current_tpu_pod analogue)."""
+    t = pod_type()
+    per_host = num_tpu_chips()
+    if not t or per_host <= 0:
+        return None
+    try:
+        gen, suffix = t.split("-")[0], int(t.split("-")[1])
+    except (IndexError, ValueError):
+        return None
+    return max(1, suffix // (per_host * _cores_per_chip(gen)))
+
+
+def validate_chip_request(n: float) -> None:
+    """TPU requests must be 1/2/4/8 chips (ICI-connected groups) or a
+    positive fraction <1 of one chip (validate_resource_request_quantity)."""
+    if n <= 0:
+        raise ValueError(f"TPU request must be positive, got {n}")
+    if n < 1:
+        return
+    if n != int(n) or int(n) not in VALID_CHIP_REQUESTS:
+        raise ValueError(
+            f"TPU request of {n} is invalid: whole-chip requests must be one "
+            f"of {VALID_CHIP_REQUESTS} (chips in an ICI-connected group)"
+        )
+
+
+class ChipAllocator:
+    """Per-host chip assignment for spawned TPU workers.
+
+    Least-loaded assignment: 1:1 pinning while workers <= chips, and stable
+    sharing (never an unrestricted view) once fractional requests oversubscribe
+    a chip.  Honors a parent process's TPU_VISIBLE_CHIPS restriction — ids are
+    drawn from that set, not range(n).
+    """
+
+    def __init__(self, n_chips: int):
+        vis = visible_chip_ids()
+        ids = vis if vis is not None else [str(i) for i in range(max(n_chips, 0))]
+        self._load: Dict[str, int] = {cid: 0 for cid in ids}
+
+    def acquire(self) -> Optional[str]:
+        if not self._load:
+            return None
+        cid = min(self._load, key=lambda c: (self._load[c], c))
+        self._load[cid] += 1
+        return cid
+
+    def release(self, cid: Optional[str]) -> None:
+        if cid is not None and self._load.get(cid, 0) > 0:
+            self._load[cid] -= 1
+
+
+def additional_resources() -> Dict[str, float]:
+    """Topology-derived resources beyond the TPU chip count: the
+    accelerator-type marker and, on worker 0 only, the pod-head resource
+    (get_current_node_additional_resources analogue)."""
+    out: Dict[str, float] = {}
+    chips = num_tpu_chips()
+    if chips <= 0:
+        return out
+    at = accelerator_type()
+    if at:
+        out[at] = float(chips)
+    pt = pod_type()
+    wid = worker_id()
+    if pt and (wid == 0 or (wid is None and os.environ.get(_AXON_GEN_ENV))):
+        out[f"TPU-{pt}-head"] = 1.0
+    return out
+
+
+def visible_chips_env_for_worker(chip_id) -> Dict[str, str]:
+    """Env a spawned TPU-pool worker should receive to pin it to one chip
+    (set_current_process_visible_accelerator_ids analogue).  Empty when
+    pinning is disabled or no chip was assigned."""
+    if chip_id is None or os.environ.get(NOSET_VISIBLE_CHIPS_ENV):
+        return {}
+    return {VISIBLE_CHIPS_ENV: str(chip_id)}

@@ -131,6 +131,11 @@ class ActorClass:
         unknown = set(self._default_options) - _VALID_ACTOR_OPTIONS
         if unknown:
             raise ValueError(f"unknown actor option(s): {sorted(unknown)}")
+        nt = self._default_options.get("num_tpus")
+        if nt:
+            from .accelerators import validate_chip_request
+
+            validate_chip_request(float(nt))
         self.__name__ = getattr(cls, "__name__", "ActorClass")
 
     def remote(self, *args, **kwargs) -> ActorHandle:

@@ -148,11 +148,20 @@ def init(
     if num_cpus is None:
         num_cpus = min(os.cpu_count() or 4, 16)
     total: Dict[str, float] = {"CPU": float(num_cpus)}
+    from . import accelerators
+
     if num_tpus is None:
-        # detect TPU chips without importing jax (env marker or /dev entries)
-        num_tpus = int(os.environ.get("CA_NUM_TPUS", "0"))
+        # detect TPU chips without importing jax (env markers or /dev/accel*;
+        # accelerators.py = tpu.py TPUAcceleratorManager analogue)
+        num_tpus = int(
+            os.environ.get("CA_NUM_TPUS") or accelerators.num_tpu_chips()
+        )
     if num_tpus:
         total["TPU"] = float(num_tpus)
+        # topology-derived markers: accelerator type (TPU-V5E) and, on pod
+        # worker 0, the pod-head resource (TPU-v5e-16-head) for SPMD pinning
+        for k, v in accelerators.additional_resources().items():
+            total.setdefault(k, v)
     total["memory"] = float(cfg.object_store_memory)
     if resources:
         total.update({k: float(v) for k, v in resources.items()})

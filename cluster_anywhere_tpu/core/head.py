@@ -78,6 +78,7 @@ class WorkerRec:
     last_heartbeat: float = field(default_factory=time.monotonic)
     blocked: bool = False  # blocked in get(); its cpus are released
     busy_since: float = 0.0  # monotonic time the current lease/actor began
+    tpu_chip: Optional[int] = None  # pinned chip id (multi-chip hosts only)
 
 
 @dataclass
@@ -178,6 +179,14 @@ class Head:
         self.nodes: Dict[str, NodeRec] = {}
         self._node_index = 0
         self._add_node(NodeRec(LOCAL_NODE, None, dict(resources), dict(resources)))
+        # chip allocator for TPU-worker pinning; active only on multi-chip
+        # hosts (a single chip needs no TPU_VISIBLE_CHIPS restriction)
+        n_chips = int(resources.get("TPU", 0))
+        self._chip_alloc = None
+        if n_chips > 1:
+            from .accelerators import ChipAllocator
+
+            self._chip_alloc = ChipAllocator(n_chips)
         # -- tables --
         self.workers: Dict[str, WorkerRec] = {}
         self.actors: Dict[str, ActorRec] = {}
@@ -499,6 +508,15 @@ class Head:
             # and pin jax to the host platform if user code imports it.
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["JAX_PLATFORMS"] = "cpu"
+        chip = None
+        if pool == "tpu" and self._chip_alloc is not None:
+            # pin each TPU worker to one chip (set_current_process_visible_
+            # accelerator_ids analogue) so concurrent workers don't fight
+            # over the device; single-chip hosts leave the env untouched
+            from . import accelerators
+
+            chip = self._chip_alloc.acquire()
+            env.update(accelerators.visible_chips_env_for_worker(chip))
         logf = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "cluster_anywhere_tpu.core.workerproc"],
@@ -509,7 +527,8 @@ class Head:
         )
         logf.close()
         rec = WorkerRec(
-            worker_id=wid, pid=proc.pid, addr=addr, proc=proc, purpose=purpose, pool=pool
+            worker_id=wid, pid=proc.pid, addr=addr, proc=proc, purpose=purpose, pool=pool,
+            tpu_chip=chip,
         )
         self.workers[wid] = rec
         self.stats["workers_spawned"] += 1
@@ -865,6 +884,10 @@ class Head:
                 node.idle[rec.pool].remove(rec.worker_id)
             except ValueError:
                 pass
+        if rec.tpu_chip is not None:
+            if self._chip_alloc is not None:
+                self._chip_alloc.release(rec.tpu_chip)
+            rec.tpu_chip = None
         if rec.blocked:
             # its cpus were returned to the pool at block time; take them back
             # before the lease/actor release re-adds them (double-free guard)

@@ -47,6 +47,13 @@ class NodeAgent:
         self.shm_ns_dir = os.path.join("/dev/shm", self.session_name, self.node_id)
         os.makedirs(self.shm_ns_dir, exist_ok=True)
         self.server = Server([self.serve_addr_spec], self._handle)
+        # chip pinning for this node's TPU workers (same policy as the head's
+        # local node; the agent owns spawns here, so it owns the allocator)
+        from .accelerators import ChipAllocator
+
+        n_chips = int(self.resources.get("TPU", 0))
+        self.chip_alloc = ChipAllocator(n_chips) if n_chips > 1 else None
+        self._worker_chips: Dict[str, str] = {}
         self.mem_monitor = None
         if self.config.memory_monitor_refresh_ms > 0 and self.config.memory_usage_threshold > 0:
             from .memory_monitor import MemoryMonitor
@@ -70,6 +77,13 @@ class NodeAgent:
         if pool != "tpu":
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["JAX_PLATFORMS"] = "cpu"
+        elif self.chip_alloc is not None:
+            from .accelerators import visible_chips_env_for_worker
+
+            chip = self.chip_alloc.acquire()
+            if chip is not None:
+                self._worker_chips[wid] = chip
+                env.update(visible_chips_env_for_worker(chip))
         log_path = os.path.join(self.node_dir, f"{wid}.log")
         logf = open(log_path, "ab")
         proc = subprocess.Popen(
@@ -160,6 +174,8 @@ class NodeAgent:
             for wid, proc in list(self.procs.items()):
                 if proc.poll() is not None:
                     del self.procs[wid]
+                    if self.chip_alloc is not None:
+                        self.chip_alloc.release(self._worker_chips.pop(wid, None))
                     try:
                         self.head.notify("worker_exit", wid=wid)
                     except Exception:

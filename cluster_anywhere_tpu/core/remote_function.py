@@ -36,6 +36,11 @@ def _check_options(opts: Dict[str, Any]):
     nr = opts.get("num_returns")
     if nr is not None and nr != "streaming" and (not isinstance(nr, int) or nr < 1):
         raise ValueError('num_returns must be a positive int or "streaming"')
+    nt = opts.get("num_tpus")
+    if nt:
+        from .accelerators import validate_chip_request
+
+        validate_chip_request(float(nt))
 
 
 def _normalize_pg(opts: Dict[str, Any]) -> Dict[str, Any]:

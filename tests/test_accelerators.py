@@ -1,0 +1,132 @@
+"""TPU accelerator manager: topology detection feeding the resource model
+(_private/accelerators/tpu.py:70 TPUAcceleratorManager analogue)."""
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.core import accelerators as acc
+
+
+@pytest.fixture
+def clean_tpu_env(monkeypatch):
+    for var in (
+        acc.VISIBLE_CHIPS_ENV,
+        acc.ACCELERATOR_TYPE_ENV,
+        acc.CHIPS_PER_HOST_BOUNDS_ENV,
+        acc.WORKER_ID_ENV,
+        acc.POD_NAME_ENV,
+        "PALLAS_AXON_TPU_GEN",
+        "CA_NUM_TPUS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch
+
+
+def test_chip_count_sources(clean_tpu_env):
+    m = clean_tpu_env
+    m.setenv(acc.CHIPS_PER_HOST_BOUNDS_ENV, "2,2,1")
+    assert acc.num_tpu_chips() == 4
+    # visible-chips restriction wins over host bounds
+    m.setenv(acc.VISIBLE_CHIPS_ENV, "0,1")
+    assert acc.num_tpu_chips() == 2
+    assert acc.visible_chip_ids() == ["0", "1"]
+
+
+def test_axon_dev_tunnel_counts_one_chip(clean_tpu_env):
+    clean_tpu_env.setenv("PALLAS_AXON_TPU_GEN", "v5e")
+    assert acc.num_tpu_chips() == 1
+    assert acc.pod_type() == "v5e-1"
+    assert acc.accelerator_type() == "TPU-V5E"
+
+
+def test_pod_topology(clean_tpu_env):
+    m = clean_tpu_env
+    m.setenv(acc.ACCELERATOR_TYPE_ENV, "v5e-16")
+    m.setenv(acc.CHIPS_PER_HOST_BOUNDS_ENV, "2,2,1")
+    m.setenv(acc.WORKER_ID_ENV, "0")
+    m.setenv(acc.POD_NAME_ENV, "mypod")
+    assert acc.pod_type() == "v5e-16"
+    assert acc.accelerator_type() == "TPU-V5E"
+    assert acc.num_workers_in_pod() == 4  # 16 chips / 4 per host
+    assert acc.pod_name() == "mypod"
+    extra = acc.additional_resources()
+    assert extra["TPU-V5E"] == 4.0
+    assert extra["TPU-v5e-16-head"] == 1.0
+    # workers other than 0 don't carry the pod-head resource
+    m.setenv(acc.WORKER_ID_ENV, "2")
+    assert "TPU-v5e-16-head" not in acc.additional_resources()
+
+
+def test_v4_pod_counts_cores(clean_tpu_env):
+    m = clean_tpu_env
+    m.setenv(acc.ACCELERATOR_TYPE_ENV, "v4-16")  # 16 TensorCores = 8 chips
+    m.setenv(acc.CHIPS_PER_HOST_BOUNDS_ENV, "2,2,1")  # 4 chips/host
+    assert acc.num_workers_in_pod() == 2
+
+
+def test_validate_chip_request():
+    for ok in (1, 2, 4, 8, 0.5):
+        acc.validate_chip_request(ok)
+    for bad in (3, 5, 16, 1.5):
+        with pytest.raises(ValueError):
+            acc.validate_chip_request(bad)
+    with pytest.raises(ValueError):
+        @ca.remote(num_tpus=3)
+        def f():
+            pass
+
+
+def test_visible_chips_env_for_worker(clean_tpu_env):
+    assert acc.visible_chips_env_for_worker(2) == {acc.VISIBLE_CHIPS_ENV: "2"}
+    assert acc.visible_chips_env_for_worker(None) == {}
+    clean_tpu_env.setenv(acc.NOSET_VISIBLE_CHIPS_ENV, "1")
+    assert acc.visible_chips_env_for_worker(2) == {}
+
+
+def test_init_detects_topology_resources(clean_tpu_env):
+    m = clean_tpu_env
+    m.setenv(acc.ACCELERATOR_TYPE_ENV, "v5e-8")
+    m.setenv(acc.CHIPS_PER_HOST_BOUNDS_ENV, "2,2,1")
+    m.setenv(acc.WORKER_ID_ENV, "0")
+    if ca.is_initialized():
+        ca.shutdown()
+    info = ca.init(num_cpus=2)
+    try:
+        res = info["resources"]
+        assert res["TPU"] == 4.0
+        assert res["TPU-V5E"] == 4.0
+        assert res["TPU-v5e-8-head"] == 1.0
+    finally:
+        ca.shutdown()
+
+
+def test_validate_rejects_nonpositive_and_actor_path():
+    with pytest.raises(ValueError):
+        acc.validate_chip_request(-2)
+    with pytest.raises(ValueError):
+        acc.validate_chip_request(0)
+    with pytest.raises(ValueError):
+        @ca.remote(num_tpus=3)
+        class A:
+            pass
+    with pytest.raises(ValueError):
+        @ca.remote
+        class B:
+            pass
+        B.options(num_tpus=-1)
+
+
+def test_chip_allocator(clean_tpu_env):
+    alloc = acc.ChipAllocator(2)
+    a, b = alloc.acquire(), alloc.acquire()
+    assert {a, b} == {"0", "1"}
+    # oversubscription shares the least-loaded chip, never returns None
+    c = alloc.acquire()
+    assert c in ("0", "1")
+    alloc.release(c)
+    alloc.release(a)
+    assert alloc.acquire() == a  # freed chip is reused first
+    # honors a parent visible-chips restriction
+    clean_tpu_env.setenv(acc.VISIBLE_CHIPS_ENV, "4,5")
+    alloc2 = acc.ChipAllocator(2)
+    assert {alloc2.acquire(), alloc2.acquire()} == {"4", "5"}
