@@ -10,7 +10,7 @@ AlgorithmConfig, EnvRunner actors, jax Learners; PPO + DQN + IMPALA).
 
 from .algorithm import Algorithm, AlgorithmConfig
 from .buffer import ReplayBuffer
-from .env import CartPole, Env, VectorEnv, make_env, register_env
+from .env import CartPole, Env, Pendulum, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
 from .learner import DQNLearner, IMPALALearner, PPOLearner, compute_gae
 from .module import DiscretePolicyModule, QModule
@@ -20,6 +20,7 @@ __all__ = [
     "AlgorithmConfig",
     "Env",
     "CartPole",
+    "Pendulum",
     "VectorEnv",
     "make_env",
     "register_env",

@@ -43,6 +43,9 @@ class AlgorithmConfig:
         self.epsilon_decay = 0.99
         self.min_epsilon = 0.05
         self.updates_per_iteration = 32
+        # sac
+        self.tau = 0.005
+        self.target_entropy = None  # default: -action_dim
 
     def environment(self, env) -> "AlgorithmConfig":
         self.env = env
@@ -69,13 +72,21 @@ class Algorithm:
         self.config = config
         probe = make_env(config.env)
         obs_dim, num_actions = probe.observation_dim, probe.num_actions
-        kind = "policy" if config.algo in ("PPO", "IMPALA") else "q"
+        if config.algo == "SAC":
+            kind = "gaussian"
+        elif config.algo in ("PPO", "IMPALA"):
+            kind = "policy"
+        else:
+            kind = "q"
         module_spec = {
             "kind": kind,
             "obs_dim": obs_dim,
             "num_actions": num_actions,
             "hidden": config.hidden,
         }
+        if kind == "gaussian":
+            module_spec["action_dim"] = probe.action_dim
+            module_spec["action_scale"] = getattr(probe, "action_scale", 1.0)
         if config.algo == "PPO":
             self.module = DiscretePolicyModule(obs_dim, num_actions, config.hidden)
             self.learner = PPOLearner(
@@ -110,6 +121,28 @@ class Algorithm:
             )
             self.buffer = ReplayBuffer(config.buffer_capacity, obs_dim, config.seed)
             self.epsilon = 1.0
+        elif config.algo == "SAC":
+            from .buffer import ReplayBuffer
+            from .learner import SACLearner
+            from .module import SquashedGaussianModule, TwinQModule
+
+            self.module = SquashedGaussianModule(
+                obs_dim, probe.action_dim,
+                getattr(probe, "action_scale", 1.0), config.hidden,
+            )
+            self.learner = SACLearner(
+                self.module,
+                TwinQModule(obs_dim, probe.action_dim, config.hidden),
+                lr=config.lr,
+                gamma=config.gamma,
+                tau=config.tau,
+                target_entropy=config.target_entropy,
+                seed=config.seed,
+            )
+            self.buffer = ReplayBuffer(
+                config.buffer_capacity, obs_dim, config.seed,
+                action_dim=probe.action_dim,
+            )
         else:
             raise ValueError(f"unknown algo {config.algo!r}")
         # resolve string env names to their creator callable here: the
@@ -226,9 +259,12 @@ class Algorithm:
                 T, N = ro["rewards"].shape
                 obs = ro["obs"]
                 next_obs = np.concatenate([obs[1:], ro["next_obs"][None]], axis=0)
+                acts = ro["actions"]
+                # continuous actions are [T, N, A]; discrete are [T, N]
+                acts = acts.reshape(T * N, -1) if acts.ndim == 3 else acts.reshape(-1)
                 self.buffer.add_batch(
                     obs.reshape(T * N, -1),
-                    ro["actions"].reshape(-1),
+                    acts,
                     ro["rewards"].reshape(-1),
                     ro["dones"].reshape(-1).astype(np.float32),
                     next_obs.reshape(T * N, -1),
@@ -237,7 +273,8 @@ class Algorithm:
             if len(self.buffer) >= cfg.train_batch_size:
                 for _ in range(cfg.updates_per_iteration):
                     stats = self.learner.update(self.buffer.sample(cfg.train_batch_size))
-            self.epsilon = max(cfg.min_epsilon, self.epsilon * cfg.epsilon_decay)
+            if cfg.algo == "DQN":
+                self.epsilon = max(cfg.min_epsilon, self.epsilon * cfg.epsilon_decay)
         self._broadcast()
         self.iteration += 1
         metrics.update(stats)

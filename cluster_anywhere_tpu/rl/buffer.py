@@ -9,11 +9,17 @@ import numpy as np
 
 
 class ReplayBuffer:
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: int = 0):
+        """action_dim=0 stores scalar int actions (DQN); >0 stores float
+        action vectors of that width (SAC)."""
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        if action_dim > 0:
+            self.actions = np.zeros((capacity, action_dim), np.float32)
+        else:
+            self.actions = np.zeros(capacity, np.int32)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.float32)
         self.idx = 0

@@ -74,7 +74,59 @@ class CartPole(Env):
         return self.state.astype(np.float32), 1.0, terminated, {}
 
 
-_ENV_REGISTRY: Dict[str, Callable[[], Env]] = {"CartPole-v1": CartPole}
+class Pendulum(Env):
+    """Classic control Pendulum-v1 dynamics: continuous torque in
+    [-max_torque, max_torque] swings the pole upright.  The continuous-action
+    counterpart of CartPole for SAC coverage (rllib/algorithms/sac trains on
+    exactly this family)."""
+
+    observation_dim = 3
+    num_actions = 0  # continuous
+    continuous = True
+    action_dim = 1
+    action_scale = 2.0  # torque bound
+    max_steps = 200
+
+    def __init__(self):
+        self.g, self.m, self.length = 10.0, 1.0, 1.0
+        self.dt = 0.05
+        self.max_speed = 8.0
+        self.rng = np.random.default_rng()
+        self.th = 0.0
+        self.thdot = 0.0
+        self.steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array(
+            [np.cos(self.th), np.sin(self.th), self.thdot], np.float32
+        )
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.th = self.rng.uniform(-np.pi, np.pi)
+        self.thdot = self.rng.uniform(-1.0, 1.0)
+        self.steps = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -self.action_scale, self.action_scale))
+        th_norm = ((self.th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm**2 + 0.1 * self.thdot**2 + 0.001 * u**2
+        self.thdot = self.thdot + (
+            3 * self.g / (2 * self.length) * np.sin(self.th)
+            + 3.0 / (self.m * self.length**2) * u
+        ) * self.dt
+        self.thdot = float(np.clip(self.thdot, -self.max_speed, self.max_speed))
+        self.th = self.th + self.thdot * self.dt
+        self.steps += 1
+        return self._obs(), -float(cost), self.steps >= self.max_steps, {}
+
+
+_ENV_REGISTRY: Dict[str, Callable[[], Env]] = {
+    "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
+}
 
 
 def register_env(name: str, creator: Callable[[], Env]):
@@ -98,6 +150,7 @@ class VectorEnv:
         self.obs = np.stack([e.reset(seed + i) for i, e in enumerate(self.envs)])
         self.episode_returns = np.zeros(num_envs)
         self.completed_returns: list = []
+        self.continuous = bool(getattr(self.envs[0], "continuous", False))
 
     @property
     def num_envs(self) -> int:
@@ -106,7 +159,7 @@ class VectorEnv:
     def step(self, actions: np.ndarray):
         obs, rewards, dones = [], [], []
         for i, (e, a) in enumerate(zip(self.envs, actions)):
-            o, r, d, _ = e.step(int(a))
+            o, r, d, _ = e.step(a if self.continuous else int(a))
             self.episode_returns[i] += r
             if d:
                 self.completed_returns.append(self.episode_returns[i])

@@ -31,6 +31,14 @@ class EnvRunner:
                 module_spec["obs_dim"], module_spec["num_actions"],
                 module_spec.get("hidden", (64, 64)),
             )
+        elif kind == "gaussian":
+            from .module import SquashedGaussianModule
+
+            self.module = SquashedGaussianModule(
+                module_spec["obs_dim"], module_spec["action_dim"],
+                module_spec.get("action_scale", 1.0),
+                module_spec.get("hidden", (64, 64)),
+            )
         else:
             self.module = QModule(
                 module_spec["obs_dim"], module_spec["num_actions"],
@@ -41,10 +49,17 @@ class EnvRunner:
         self.rng = np.random.default_rng(seed + 1)
         self.explore = explore
         self.epsilon = 1.0
-        self._jit_logits = jax.jit(
-            self.module.logits if kind == "policy" else self.module.q_values
-        )
-        self._jit_value = jax.jit(self.module.value) if kind == "policy" else None
+        if kind == "gaussian":
+            self._sample_key = jax.random.key(seed + 2)
+            self._jit_sample = jax.jit(self.module.sample)
+            self._jit_mean = jax.jit(self.module.mean_action)
+            self._jit_logits = None
+            self._jit_value = None
+        else:
+            self._jit_logits = jax.jit(
+                self.module.logits if kind == "policy" else self.module.q_values
+            )
+            self._jit_value = jax.jit(self.module.value) if kind == "policy" else None
 
     def set_weights(self, params, epsilon: Optional[float] = None):
         self.params = params
@@ -60,8 +75,16 @@ class EnvRunner:
         obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
         for _ in range(num_steps):
             obs = self.vec.obs
-            out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs)))
-            if self.kind == "policy":
+            if self.kind == "gaussian":
+                import jax
+
+                self._sample_key, k = jax.random.split(self._sample_key)
+                act, _ = self._jit_sample(self.params, jnp.asarray(obs), k)
+                actions = np.asarray(act, np.float32)
+                logp = np.zeros(len(actions), np.float32)
+                values = np.zeros(len(actions), np.float32)
+            elif self.kind == "policy":
+                out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs)))
                 z = out - out.max(-1, keepdims=True)
                 p = np.exp(z)
                 p /= p.sum(-1, keepdims=True)
@@ -74,6 +97,7 @@ class EnvRunner:
                 logp = np.log(p[np.arange(len(actions)), actions] + 1e-9)
                 values = np.asarray(self._jit_value(self.params, jnp.asarray(obs)))
             else:  # epsilon-greedy over q-values
+                out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs)))
                 greedy = out.argmax(-1)
                 rand = self.rng.integers(0, out.shape[-1], size=len(greedy))
                 mask = self.rng.random(len(greedy)) < self.epsilon
@@ -88,7 +112,9 @@ class EnvRunner:
             logp_l.append(logp)
             val_l.append(values)
         # bootstrap value of the final obs (PPO/GAE)
-        if self.kind == "policy":
+        if self.kind == "gaussian":
+            last_values = np.zeros(self.vec.num_envs, np.float32)
+        elif self.kind == "policy":
             last_values = np.asarray(
                 self._jit_value(self.params, jnp.asarray(self.vec.obs))
             )
@@ -118,8 +144,14 @@ class EnvRunner:
             obs = env.reset(seed=1000 + ep)
             done, ret = False, 0.0
             while not done:
-                out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs[None])))
-                obs, r, done, _ = env.step(int(out[0].argmax()))
+                if self.kind == "gaussian":
+                    a = np.asarray(self._jit_mean(self.params, jnp.asarray(obs[None])))[0]
+                    obs, r, done, _ = env.step(a)
+                else:
+                    out = np.asarray(
+                        self._jit_logits(self.params, jnp.asarray(obs[None]))
+                    )
+                    obs, r, done, _ = env.step(int(out[0].argmax()))
                 ret += r
             total += ret
         return total / num_episodes

@@ -268,3 +268,106 @@ def compute_gae(rollout: Dict[str, np.ndarray], gamma: float, lam: float):
     adv_flat = adv.reshape(-1)
     adv_flat = (adv_flat - adv_flat.mean()) / (adv_flat.std() + 1e-8)
     return adv_flat, returns.reshape(-1)
+
+
+class SACLearner:
+    """Soft actor-critic with clipped double-Q, polyak target critics, and
+    automatic entropy-temperature tuning (reference rllib/algorithms/sac/;
+    the whole update — critics, actor, alpha, target polyak — is one compiled
+    XLA program per batch)."""
+
+    def __init__(
+        self,
+        policy_module,
+        q_module,
+        *,
+        lr: float = 3e-4,
+        gamma: float = 0.99,
+        tau: float = 0.005,
+        target_entropy: float = None,
+        seed: int = 0,
+    ):
+        import optax
+
+        self.policy = policy_module
+        self.qnet = q_module
+        self.gamma = gamma
+        self.tau = tau
+        if target_entropy is None:
+            target_entropy = -float(policy_module.action_dim)
+        self.target_entropy = target_entropy
+        kp, kq = jax.random.split(jax.random.key(seed))
+        self.params = {
+            **policy_module.init(kp),
+            **q_module.init(kq),
+            "log_alpha": jnp.zeros(()),
+        }
+        self.target = {k: self.params[k] for k in ("q1", "q2")}
+        self.opt = optax.adam(lr)
+        self.opt_state = self.opt.init(self.params)
+        self._key = jax.random.key(seed + 1)
+
+        def losses(params, target, batch, k1, k2):
+            alpha = jnp.exp(params["log_alpha"])
+            # critic targets from the frozen nets + current policy (actions
+            # are env-scaled on both the buffer and the sampler side)
+            next_act, next_logp = policy_module.sample(params, batch["next_obs"], k1)
+            tq1, tq2 = q_module.q(target, batch["next_obs"], next_act)
+            soft_v = jnp.minimum(tq1, tq2) - jax.lax.stop_gradient(alpha) * next_logp
+            y = batch["rewards"] + self.gamma * (1.0 - batch["dones"]) * soft_v
+            y = jax.lax.stop_gradient(y)
+            q1, q2 = q_module.q(params, batch["obs"], batch["actions"])
+            critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+            # actor: maximize min-Q of a fresh sample minus entropy cost
+            act, logp = policy_module.sample(params, batch["obs"], k2)
+            pq1, pq2 = q_module.q(
+                jax.lax.stop_gradient({k: params[k] for k in ("q1", "q2")}),
+                batch["obs"],
+                act,
+            )
+            actor_loss = jnp.mean(
+                jax.lax.stop_gradient(alpha) * logp - jnp.minimum(pq1, pq2)
+            )
+            # temperature: drive policy entropy toward the target
+            alpha_loss = -jnp.mean(
+                params["log_alpha"] * jax.lax.stop_gradient(logp + self.target_entropy)
+            )
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "alpha": alpha,
+                "entropy": -jnp.mean(logp),
+            }
+
+        def update_step(params, target, opt_state, batch, key):
+            key, k1, k2 = jax.random.split(key, 3)
+            (_, aux), grads = jax.value_and_grad(losses, has_aux=True)(
+                params, target, batch, k1, k2
+            )
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, p: (1 - self.tau) * t + self.tau * p,
+                target,
+                {k: params[k] for k in ("q1", "q2")},
+            )
+            return params, target, opt_state, aux, key
+
+        self._update = jax.jit(update_step)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+        return "ok"
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.target, self.opt_state, aux, self._key = self._update(
+            self.params, self.target, self.opt_state, jb, self._key
+        )
+        return {k: float(v) for k, v in aux.items()}

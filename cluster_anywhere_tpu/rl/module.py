@@ -69,3 +69,63 @@ class QModule:
     @staticmethod
     def q_values(params, obs):
         return mlp_forward(params["q"], obs)
+
+
+class SquashedGaussianModule:
+    """tanh-squashed Gaussian policy over a continuous action box
+    (reference rllib/algorithms/sac policy head, jax-native)."""
+
+    LOG_STD_MIN, LOG_STD_MAX = -5.0, 2.0
+
+    def __init__(self, obs_dim: int, action_dim: int, action_scale: float = 1.0,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_scale = float(action_scale)
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        return {"pi": init_mlp(key, (self.obs_dim, *self.hidden, 2 * self.action_dim))}
+
+    def dist(self, params, obs):
+        out = mlp_forward(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mean, log_std
+
+    def sample(self, params, obs, key):
+        """Reparameterized squashed sample -> (action, logp)."""
+        mean, log_std = self.dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        act = jnp.tanh(pre)
+        # N(pre; mean, std) log-density with the tanh change of variables
+        logp = -0.5 * (((pre - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        logp = logp - jnp.log(1.0 - act**2 + 1e-6)
+        logp = jnp.sum(logp, axis=-1)
+        return act * self.action_scale, logp
+
+    def mean_action(self, params, obs):
+        mean, _ = self.dist(params, obs)
+        return jnp.tanh(mean) * self.action_scale
+
+
+class TwinQModule:
+    """Two independent Q(s, a) critics over concatenated obs+action
+    (clipped double-Q; reference sac_torch_model.py twin heads)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        k1, k2 = jax.random.split(key)
+        sizes = (self.obs_dim + self.action_dim, *self.hidden, 1)
+        return {"q1": init_mlp(k1, sizes), "q2": init_mlp(k2, sizes)}
+
+    @staticmethod
+    def q(params, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        return mlp_forward(params["q1"], x)[..., 0], mlp_forward(params["q2"], x)[..., 0]
