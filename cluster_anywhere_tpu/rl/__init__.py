@@ -14,6 +14,13 @@ from .env import CartPole, Env, Pendulum, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
 from .learner import DQNLearner, IMPALALearner, PPOLearner, compute_gae
 from .module import DiscretePolicyModule, QModule
+from .offline import BCLearner, RolloutReader, RolloutWriter, record_rollouts, train_bc
+from .multi_agent import (
+    CoordinationGame,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    RockPaperScissors,
+)
 
 __all__ = [
     "Algorithm",
@@ -21,6 +28,15 @@ __all__ = [
     "Env",
     "CartPole",
     "Pendulum",
+    "MultiAgentEnv",
+    "MultiAgentPPO",
+    "CoordinationGame",
+    "RockPaperScissors",
+    "BCLearner",
+    "RolloutReader",
+    "RolloutWriter",
+    "record_rollouts",
+    "train_bc",
     "VectorEnv",
     "make_env",
     "register_env",

@@ -84,17 +84,17 @@ class EnvRunner:
                 logp = np.zeros(len(actions), np.float32)
                 values = np.zeros(len(actions), np.float32)
             elif self.kind == "policy":
+                from .module import softmax_sample
+
                 out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs)))
-                z = out - out.max(-1, keepdims=True)
-                p = np.exp(z)
-                p /= p.sum(-1, keepdims=True)
                 if self.explore == "sample":
-                    actions = np.array(
-                        [self.rng.choice(len(pi), p=pi) for pi in p], np.int32
-                    )
+                    actions, logp = softmax_sample(self.rng, out)
                 else:
-                    actions = p.argmax(-1).astype(np.int32)
-                logp = np.log(p[np.arange(len(actions)), actions] + 1e-9)
+                    actions = out.argmax(-1).astype(np.int32)
+                    z = out - out.max(-1, keepdims=True)
+                    p = np.exp(z)
+                    p /= p.sum(-1, keepdims=True)
+                    logp = np.log(p[np.arange(len(actions)), actions] + 1e-9)
                 values = np.asarray(self._jit_value(self.params, jnp.asarray(obs)))
             else:  # epsilon-greedy over q-values
                 out = np.asarray(self._jit_logits(self.params, jnp.asarray(obs)))

@@ -129,3 +129,17 @@ class TwinQModule:
     def q(params, obs, act):
         x = jnp.concatenate([obs, act], axis=-1)
         return mlp_forward(params["q1"], x)[..., 0], mlp_forward(params["q2"], x)[..., 0]
+
+
+def softmax_sample(rng, logits: "np.ndarray"):
+    """Numpy-side categorical sampling from a batch of logits.
+    Returns (actions int32 [B], logp float32 [B]).  Shared by every env
+    runner so the sampling numerics live in exactly one place."""
+    import numpy as np
+
+    z = logits - logits.max(-1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(-1, keepdims=True)
+    actions = np.array([rng.choice(p.shape[-1], p=row) for row in p], np.int32)
+    logp = np.log(p[np.arange(len(actions)), actions] + 1e-9).astype(np.float32)
+    return actions, logp

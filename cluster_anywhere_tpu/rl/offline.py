@@ -1,0 +1,183 @@
+"""Offline RL: rollout persistence + learning from logged data.
+
+Reference parity: ``rllib/offline/json_writer.py`` / ``json_reader.py``
+(SampleBatch JSONL persistence) and ``rllib/algorithms/bc`` (behavior
+cloning, the canonical offline baseline).  Batches are stored as npz shards
+(dense numeric arrays — the natural jax-side format) with a JSONL manifest
+for streaming reads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class RolloutWriter:
+    """Append rollout batches as npz shards under `path` with a manifest
+    (json_writer.py analogue)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.manifest = os.path.join(path, "manifest.jsonl")
+        self._n = 0
+
+    def write(self, batch: Dict[str, np.ndarray]) -> str:
+        name = f"shard_{int(time.time()*1000)}_{self._n:06d}.npz"
+        self._n += 1
+        fpath = os.path.join(self.path, name)
+        # write via file object so numpy can't append another .npz suffix;
+        # the .tmp name keeps a crashed partial write out of any *.npz glob
+        with open(fpath + ".tmp", "wb") as f:
+            np.savez_compressed(f, **batch)
+        os.rename(fpath + ".tmp", fpath)
+        rows = int(len(next(iter(batch.values()))))
+        with open(self.manifest, "a") as f:
+            f.write(json.dumps({"file": name, "rows": rows, "keys": sorted(batch)}) + "\n")
+        return fpath
+
+
+class RolloutReader:
+    """Stream shards back (json_reader.py analogue); `sample` draws a
+    uniform minibatch across all shards for offline updates."""
+
+    def __init__(self, path: str, seed: int = 0):
+        self.path = path
+        self.shards: List[str] = []
+        manifest = os.path.join(path, "manifest.jsonl")
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    self.shards.append(os.path.join(path, rec["file"]))
+        else:
+            self.shards = sorted(
+                os.path.join(path, n) for n in os.listdir(path) if n.endswith(".npz")
+            )
+        if not self.shards:
+            raise FileNotFoundError(f"no rollout shards under {path}")
+        self.rng = np.random.default_rng(seed)
+        self._cache: Optional[Dict[str, np.ndarray]] = None
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        for s in self.shards:
+            with np.load(s) as z:
+                yield {k: z[k] for k in z.files}
+
+    def _all(self) -> Dict[str, np.ndarray]:
+        if self._cache is None:
+            parts: Dict[str, list] = {}
+            for batch in self:
+                for k, v in batch.items():
+                    parts.setdefault(k, []).append(v)
+            self._cache = {k: np.concatenate(v) for k, v in parts.items()}
+        return self._cache
+
+    @property
+    def num_rows(self) -> int:
+        return int(len(next(iter(self._all().values()))))
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        data = self._all()
+        idx = self.rng.integers(0, self.num_rows, size=batch_size)
+        return {k: v[idx] for k, v in data.items()}
+
+
+class BCLearner:
+    """Behavior cloning: maximize log-likelihood of the logged actions
+    (rllib/algorithms/bc; one jitted cross-entropy update)."""
+
+    def __init__(self, module, *, lr: float = 1e-3, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.module = module
+        self.opt = optax.adam(lr)
+        self.params = module.init(jax.random.key(seed))
+        self.opt_state = self.opt.init(self.params)
+
+        def loss_fn(params, batch):
+            logits = module.logits(params, batch["obs"])
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, batch["actions"][:, None], axis=-1)[:, 0]
+            return jnp.mean(nll)
+
+        def update_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update_step)
+
+    def get_weights(self):
+        return self.params
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        jb = {
+            "obs": jnp.asarray(batch["obs"]),
+            "actions": jnp.asarray(batch["actions"]),
+        }
+        self.params, self.opt_state, loss = self._update(self.params, self.opt_state, jb)
+        return {"bc_loss": float(loss)}
+
+
+def record_rollouts(algo, path: str, num_iterations: int = 1) -> str:
+    """Sample from a built Algorithm's env runners and persist the flat
+    transitions — the 'generate offline data from a policy' workflow the
+    reference documents for BC."""
+    from ..core import api as _ca
+
+    writer = RolloutWriter(path)
+    for _ in range(num_iterations):
+        rollouts = _ca.get(
+            [r.sample.remote(algo.config.rollout_length) for r in algo.runners]
+        )
+        for ro in rollouts:
+            ro.pop("metrics", None)
+            T, N = ro["rewards"].shape
+            acts = ro["actions"]
+            if acts.ndim == 3:  # continuous [T, N, A]: keep vectors + dtype
+                acts = acts.reshape(T * N, -1).astype(np.float32)
+            else:
+                acts = acts.reshape(-1).astype(np.int32)
+            writer.write({
+                "obs": ro["obs"].reshape(T * N, -1).astype(np.float32),
+                "actions": acts,
+                "rewards": ro["rewards"].reshape(-1).astype(np.float32),
+                "dones": ro["dones"].reshape(-1).astype(np.float32),
+            })
+    return path
+
+
+def train_bc(
+    path: str,
+    obs_dim: int,
+    num_actions: int,
+    *,
+    hidden=(64, 64),
+    lr: float = 1e-3,
+    batch_size: int = 256,
+    num_updates: int = 500,
+    seed: int = 0,
+):
+    """Offline BC training loop over logged rollouts; returns the learner."""
+    from .module import DiscretePolicyModule
+
+    reader = RolloutReader(path, seed=seed)
+    learner = BCLearner(
+        DiscretePolicyModule(obs_dim, num_actions, hidden), lr=lr, seed=seed
+    )
+    stats = {}
+    for _ in range(num_updates):
+        stats = learner.update(reader.sample(batch_size))
+    learner.last_stats = stats
+    return learner
