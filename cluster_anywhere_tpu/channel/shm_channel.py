@@ -194,12 +194,19 @@ class ShmChannel(ChannelInterface):
 
     # -- core protocol ------------------------------------------------------
 
-    def _write_payload(self, payload: bytes, spilled: bool, deadline):
+    def _write_payload(self, chunks, total: int, spilled: bool, deadline):
+        """chunks: list of bytes-like pieces written back-to-back (scatter
+        write — large array buffers go straight from their source into shm
+        with no intermediate contiguous blob)."""
         want = self.version
         for r in range(self.num_readers):
             self._wait_ge(5 + r, want, deadline)  # acks only ever increase
-        self._mm[self.header_size : self.header_size + len(payload)] = payload
-        self._set(2, len(payload) | (_SPILL_BIT if spilled else 0))
+        pos = self.header_size
+        for c in chunks:
+            n = len(c)
+            self._mm[pos : pos + n] = c
+            pos += n
+        self._set(2, total | (_SPILL_BIT if spilled else 0))
         self._set_wake(1, want + 1)  # publish + wake readers
 
     def _enter(self):
@@ -216,20 +223,21 @@ class ShmChannel(ChannelInterface):
             self._waiters -= 1
 
     def write(self, value: Any, timeout: Optional[float] = None):
-        from ..core.serialization import pack
+        from ..core.serialization import pack, pack_chunks
 
         deadline = None if timeout is None else _now() + timeout
-        payload = pack(value)
+        total, chunks = pack_chunks(value)
         spilled = False
         ref = None
-        if len(payload) > self.capacity:
+        if total > self.capacity:
             from ..core import api as ca
 
             ref = ca.put(value)
-            payload, spilled = pack(ref), True
+            payload = pack(ref)
+            chunks, total, spilled = [payload], len(payload), True
         self._enter()
         try:
-            self._write_payload(payload, spilled, deadline)
+            self._write_payload(chunks, total, spilled, deadline)
         finally:
             self._exit()
         # _write_payload waited for all acks of the previous version, and

@@ -108,6 +108,27 @@ def pack(value: Any) -> bytes:
     return b"".join(parts)
 
 
+def pack_chunks(value: Any):
+    """Like pack(), but returns (total_len, chunks) without assembling one
+    contiguous blob — a scatter-write sink (e.g. a shm channel) copies each
+    chunk straight into place, saving a full extra copy of every large
+    tensor/array buffer.  Chunk layout is byte-identical to pack()."""
+    data, buffers = serialize(value)
+    raws = [b.raw() for b in buffers]
+    header = msgpack.packb(
+        {"p": data, "l": [len(r) for r in raws]}, use_bin_type=True
+    )
+    chunks: List[Any] = [len(header).to_bytes(4, "big"), header]
+    offset = 4 + len(header)
+    for r in raws:
+        pad = _align(offset) - offset
+        if pad:
+            chunks.append(b"\x00" * pad)
+        chunks.append(r)
+        offset += pad + len(r)
+    return offset, chunks
+
+
 def unpack(blob, pin_cb=None) -> Any:
     """Inverse of pack(). Accepts bytes or a memoryview (zero-copy for
     buffer-backed payloads when given a memoryview over shm).

@@ -64,6 +64,18 @@ def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple
     input side closes, executing this actor's nodes each tick."""
     readers = {nid: open_channel(spec, ridx) for nid, (spec, ridx) in reader_specs.items()}
     writers = {nid: open_channel(spec) for nid, spec in writer_specs.items()}
+    tensor_nids = {nid for nid, (spec, _) in reader_specs.items() if spec.get("tensor")}
+
+    def _to_device(v):
+        """with_tensor_transport consumer side: array leaves re-enter the
+        local accelerator so downstream methods compute on device arrays."""
+        import jax
+        import numpy as _np
+
+        return jax.tree.map(
+            lambda x: jax.device_put(x) if isinstance(x, _np.ndarray) else x, v
+        )
+
     ticks = 0
     try:
         while True:
@@ -75,7 +87,10 @@ def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple
                 # input channels could deadlock on cyclic actor placements
                 # (A.n1 -> B.n2 -> A.n3 would have A wait on n2 before writing n1)
                 if nid not in tick_vals:
-                    tick_vals[nid] = readers[nid].read(None)
+                    v = readers[nid].read(None)
+                    if nid in tensor_nids and not isinstance(v, _DagError):
+                        v = _to_device(v)
+                    tick_vals[nid] = v
                 return tick_vals[nid]
 
             err: Optional[_DagError] = None
@@ -285,16 +300,22 @@ class CompiledDAG:
                 if owner(n) != key:
                     continue
 
+                def chan_spec(nid, producer):
+                    spec = dict(self._channels[nid].spec())
+                    if getattr(producer, "_tensor_transport", False):
+                        spec["tensor"] = True
+                    return spec
+
                 def arg_spec(dep):
                     if isinstance(dep, InputNode):
                         reader_specs[INPUT_ID] = (
-                            self._channels[INPUT_ID].spec(),
+                            chan_spec(INPUT_ID, self._input_node),
                             reader_index[(INPUT_ID, key)],
                         )
                         return ("input", (INPUT_ID, None))
                     if isinstance(dep, InputAttributeNode):
                         reader_specs[INPUT_ID] = (
-                            self._channels[INPUT_ID].spec(),
+                            chan_spec(INPUT_ID, self._input_node),
                             reader_index[(INPUT_ID, key)],
                         )
                         return ("input", (INPUT_ID, dep._key))
@@ -302,7 +323,7 @@ class CompiledDAG:
                         if owner(dep) == key:
                             return ("local", dep._id)
                         reader_specs[dep._id] = (
-                            self._channels[dep._id].spec(),
+                            chan_spec(dep._id, dep),
                             reader_index[(dep._id, key)],
                         )
                         return ("chan", dep._id)

@@ -16,6 +16,22 @@ class DAGNode:
         self._bound_args = tuple(args)
         self._bound_kwargs = dict(kwargs or {})
         self._id = next(_node_counter)
+        self._tensor_transport = False
+
+    def with_tensor_transport(self) -> "DAGNode":
+        """Mark this node's output as tensor data: every cross-process
+        consumer materializes array leaves onto its local accelerator
+        (jax.device_put) immediately after the channel read, so downstream
+        compute sees device arrays, not host numpy.
+
+        TPU-native stand-in for the reference's
+        experimental/channel/torch_tensor_nccl_channel.py:44 transport
+        annotation: separate jax processes cannot share one ICI runtime, so
+        tensors cross processes host-staged through the shm channel (a
+        scatter-write of the raw buffers — no pickle assembly copy) and
+        re-enter the device on the consumer side."""
+        self._tensor_transport = True
+        return self
 
     # -- graph introspection ------------------------------------------------
 

@@ -255,3 +255,42 @@ def test_compiled_dag_duplicate_output_leaves(ca_cluster_module):
         assert dag.execute(5).get(timeout=30) == [6, 6]
     finally:
         dag.teardown()
+
+
+def test_tensor_transport_device_put(ca_cluster_module):
+    """with_tensor_transport(): cross-actor array edges re-enter the device
+    on the consumer side — downstream methods see jax.Array, not host numpy
+    (torch_tensor_nccl_channel.py role, host-staged for separate jax
+    processes)."""
+    import numpy as np
+
+    @ca.remote
+    class Producer:
+        def make(self, _):
+            return {"x": np.arange(8, dtype=np.float32), "tag": "meta"}
+
+    @ca.remote
+    class Consumer:
+        def check(self, d):
+            import jax
+
+            x = d["x"]
+            return {
+                "is_device": isinstance(x, jax.Array),
+                "sum": float(x.sum()),
+                "tag": d["tag"],
+            }
+
+    p, c = Producer.remote(), Consumer.remote()
+    with InputNode() as inp:
+        out = c.check.bind(p.make.bind(inp).with_tensor_transport())
+    dag = out.experimental_compile()
+    try:
+        res = dag.execute(0).get(timeout=60)
+        assert res["is_device"] is True
+        assert res["sum"] == float(np.arange(8).sum())
+        assert res["tag"] == "meta"  # non-array leaves pass through untouched
+    finally:
+        dag.teardown()
+    ca.kill(p)
+    ca.kill(c)
