@@ -23,16 +23,17 @@ _lib: Optional[ctypes.CDLL] = None
 _failed = False
 
 
-def _compile() -> bool:
+def _compile(out: str = _SO, extra_flags: Optional[list] = None) -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    tmp = _SO + f".tmp{os.getpid()}"
+    tmp = out + f".tmp{os.getpid()}"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+        *(extra_flags or []),
         _SRC, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        os.replace(tmp, out)
         return True
     except (subprocess.SubprocessError, OSError):
         try:
@@ -40,6 +41,23 @@ def _compile() -> bool:
         except OSError:
             pass
         return False
+
+
+def build_sanitized(kind: str = "thread") -> Optional[str]:
+    """Build a sanitizer-instrumented variant (TSAN/ASAN) of the native lib
+    and return its path, or None if the toolchain can't.  Used by the race
+    -detection tests (§5 sanitizer story): the instrumented .so is loaded in
+    a subprocess with the sanitizer runtime LD_PRELOADed, never in-process.
+    """
+    assert kind in ("thread", "address")
+    out = os.path.join(_BUILD_DIR, f"libca_native.{kind[0]}san.so")
+    if (
+        os.path.exists(out)
+        and os.path.getmtime(out) >= os.path.getmtime(_SRC)
+    ):
+        return out
+    flags = [f"-fsanitize={kind}", "-g", "-fno-omit-frame-pointer"]
+    return out if _compile(out, flags) else None
 
 
 def load() -> Optional[ctypes.CDLL]:

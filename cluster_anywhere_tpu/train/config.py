@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclass
@@ -74,6 +74,8 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 0
+    # experiment callbacks (tune/callback.py): logger integrations etc.
+    callbacks: List[Any] = field(default_factory=list)
 
     def resolved_storage_path(self) -> str:
         return os.path.expanduser(

@@ -14,6 +14,7 @@ the cluster can actually place, enabling elastic training.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from enum import Enum
@@ -91,6 +92,15 @@ class FailurePolicy:
         return FailureDecision.RAISE
 
 
+@dataclass
+class _RunHandle:
+    """Trial-shaped handle for run_config.callbacks on the Train path."""
+
+    trial_id: str
+    config: Dict[str, Any]
+    local_dir: str
+
+
 class TrainController:
     def __init__(
         self,
@@ -128,6 +138,22 @@ class TrainController:
         self._resume_checkpoint = resume_from_checkpoint
         self._latest_metrics: Dict[str, Any] = {}
         self._metrics_history: List[Dict[str, Any]] = []
+        # run_config.callbacks (tune/callback.py hook surface) fire here too:
+        # the whole train run presents as one "trial" to the loggers
+        self._run_handle = _RunHandle(
+            trial_id=self.experiment_name,
+            config=dict(train_fn_config or {}),
+            local_dir=os.path.join(
+                run_config.resolved_storage_path(), self.experiment_name
+            ),
+        )
+
+    def _cb(self, hook: str, *args):
+        for cb in self.run_config.callbacks:
+            try:
+                getattr(cb, hook)(self._run_handle, *args)
+            except Exception:
+                pass  # logging must never take down the run
 
     # -- one attempt -----------------------------------------------------
     def _run_attempt(self, attempt: int) -> Optional[str]:
@@ -172,6 +198,7 @@ class TrainController:
                 if rank == 0:
                     self._latest_metrics = rep["metrics"]
                     self._metrics_history.append(rep["metrics"])
+                    self._cb("on_trial_result", rep["metrics"])
                     if "checkpoint_path" in rep:
                         self.checkpoint_manager.register(
                             Checkpoint(rep["checkpoint_path"]), rep["metrics"]
@@ -182,6 +209,7 @@ class TrainController:
         failure_count = 0
         attempt = 0
         final_error: Optional[BaseException] = None
+        self._cb("on_trial_start")
         while True:
             error = self._run_attempt(attempt)
             attempt += 1
@@ -192,8 +220,7 @@ class TrainController:
                 self.status = RunAttemptStatus.ERRORED
                 final_error = TrainingFailedError(message=error)
                 break
-        import os
-
+        self._cb("on_trial_error" if final_error is not None else "on_trial_complete")
         return Result(
             metrics=self._latest_metrics,
             checkpoint=self.checkpoint_manager.latest_checkpoint,

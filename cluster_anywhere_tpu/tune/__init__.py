@@ -53,6 +53,12 @@ from .search_space import (
     sample_from,
     uniform,
 )
+from .callbacks import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    MLflowLoggerCallback,
+)
 from .tuner import (
     ResultGrid,
     TrialResult,
@@ -65,6 +71,10 @@ from .tuner import (
 ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
+    "Callback",
+    "CSVLoggerCallback",
+    "JsonLoggerCallback",
+    "MLflowLoggerCallback",
     "Tuner",
     "TuneConfig",
     "ResultGrid",

@@ -44,6 +44,7 @@ class TuneController:
         experiment_name: str = "exp",
         seed: Optional[int] = None,
         restored_trials: Optional[List[Trial]] = None,
+        callbacks: Optional[List] = None,
     ):
         self.trainable = trainable
         self.metric = metric
@@ -63,6 +64,7 @@ class TuneController:
         self.max_concurrent = max_concurrent_trials or max(
             1, int(ca.cluster_resources().get("CPU", 4))
         )
+        self.callbacks = list(callbacks or [])
         self.trials: List[Trial] = list(restored_trials or [])
         self._trial_counter = len(self.trials)
         self._searcher_exhausted = False
@@ -99,6 +101,7 @@ class TuneController:
                 last_state_write = now
             time.sleep(0.02)
         self.save_state()
+        self._cb("on_experiment_end", self.trials)
         return self.trials
 
     # ------------------------------------------------------------- lifecycle
@@ -142,6 +145,14 @@ class TuneController:
             resume_checkpoint_path=checkpoint_path or trial.latest_checkpoint_path,
         )
         trial.status = RUNNING
+        self._cb("on_trial_start", trial)
+
+    def _cb(self, hook: str, *args):
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception:
+                pass  # logging must never take down the experiment loop
 
     def _stop_trial(self, trial: Trial, status: str, error: Optional[str] = None):
         if trial.actor is not None:
@@ -156,6 +167,9 @@ class TuneController:
             trial.trial_id, trial.last_result, error=status == ERRORED
         )
         self.scheduler.on_trial_complete(trial, trial.last_result)
+        # terminal failures route through _on_trial_error, so this is always
+        # a clean completion
+        self._cb("on_trial_complete", trial)
 
     # ------------------------------------------------------------- polling
     def _poll_running(self, running: List[Trial]):
@@ -206,6 +220,7 @@ class TuneController:
         trial.last_result = metrics
         trial.metrics_history.append(metrics)
         self.searcher.on_trial_result(trial.trial_id, metrics)
+        self._cb("on_trial_result", trial, metrics)
         decision = self.scheduler.on_trial_result(trial, metrics)
         if self._hit_stop_criteria(metrics):
             decision = STOP
@@ -236,6 +251,7 @@ class TuneController:
             trial.error = error
             self.searcher.on_trial_complete(trial.trial_id, None, error=True)
             self.scheduler.on_trial_complete(trial, None)
+            self._cb("on_trial_error", trial)
 
     def _maybe_perturb(self, trial: Trial):
         decision = self.scheduler.choose_perturbation(trial, self.trials)

@@ -149,6 +149,7 @@ class Tuner:
             experiment_name=name,
             seed=self.tune_config.seed,
             restored_trials=self._restored_trials,
+            callbacks=self.run_config.callbacks,
         )
         trials = controller.run()
         results = []

@@ -201,3 +201,31 @@ def test_jax_backend_local_mesh(ca_cluster_module, tmp_path):
     ).fit()
     assert result.metrics["y"] == pytest.approx(512.0)
     assert result.metrics["n_dev"] >= 1
+
+
+def test_train_run_callbacks(ca_cluster_module, tmp_path):
+    """run_config.callbacks fire on the Train path too: the whole run
+    presents as one trial to the logger integrations."""
+    import json
+
+    from cluster_anywhere_tpu import train, tune
+
+    def loop():
+        for i in range(3):
+            train.report({"loss": 1.0 / (i + 1), "step": i})
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(
+            name="cb_train",
+            storage_path=str(tmp_path),
+            callbacks=[tune.JsonLoggerCallback()],
+        ),
+    )
+    res = trainer.fit()
+    assert res.error is None
+    log = os.path.join(str(tmp_path), "cb_train", "result.json")
+    lines = open(log).read().splitlines()
+    assert len(lines) == 3
+    assert json.loads(lines[-1])["loss"] == 1.0 / 3

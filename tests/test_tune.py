@@ -311,3 +311,57 @@ def test_restore_runs_remaining_samples(tmp_path):
     grid = restored.fit()
     assert len(grid) == 5  # 2 persisted + 3 remaining samples
     assert grid.num_errors == 0
+
+
+def test_logger_callbacks(ca_cluster_module, tmp_path):
+    """JSON/CSV/MLflow logger callbacks write per-trial logs through a real
+    experiment (tune/logger/*, air/integrations/mlflow.py file-store)."""
+    import csv
+    import json
+
+    mlruns = tmp_path / "mlruns"
+
+    def trainable(config):
+        for i in range(3):
+            tune.report({"loss": config["x"] * (3 - i), "training_iteration": i + 1})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=tune.RunConfig(
+            name="cb_exp",
+            storage_path=str(tmp_path),
+            callbacks=[
+                tune.JsonLoggerCallback(),
+                tune.CSVLoggerCallback(),
+                tune.MLflowLoggerCallback(str(mlruns), experiment_name="cb_exp"),
+            ],
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0 and len(grid) == 2
+    for r in grid:
+        # result.json: one JSON line per report
+        lines = open(os.path.join(r.path, "result.json")).read().splitlines()
+        assert len(lines) >= 3
+        # params.json captures the config, and logged losses match it
+        params = json.load(open(os.path.join(r.path, "params.json")))
+        assert params["x"] in (1.0, 2.0)
+        assert json.loads(lines[0])["loss"] == params["x"] * 3
+        # progress.csv: header + rows
+        rows = list(csv.DictReader(open(os.path.join(r.path, "progress.csv"))))
+        assert len(rows) >= 3 and "loss" in rows[0]
+    # mlflow file store: experiment meta + one run dir per trial with metrics
+    exp_dir = mlruns / "0"
+    assert (exp_dir / "meta.yaml").exists()
+    run_dirs = [d for d in exp_dir.iterdir() if d.is_dir()]
+    assert len(run_dirs) == 2
+    for rd in run_dirs:
+        metric = (rd / "metrics" / "loss").read_text().splitlines()
+        assert len(metric) >= 3
+        ts, val, step = metric[1].split()
+        assert int(step) == 1
+        assert (rd / "params" / "x").exists()
+        assert "end_time:" in (rd / "meta.yaml").read_text()
+        assert "status: 3" in (rd / "meta.yaml").read_text()
