@@ -118,3 +118,93 @@ class LocalNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[NodeInfo]:
         return [n for n in self.nodes.values() if n.state != "terminated"]
+
+
+class AgentNodeProvider(NodeProvider):
+    """Launches REAL node-agent processes against the connected cluster —
+    each autoscaled "node" is a full raylet-analogue with its own worker
+    pool, shm namespace, and TCP link to the head (the in-process analogue
+    of a cloud provider booting a VM; reference fake_multi_node provider).
+
+    Scheduling spillover, per-node stores, and node-death semantics all
+    behave exactly as for cluster_utils.Cluster nodes, so autoscaled
+    capacity is indistinguishable from statically added nodes."""
+
+    def __init__(self):
+        import json
+
+        from ..core.worker import global_worker
+
+        self.w = global_worker()
+        self.session_dir = self.w.session_dir
+        self.head_tcp = open(os.path.join(self.session_dir, "head.addr")).read().strip()
+        if not self.head_tcp:
+            raise RuntimeError("head has no TCP endpoint; cannot add agent nodes")
+        self.nodes: Dict[str, NodeInfo] = {}
+        self._json = json
+
+    def create_node(self, node_type: NodeType) -> NodeInfo:
+        node_id = f"as-{uuid.uuid4().hex[:8]}"
+        shape = dict(node_type.resources)
+        shape.setdefault("memory", float(self.w.config.object_store_memory))
+        env = dict(os.environ)
+        env["CA_SESSION_DIR"] = self.session_dir
+        env["CA_HEAD_ADDR"] = self.head_tcp
+        env["CA_NODE_ID"] = node_id
+        env["CA_NODE_RESOURCES"] = self._json.dumps(shape)
+        env["CA_CONFIG_JSON"] = self.w.config.to_json()
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        node_dir = os.path.join(self.session_dir, "nodes", node_id)
+        os.makedirs(node_dir, exist_ok=True)
+        logf = open(os.path.join(node_dir, "agent.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_anywhere_tpu.core.nodeagent"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        logf.close()
+        ready = os.path.join(node_dir, "agent.ready")
+        deadline = time.monotonic() + 30
+        while not os.path.exists(ready):
+            if proc.poll() is not None or time.monotonic() > deadline:
+                raise RuntimeError(f"agent node {node_id} failed to start")
+            time.sleep(0.02)
+        info = NodeInfo(
+            node_id=node_id,
+            node_type=node_type.name,
+            resources=shape,
+            handle=proc,
+        )
+        self.nodes[node_id] = info
+        return info
+
+    def terminate_node(self, node: NodeInfo) -> None:
+        import signal
+
+        if node.state == "terminated":
+            return
+        node.state = "terminated"
+        proc = node.handle
+        if proc is not None:
+            try:
+                os.kill(proc.pid, signal.SIGTERM)
+                proc.wait(timeout=10)
+            except (ProcessLookupError, subprocess.TimeoutExpired):
+                try:
+                    os.kill(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        self.nodes.pop(node.node_id, None)
+
+    def non_terminated_nodes(self) -> List[NodeInfo]:
+        for n in list(self.nodes.values()):
+            proc = n.handle
+            if proc is not None and proc.poll() is not None:
+                n.state = "terminated"  # crashed out from under us
+                self.nodes.pop(n.node_id, None)
+        return [n for n in self.nodes.values() if n.state != "terminated"]

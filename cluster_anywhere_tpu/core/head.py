@@ -57,6 +57,7 @@ class NodeRec:
     conn: Optional[Connection] = None  # head -> agent connection
     max_workers: int = 64
     mem_pressured: bool = False  # agent-reported memory pressure (monitor)
+    load: Dict[str, float] = field(default_factory=dict)  # heartbeat telemetry
 
     @property
     def is_local(self) -> bool:
@@ -1192,6 +1193,8 @@ class Head:
             node.last_heartbeat = time.monotonic()
             if "mem_pressured" in msg:
                 node.mem_pressured = bool(msg["mem_pressured"])
+            if "load" in msg:
+                node.load = msg["load"]
 
     async def _h_worker_exit(self, state, msg, reply, reply_err):
         """Node agent reports one of its worker processes exited."""
@@ -1749,6 +1752,8 @@ class Head:
 
     # introspection ---------------------------------------------------------
     async def _h_nodes(self, state, msg, reply, reply_err):
+        from .nodeagent import node_load_sample
+
         out = []
         for n in self.nodes.values():
             out.append(
@@ -1757,6 +1762,7 @@ class Head:
                     "alive": n.state == "alive",
                     "resources": n.total,
                     "available": n.avail,
+                    "load": n.load if not n.is_local else node_load_sample(),
                     "is_head_node": n.is_local,
                     "n_workers": sum(
                         1

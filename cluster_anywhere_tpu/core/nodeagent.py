@@ -30,6 +30,27 @@ from .head import read_shm_chunk
 from .protocol import Server, connect_addr, spawn_bg
 
 
+def node_load_sample() -> Dict[str, float]:
+    """Point-in-time node utilization, disseminated with heartbeats (the
+    centralized stand-in for ray_syncer.h:83's NodeResourceUsage broadcast:
+    one scheduler needs the data, so it flows head-ward, not peer-to-peer)."""
+    out: Dict[str, float] = {}
+    try:
+        out["load_1m"] = os.getloadavg()[0]
+    except OSError:
+        pass
+    try:
+        from .memory_monitor import MemoryMonitor
+
+        s = MemoryMonitor().sample()
+        if s is not None:
+            used, total = s
+            out["mem_used_frac"] = round(used / total, 4) if total else 0.0
+    except Exception:
+        pass
+    return out
+
+
 class NodeAgent:
     def __init__(self):
         self.session_dir = os.environ["CA_SESSION_DIR"]
@@ -163,7 +184,7 @@ class NodeAgent:
         while not self._shutdown.is_set():
             await asyncio.sleep(min(period, 1.0))
             try:
-                hb = {"node_id": self.node_id}
+                hb = {"node_id": self.node_id, "load": node_load_sample()}
                 if self.mem_monitor is not None:
                     hb["mem_pressured"] = self.mem_monitor.is_pressured()
                 self.head.notify("node_heartbeat", **hb)

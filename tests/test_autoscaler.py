@@ -235,3 +235,58 @@ def test_local_provider_end_to_end(ca_cluster):
     assert ca.get(refs, timeout=60) == [1] * 6
     for n in list(provider.non_terminated_nodes()):
         provider.terminate_node(n)
+
+
+def test_agent_provider_scales_real_nodes(ca_cluster):
+    """AgentNodeProvider boots a real node agent (raylet analogue) on scale
+    -up: the node joins the head's node table, queued tasks spill onto it,
+    and terminate removes it from the cluster."""
+    from cluster_anywhere_tpu.autoscaler.provider import AgentNodeProvider
+    from cluster_anywhere_tpu.util.state import list_nodes
+
+    provider = AgentNodeProvider()
+    rec = Reconciler(
+        provider,
+        AutoscalerConfig(node_types=[NodeType("cpu2", {"CPU": 2.0})], idle_timeout_s=300),
+    )
+
+    @ca.remote
+    def hold(t):
+        time.sleep(t)
+        return 1
+
+    refs = [hold.remote(2.0) for _ in range(6)]  # 6 demands vs 4 base CPUs
+    launched = 0
+    deadline = time.time() + 10
+    while launched == 0 and time.time() < deadline:
+        time.sleep(0.5)
+        launched = rec.step()["launched"]
+    assert launched >= 1
+    # the autoscaled agent is a REAL node in the head's table
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        agents = [n for n in list_nodes() if n["alive"] and not n["is_head_node"]]
+        if agents:
+            break
+        time.sleep(0.2)
+    assert agents, "autoscaled agent node never joined"
+    assert agents[0]["resources"].get("CPU") == 2.0
+    assert ca.get(refs, timeout=60) == [1] * 6
+    # heartbeat load telemetry flows from the agent (syncer dissemination)
+    deadline = time.time() + 10
+    load = {}
+    while time.time() < deadline and "load_1m" not in load:
+        time.sleep(0.5)
+        for n in list_nodes():
+            if n["node_id"] == agents[0]["node_id"]:
+                load = n.get("load") or {}
+    assert "load_1m" in load
+    for n in list(provider.non_terminated_nodes()):
+        provider.terminate_node(n)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        alive = [n for n in list_nodes() if n["alive"] and not n["is_head_node"]]
+        if not alive:
+            break
+        time.sleep(0.5)
+    assert not alive, "terminated agent node still alive in the node table"
