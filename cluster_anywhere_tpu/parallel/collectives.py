@@ -29,13 +29,32 @@ _groups: Dict[str, "HostCollectiveGroup"] = {}
 
 
 class HostCollectiveGroup:
+    """Gloo-role host collectives (util/collective GLOOGroup analogue).
+
+    The KV store carries only rendezvous metadata — pickled ObjectRefs, a
+    few hundred bytes — while tensor payloads ride the object store's data
+    plane: zero-copy shm between same-host ranks, chunked TCP pulls across
+    nodes.  Reductions are rooted: every rank publishes one chunk, the root
+    reduces and publishes one result, every other rank polls exactly one
+    key — O(world) tensor movements per op, not the O(world^2) of all-ranks
+    -fetch-all-chunks.
+    """
+
+    # refs published for recent ops are retained so a lagging consumer's
+    # borrow registration always lands while the producer still holds the
+    # object (SPMD lockstep bounds consumer lag to ~2 ops; 4 is margin)
+    _RETAIN_OPS = 4
+
     def __init__(self, world_size: int, rank: int, group_name: str = "default"):
+        from collections import deque
+
         if not 0 <= rank < world_size:
             raise ValueError(f"rank {rank} out of range for world_size {world_size}")
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
         self._seq = 0
+        self._live = deque(maxlen=self._RETAIN_OPS * max(world_size, 2))
 
     def _ns(self, op: str) -> str:
         return f"__collective__/{self.group_name}/{self._seq}/{op}"
@@ -45,32 +64,33 @@ class HostCollectiveGroup:
 
         return global_worker()
 
-    def _put(self, ns: str, key: str, value: Any):
-        self._kv().head_call("kv_put", ns=ns, key=key, value=pickle.dumps(value))
+    def _publish(self, ns: str, key: str, value: np.ndarray):
+        """ca.put the tensor; only the ref crosses the head's KV.  Small
+        tensors put inline must be promoted to cluster-visible shm first —
+        a ref smuggled through KV bypasses the task-arg promotion path."""
+        from ..core import api as ca_api
 
-    def _gather_all(self, ns: str, timeout: float = 60.0) -> List[Any]:
+        ref = ca_api.put(np.ascontiguousarray(value))
+        self._kv()._promote_nested([ref.id.binary()])
+        self._live.append(ref)
+        self._kv().head_call("kv_put", ns=ns, key=key, value=pickle.dumps(ref))
+
+    def _fetch(self, ns: str, key: str, timeout: float = 60.0) -> np.ndarray:
+        """Poll one KV key for a ref, then read the payload from the store."""
+        from ..core import api as ca_api
+
         w = self._kv()
         deadline = time.monotonic() + timeout
         while True:
-            keys = w.head_call("kv_keys", ns=ns)["keys"]
-            if len(keys) >= self.world_size:
-                out = []
-                for r in range(self.world_size):
-                    v = w.head_call("kv_get", ns=ns, key=str(r))["value"]
-                    out.append(pickle.loads(v))
-                return out
+            v = w.head_call("kv_get", ns=ns, key=key)["value"]
+            if v is not None:
+                return np.asarray(ca_api.get(pickle.loads(v)))
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"collective {ns}: only {len(keys)}/{self.world_size} arrived"
-                )
-            time.sleep(0.005)
+                raise TimeoutError(f"collective {ns}/{key} timed out")
+            time.sleep(0.002)
 
-    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
-        ns = self._ns("allreduce")
-        self._seq += 1
-        self._put(ns, str(self.rank), np.asarray(tensor))
-        parts = self._gather_all(ns)
-        stack = np.stack(parts)
+    @staticmethod
+    def _reduce(stack: np.ndarray, op: str) -> np.ndarray:
         if op == "sum":
             return stack.sum(axis=0)
         if op == "max":
@@ -81,11 +101,27 @@ class HostCollectiveGroup:
             return stack.mean(axis=0)
         raise ValueError(f"unsupported op {op}")
 
+    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        ns = self._ns("allreduce")
+        self._seq += 1
+        if self.rank == 0:
+            parts = [np.asarray(tensor)]
+            for r in range(1, self.world_size):
+                parts.append(self._fetch(ns, str(r)))
+            result = self._reduce(np.stack(parts), op)
+            if self.world_size > 1:
+                self._publish(ns, "result", result)
+            return result
+        self._publish(ns, str(self.rank), np.asarray(tensor))
+        return self._fetch(ns, "result")
+
     def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
         ns = self._ns("allgather")
         self._seq += 1
-        self._put(ns, str(self.rank), np.asarray(tensor))
-        return self._gather_all(ns)
+        self._publish(ns, str(self.rank), np.asarray(tensor))
+        # every rank reads every chunk, but through the data plane (shm
+        # locally), so the head only serves world_size tiny ref lookups
+        return [self._fetch(ns, str(r)) for r in range(self.world_size)]
 
     def reducescatter(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
         full = self.allreduce(tensor, op)
@@ -95,26 +131,23 @@ class HostCollectiveGroup:
         ns = self._ns("broadcast")
         self._seq += 1
         if self.rank == src_rank:
-            self._put(ns, "0", np.asarray(tensor))
-        w = self._kv()
-        deadline = time.monotonic() + 60.0
-        while True:
-            v = w.head_call("kv_get", ns=ns, key="0")["value"]
-            if v is not None:
-                return pickle.loads(v)
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"broadcast {ns} timed out")
-            time.sleep(0.005)
+            arr = np.asarray(tensor)
+            if self.world_size > 1:
+                self._publish(ns, "0", arr)
+            return arr
+        return self._fetch(ns, "0")
 
     def barrier(self):
         self.allreduce(np.zeros(1))
 
     def send(self, tensor: np.ndarray, dst_rank: int):
         ns = f"__collective__/{self.group_name}/p2p/{self.rank}->{dst_rank}"
-        self._put(ns, str(self._seq), np.asarray(tensor))
+        self._publish(ns, str(self._seq), np.asarray(tensor))
         self._seq += 1
 
     def recv(self, src_rank: int, timeout: float = 60.0) -> np.ndarray:
+        from ..core import api as ca_api
+
         ns = f"__collective__/{self.group_name}/p2p/{src_rank}->{self.rank}"
         w = self._kv()
         deadline = time.monotonic() + timeout
@@ -124,10 +157,10 @@ class HostCollectiveGroup:
                 key = keys[0]
                 v = w.head_call("kv_get", ns=ns, key=key)["value"]
                 w.head_call("kv_del", ns=ns, key=key)
-                return pickle.loads(v)
+                return np.asarray(ca_api.get(pickle.loads(v)))
             if time.monotonic() > deadline:
                 raise TimeoutError("recv timed out")
-            time.sleep(0.005)
+            time.sleep(0.002)
 
 
 # ---------------------------------------------------------------------------
