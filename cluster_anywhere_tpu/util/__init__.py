@@ -3,7 +3,7 @@ python/ray/util/: ActorPool at util/actor_pool.py, Queue at util/queue.py,
 inspect_serializability at util/check_serialize.py, metrics at
 util/metrics.py, the state API at util/state/, tracing at util/tracing/)."""
 
-from . import metrics, state, tracing
+from . import metrics, multiprocessing, state, tracing
 from .actor_pool import ActorPool
 from .check_serialize import inspect_serializability
 from .queue import Empty, Full, Queue
@@ -15,6 +15,7 @@ __all__ = [
     "Full",
     "inspect_serializability",
     "metrics",
+    "multiprocessing",
     "state",
     "tracing",
 ]

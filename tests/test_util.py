@@ -93,3 +93,56 @@ def test_inspect_serializability():
     ok, failures = inspect_serializability(bad)
     assert not ok
     assert any("lock" == f.name for f in failures)
+
+
+def test_multiprocessing_pool(ca_cluster_module):
+    """ray.util.multiprocessing Pool analogue: stdlib surface over cluster
+    tasks (apply/map/imap/starmap, async variants, context manager)."""
+    from cluster_anywhere_tpu.util.multiprocessing import Pool, TimeoutError as MPTimeout
+
+    def square(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=3) as pool:
+        assert pool.apply(square, (4,)) == 16
+        assert pool.map(square, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(pool.imap(square, range(6), chunksize=2)) == [0, 1, 4, 9, 16, 25]
+        assert sorted(pool.imap_unordered(square, range(6))) == [0, 1, 4, 9, 16, 25]
+
+        ar = pool.apply_async(square, (7,))
+        assert ar.get(timeout=30) == 49
+        assert ar.ready() and ar.successful()
+
+        mr = pool.map_async(square, range(5))
+        assert mr.get(timeout=30) == [0, 1, 4, 9, 16]
+
+        # errors surface on get(), not at submission
+        def boom(x):
+            raise RuntimeError("nope")
+
+        er = pool.apply_async(boom, (1,))
+        with pytest.raises(Exception, match="nope"):
+            er.get(timeout=30)
+        assert not er.successful()
+
+
+def test_multiprocessing_pool_initializer(ca_cluster_module):
+    """initializer runs once per pool worker, its state visible to tasks."""
+    from cluster_anywhere_tpu.util.multiprocessing import Pool
+
+    def init(v):
+        import builtins
+
+        builtins._pool_init_value = v
+
+    def read_init(_):
+        import builtins
+
+        return getattr(builtins, "_pool_init_value", None)
+
+    with Pool(processes=2, initializer=init, initargs=(123,)) as pool:
+        assert pool.map(read_init, range(4)) == [123] * 4
