@@ -156,6 +156,7 @@ class Dashboard:
                         "is_head_node": n.is_local,
                         "resources": n.total,
                         "available": n.avail,
+                        "load": n.load,
                         "n_workers": sum(
                             1
                             for w in h.workers.values()

@@ -2,6 +2,7 @@
 failure surfacing (regression tests for review findings)."""
 
 import os
+import signal
 import time
 
 import numpy as np
@@ -184,3 +185,34 @@ def test_driver_tables_drain_after_refs_die(ca_cluster):
     assert all(a <= b for a, b in zip(ff, base)), (
         f"fire-and-forget resurrected entries: {base} -> {ff}"
     )
+
+
+def test_view_survives_producer_sigkill(ca_cluster):
+    """Crash-consistency of the arena sweep: a consumer holding a zero-copy
+    view of a SIGKILLed producer's object keeps reading valid bytes — the
+    unlinked arena file persists while mapped (POSIX), so the head's sweep
+    of the dead client's arenas can't corrupt live readers."""
+    import numpy as np
+
+    from cluster_anywhere_tpu.core.errors import CAError
+
+    @ca.remote
+    class Producer:
+        def make(self):
+            return ca.put(np.full(300_000, 9.0))
+
+        def pid(self):
+            return os.getpid()
+
+    p = Producer.remote()
+    ref = ca.get(p.make.remote(), timeout=30)
+    arr = ca.get(ref, timeout=30)  # zero-copy view over the producer's arena
+    assert arr[0] == 9.0
+    pid = ca.get(p.pid.remote(), timeout=30)
+    os.kill(pid, signal.SIGKILL)
+    # give the head time to notice the death and sweep the dead client's
+    # arena files out of /dev/shm
+    time.sleep(3.0)
+    # the held view stays fully readable after the sweep
+    assert float(arr.sum()) == 9.0 * 300_000
+    assert arr[-1] == 9.0
