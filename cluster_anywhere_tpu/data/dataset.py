@@ -224,6 +224,9 @@ class Dataset:
     def iter_torch_batches(self, **kw) -> Iterator[Any]:
         return self.iterator().iter_torch_batches(**kw)
 
+    def iter_jax_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_jax_batches(**kw)
+
     # ----------------------------------------------------------------- split
     def split(self, n: int, *, equal: bool = False) -> List["MaterializedDataset"]:
         mat = self.materialize()

@@ -126,6 +126,48 @@ class DataIterator:
                 out[k] = t
             yield out
 
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        dtypes=None,
+        sharding=None,
+        prefetch: int = 1,
+        **kw,
+    ) -> Iterator[Dict[str, Any]]:
+        """numpy batches materialized as jax.Arrays on device (the TPU-native
+        counterpart of iter_torch_batches).
+
+        ``sharding``: optional jax.sharding.Sharding (e.g. a NamedSharding
+        over the dp axis) applied at device_put, so each batch lands already
+        distributed.  ``prefetch`` batches are device_put ahead of the one
+        being consumed — jax transfers are async, so the next host->device
+        copy overlaps the caller's compute on the current batch.
+        """
+        import collections
+
+        import jax
+
+        def to_device(batch):
+            out = {}
+            for k, v in batch.items():
+                if v.dtype == object:
+                    out[k] = v
+                    continue
+                arr = np.ascontiguousarray(v)
+                if dtypes is not None:
+                    arr = arr.astype(dtypes[k] if isinstance(dtypes, dict) else dtypes)
+                out[k] = jax.device_put(arr, sharding)
+            return out
+
+        window: collections.deque = collections.deque()
+        for batch in self.iter_batches(batch_size=batch_size, batch_format="numpy", **kw):
+            window.append(to_device(batch))
+            if len(window) > max(prefetch, 0):
+                yield window.popleft()
+        while window:
+            yield window.popleft()
+
     def materialize(self):
         return self._dataset.materialize()
 
