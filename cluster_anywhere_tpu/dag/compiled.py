@@ -185,6 +185,15 @@ class CompiledDAGRef:
     def __repr__(self):
         return f"CompiledDAGRef(seq={self._seq})"
 
+    async def get_async(self, timeout: Optional[float] = None):
+        """Awaitable result read; the blocking channel read runs off-loop."""
+        import asyncio
+
+        return await asyncio.to_thread(self.get, timeout)
+
+    def __await__(self):
+        return self.get_async().__await__()
+
 
 class CompiledDAG:
     def __init__(self, root: DAGNode, max_inflight_executions: int = 2,
@@ -381,6 +390,14 @@ class CompiledDAG:
         ref = CompiledDAGRef(self, self._exec_seq)
         self._exec_seq += 1
         return ref
+
+    async def execute_async(self, *args, **kwargs) -> CompiledDAGRef:
+        """Async submission (reference compiled_dag_node.py:2336): the input
+        -channel write (which blocks under backpressure at max inflight)
+        runs off-loop; `await ref.get_async()` or `await ref` reads."""
+        import asyncio
+
+        return await asyncio.to_thread(self.execute, *args, **kwargs)
 
     def _read_result(self, seq: int, timeout: Optional[float]):
         import time as _time

@@ -294,3 +294,28 @@ def test_tensor_transport_device_put(ca_cluster_module):
         dag.teardown()
     ca.kill(p)
     ca.kill(c)
+
+
+def test_execute_async(ca_cluster_module):
+    """execute_async + awaitable refs (compiled_dag_node.py:2336): pipelined
+    async submissions resolve in order off the event loop."""
+    import asyncio
+
+    @ca.remote
+    class Doubler:
+        def run(self, x):
+            return x * 2
+
+    d = Doubler.remote()
+    with InputNode() as inp:
+        out = d.run.bind(inp)
+    dag = out.experimental_compile()
+    try:
+        async def main():
+            refs = [await dag.execute_async(i) for i in range(4)]
+            return [await r for r in refs]
+
+        assert asyncio.run(main()) == [0, 2, 4, 6]
+    finally:
+        dag.teardown()
+    ca.kill(d)
