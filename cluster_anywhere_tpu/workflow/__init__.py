@@ -15,6 +15,8 @@ re-runs only the steps that never completed.
 """
 
 from .api import (
+    EventListener,
+    KVEventListener,
     WorkflowStatus,
     cancel,
     delete,
@@ -23,6 +25,8 @@ from .api import (
     get_status,
     list_all,
     resume,
+    signal_event,
+    wait_for_event,
     run,
     run_async,
 )

@@ -258,3 +258,70 @@ def cancel(workflow_id: str, *, storage_root: Optional[str] = None):
 
 def delete(workflow_id: str, *, storage_root: Optional[str] = None):
     WorkflowStorage(workflow_id, storage_root).delete()
+
+
+# --------------------------------------------------------------------- events
+class EventListener:
+    """Blocks a workflow step until an external event arrives (reference
+    workflow event system: workflow/api.py wait_for_event + event_listener).
+    Subclass and implement poll_for_event(); the returned payload becomes
+    the step's checkpointed result, so a resumed workflow never re-waits
+    for an event it already received."""
+
+    def poll_for_event(self) -> Any:
+        raise NotImplementedError
+
+
+class KVEventListener(EventListener):
+    """Built-in listener over the cluster KV: completes when some process
+    calls ``workflow.signal_event(key, payload)``."""
+
+    NS = "__workflow_events__"
+
+    def __init__(self, key: str, poll_interval_s: float = 0.1,
+                 timeout_s: Optional[float] = None):
+        self.key = key
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def poll_for_event(self) -> Any:
+        import pickle as _pickle
+
+        from ..core.worker import global_worker
+
+        w = global_worker()
+        deadline = (
+            time.monotonic() + self.timeout_s if self.timeout_s is not None else None
+        )
+        while True:
+            v = w.head_call("kv_get", ns=self.NS, key=self.key)["value"]
+            if v is not None:
+                return _pickle.loads(v)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"event {self.key!r} did not arrive")
+            time.sleep(self.poll_interval_s)
+
+
+def signal_event(key: str, payload: Any = None):
+    """Deliver the event that a KVEventListener step is waiting for."""
+    import pickle as _pickle
+
+    from ..core.worker import global_worker
+
+    global_worker().head_call(
+        "kv_put", ns=KVEventListener.NS, key=key, value=_pickle.dumps(payload)
+    )
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> DAGNode:
+    """A workflow step that completes when `listener_cls(*args).poll_for_event()`
+    returns; use its node as an upstream dependency of steps that need the
+    event payload."""
+    if not (isinstance(listener_cls, type) and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event expects an EventListener subclass")
+
+    @ca.remote
+    def __wf_wait_for_event(cls, cls_args, cls_kwargs):
+        return cls(*cls_args, **cls_kwargs).poll_for_event()
+
+    return __wf_wait_for_event.bind(listener_cls, args, kwargs)
