@@ -177,49 +177,18 @@ def cmd_dashboard(args):
 
 
 def cmd_microbenchmark(args):
-    """Single-node microbenchmarks (reference _private/ray_perf.py main)."""
+    """Single-node microbenchmarks (reference _private/ray_perf.py main):
+    the canonical table — tasks/actors sync+async, put/get call rates, put
+    bandwidth, placement-group churn — for comparison with BASELINE.md."""
     import cluster_anywhere_tpu as ca
 
+    from .microbenchmark import run_microbenchmarks
+
     ca.init(num_cpus=args.num_cpus)
-    results = {}
-
-    @ca.remote
-    def nop():
-        return b"ok"
-
-    # warmup
-    ca.get([nop.remote() for _ in range(100)])
-    n = args.n
-    t0 = time.perf_counter()
-    ca.get([nop.remote() for _ in range(n)])
-    results["tasks_per_s"] = n / (time.perf_counter() - t0)
-
-    @ca.remote
-    class A:
-        def m(self):
-            return b"ok"
-
-    actors = [A.remote() for _ in range(4)]
-    ca.get([a.m.remote() for a in actors])
-    t0 = time.perf_counter()
-    ca.get([actors[i % 4].m.remote() for i in range(n)])
-    results["actor_calls_per_s"] = n / (time.perf_counter() - t0)
-
-    import numpy as np
-
-    mb = 64
-    arr = np.random.default_rng(0).bytes(mb * 1024 * 1024)
-    t0 = time.perf_counter()
-    for _ in range(5):
-        ref = ca.put(arr)
-    results["put_gb_s"] = 5 * mb / 1024 / (time.perf_counter() - t0)
-    t0 = time.perf_counter()
-    for _ in range(5):
-        ca.get(ref)
-    results["get_gb_s"] = 5 * mb / 1024 / (time.perf_counter() - t0)
-    for k, v in results.items():
-        print(f"{k}: {v:,.1f}")
-    ca.shutdown()
+    try:
+        run_microbenchmarks(quick=getattr(args, "quick", False))
+    finally:
+        ca.shutdown()
 
 
 def main(argv=None):
@@ -291,7 +260,7 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("microbenchmark", help="single-node perf microbenchmarks")
-    sp.add_argument("-n", type=int, default=2000)
+    sp.add_argument("--quick", action="store_true", help="scaled-down run")
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)
 

@@ -1,0 +1,164 @@
+"""`ca microbenchmark` — the reference's `ray microbenchmark`
+(python/ray/_private/ray_perf.py:93) surface: one command printing the
+canonical single-node micro numbers so users can compare environments
+against BASELINE.md's published table.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+
+def _rate(n: int, fn: Callable[[], None]) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return n / (time.perf_counter() - t0)
+
+
+def run_microbenchmarks(quick: bool = False) -> List[Tuple[str, float, str]]:
+    """Returns [(metric, value, unit)] and prints them as it goes."""
+    from .core import api as ca
+
+    owns = not ca.is_initialized()
+    if owns:
+        ca.init(num_cpus=4)
+    results: List[Tuple[str, float, str]] = []
+
+    def record(name: str, value: float, unit: str):
+        results.append((name, value, unit))
+        print(f"{name}: {value:,.1f} {unit}")
+
+    scale = 0.2 if quick else 1.0
+
+    @ca.remote
+    def noop():
+        return None
+
+    # warm the pool AND wait out prestarted-worker registration: interpreter
+    # startups compete with the head for the core and poison early numbers
+    ca.get([noop.remote() for _ in range(50)])
+    from .core.worker import global_worker
+
+    w = global_worker()
+    deadline = time.monotonic() + 10
+    want = int(ca.cluster_resources().get("CPU", 1))
+    while time.monotonic() < deadline:
+        alive = [
+            x for x in w.head_call("list_workers")["workers"]
+            if x.get("state") in ("idle", "leased")
+        ]
+        if len(alive) >= want:
+            break
+        time.sleep(0.2)
+    time.sleep(0.5)
+
+    n = int(5000 * scale)
+    record(
+        "single client tasks async",
+        _rate(n, lambda: ca.get([noop.remote() for _ in range(n)])),
+        "/s",
+    )
+
+    n = int(500 * scale)
+
+    def sync_tasks():
+        for _ in range(n):
+            ca.get(noop.remote())
+
+    record("single client tasks sync", _rate(n, sync_tasks), "/s")
+
+    @ca.remote
+    class A:
+        def ping(self):
+            return None
+
+    a = A.remote()
+    ca.get(a.ping.remote())
+    n = int(5000 * scale)
+    record(
+        "1:1 actor calls async",
+        _rate(n, lambda: ca.get([a.ping.remote() for _ in range(n)])),
+        "/s",
+    )
+    n = int(500 * scale)
+
+    def sync_actor():
+        for _ in range(n):
+            ca.get(a.ping.remote())
+
+    record("1:1 actor calls sync", _rate(n, sync_actor), "/s")
+    from .core.actor import kill as _kill
+
+    _kill(a)
+
+    # puts: value churn through the object store
+    n = int(1000 * scale)
+    small = np.arange(16)
+    record(
+        "single client put calls",
+        _rate(n, lambda: [ca.put(small) for _ in range(n)]),
+        "/s",
+    )
+    n = int(2000 * scale)
+    refs = [ca.put(small) for _ in range(n)]
+    record(
+        "single client get calls",
+        _rate(n, lambda: [ca.get(r) for r in refs]),
+        "/s",
+    )
+    del refs
+
+    size = 64 * 1024 * 1024 if quick else 256 * 1024 * 1024
+    arr = np.frombuffer(np.random.bytes(size), dtype=np.uint8)
+    reps = 2 if quick else 4
+    warm = [ca.put(arr) for _ in range(reps)]
+    del warm
+    time.sleep(0.5)
+    t0 = time.perf_counter()
+    big = [ca.put(arr) for _ in range(reps)]
+    record(
+        "single client put gigabytes",
+        reps * size / (time.perf_counter() - t0) / 1e9,
+        "GB/s",
+    )
+    del big
+
+    # placement group create/remove churn.  Earlier phases' task leases
+    # idle-return after ~1s; wait for full capacity or the first PG goes
+    # PENDING and the average collapses to the service-tick cadence.
+    from .core.placement import placement_group, remove_placement_group
+
+    total_cpu = ca.cluster_resources().get("CPU", 0)
+    deadline = time.monotonic() + 10
+    while (
+        ca.available_resources().get("CPU", 0) < total_cpu
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.1)
+
+    n = int(100 * scale)
+
+    def pg_churn():
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1}])
+            pg.wait(10)
+            remove_placement_group(pg)
+
+    record("placement group create/removal", _rate(n, pg_churn), "/s")
+
+    if owns:
+        ca.shutdown()
+    return results
+
+
+def main(quick: bool = False):
+    run_microbenchmarks(quick=quick)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(quick="--quick" in sys.argv)

@@ -284,6 +284,8 @@ def test_host_collective_cross_node():
         create_collective_group,
     )
 
+    if ca.is_initialized():  # a module-scoped cluster may still be attached
+        ca.shutdown()
     c = Cluster(head_resources={"CPU": 2})
     nid = c.add_node(num_cpus=2)
     c.connect()
