@@ -33,6 +33,16 @@ def _sweep_stale_sessions(root: str):
 
     for name in os.listdir(root):
         path = os.path.join(root, name)
+        if name.startswith("client_"):
+            # client-mode scratch (pull caches): no head to probe — sweep
+            # once clearly abandoned
+            try:
+                if time.time() - os.path.getmtime(path) > 3600:
+                    shutil.rmtree(path, ignore_errors=True)
+                    shutil.rmtree(os.path.join("/dev/shm", name), ignore_errors=True)
+            except OSError:
+                pass
+            continue
         if not name.startswith("session_"):
             continue
         ready = os.path.join(path, "head.ready")
@@ -126,6 +136,30 @@ def init(
                 "existing cluster via address=; the head's values apply"
             )
         set_config(cfg)
+        if address.startswith("tcp:"):
+            # remote driver (Ray-Client analogue, ray:// role): connect to
+            # the head's TCP endpoint from a host with no session dir.  Puts
+            # upload to the head's store; worker/actor addresses arrive as
+            # TCP duals; pulled objects cache in a client-private namespace.
+            root = cfg.session_dir_root
+            os.makedirs(root, exist_ok=True)
+            sdir = os.path.join(root, f"client_{int(time.time()*1000)}_{os.getpid()}")
+            os.makedirs(sdir, exist_ok=True)
+            _session_dir = sdir
+            w = Worker(
+                mode="driver",
+                session_dir=sdir,
+                head_sock=address,
+                config=cfg,
+                client_mode=True,
+            )
+            set_global_worker(w)
+            w.connect()
+            return {
+                "session_dir": sdir,
+                "node_id": w.node_id,
+                "resources": w.total_resources,
+            }
         sdir = _find_session(address, cfg.session_dir_root)
         _session_dir = sdir
         w = Worker(
@@ -216,10 +250,20 @@ def init(
 def shutdown():
     global _head_proc, _session_dir
     w = try_global_worker()
+    client_cleanup = None
     if w is not None:
+        if w.client_mode:
+            # client-private scratch: this host's pull-cache namespace and
+            # session dir are invisible to the cluster — remove them here
+            client_cleanup = (w.session_name, w.session_dir)
         # only a driver that spawned the head tears the cluster down; a
         # driver that joined via address= just disconnects
         w.shutdown(stop_cluster=_head_proc is not None)
+    if client_cleanup is not None:
+        import shutil
+
+        shutil.rmtree(os.path.join("/dev/shm", client_cleanup[0]), ignore_errors=True)
+        shutil.rmtree(client_cleanup[1], ignore_errors=True)
     if _head_proc is not None:
         try:
             _head_proc.wait(timeout=5)

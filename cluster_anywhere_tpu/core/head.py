@@ -33,7 +33,7 @@ from typing import Any, Dict, List, Optional
 
 from . import scheduling
 from .config import CAConfig
-from .errors import ActorDiedError, PlacementGroupError
+from .errors import ActorDiedError, ObjectStoreFullError, PlacementGroupError
 from .protocol import Connection, Server, connect_addr, spawn_bg, write_frame
 
 LOCAL_NODE = "n0"
@@ -80,6 +80,7 @@ class WorkerRec:
     blocked: bool = False  # blocked in get(); its cpus are released
     busy_since: float = 0.0  # monotonic time the current lease/actor began
     tpu_chip: Optional[int] = None  # pinned chip id (multi-chip hosts only)
+    addr_tcp: Optional[str] = None  # TCP dual of addr, for remote clients
 
 
 @dataclass
@@ -149,6 +150,7 @@ class LeaseReq:
     pg_id: Optional[str] = None
     bundle_index: int = -1
     strategy: Optional[dict] = None
+    remote: bool = False  # requester is a remote client: hand out TCP addrs
 
 
 @dataclass
@@ -668,7 +670,11 @@ class Head:
             if req.pg_id:
                 self._lease_pg[lease_id] = (req.pg_id, req.bundle_index)
             self.stats["leases_granted"] += 1
-            req.reply(lease_id=lease_id, worker_id=wid, addr=rec.addr)
+            req.reply(
+                lease_id=lease_id,
+                worker_id=wid,
+                addr=self._addr_for(rec, req.remote),
+            )
             return True
         return False
 
@@ -1098,6 +1104,10 @@ class Head:
             await self._register_agent(state, msg, reply, reply_err)
             return
         state["node_id"] = msg.get("node_id", LOCAL_NODE)
+        # remote (Ray-Client-analogue) drivers: they reach workers over TCP
+        # only, and their node is a client-private namespace no one schedules
+        # onto — worker/actor addresses handed to them must be the TCP duals
+        state["remote"] = bool(msg.get("remote"))
         # every client gets its private shm-reclaim channel (arena slices can
         # only be freed by their owner's allocator)
         self.subscribers.setdefault(f"shm_free:{client_id}", []).append(state["writer"])
@@ -1119,6 +1129,8 @@ class Head:
                 self.workers[client_id] = rec
             if msg.get("addr"):
                 rec.addr = msg["addr"]
+            if msg.get("addr_tcp"):
+                rec.addr_tcp = msg["addr_tcp"]
             if msg.get("pid"):
                 rec.pid = msg["pid"]
             rec.last_heartbeat = time.monotonic()
@@ -1216,6 +1228,7 @@ class Head:
             pg_id=msg.get("pg_id"),
             bundle_index=msg.get("bundle_index", -1),
             strategy=msg.get("strategy"),
+            remote=bool(state.get("remote")),
         )
         if not self._try_grant(req):
             self.pending_leases.append(req)
@@ -1283,10 +1296,17 @@ class Head:
         self.actors[a.actor_id] = a
         await self._place_actor(a)
         if a.state == "alive":
-            reply(addr=a.addr, incarnation=a.incarnation)
+            reply(addr=self._actor_addr_for(a, state), incarnation=a.incarnation)
         else:
             self._drop_actor_name(a)
             reply_err(ActorDiedError(a.death_cause))
+
+    def _actor_addr_for(self, a: ActorRec, state) -> Optional[str]:
+        if state.get("remote") and a.worker_id:
+            rec = self.workers.get(a.worker_id)
+            if rec is not None and rec.addr_tcp:
+                return rec.addr_tcp
+        return a.addr
 
     async def _h_get_actor(self, state, msg, reply, reply_err):
         aid = msg.get("actor_id")
@@ -1301,6 +1321,7 @@ class Head:
             return
         info = self._actor_info(a)
         info["fn_id"] = a.fn_id
+        info["addr"] = self._actor_addr_for(a, state)
         reply(**info)
 
     async def _h_kill_actor(self, state, msg, reply, reply_err):
@@ -1385,6 +1406,77 @@ class Head:
         self._pub(msg["ch"], msg.get("data"))
 
     # objects --------------------------------------------------------------
+    # ---- remote-client object upload (Ray-Client analogue data path) ----
+    # A remote driver's /dev/shm is invisible to the cluster, so its puts
+    # stream here in chunks; the head hosts the bytes in its own n0
+    # namespace and registers the object with the client as owner.
+
+    async def _h_client_put_begin(self, state, msg, reply, reply_err):
+        import mmap as _mmap
+
+        oid = msg["oid"]
+        size = int(msg["size"])
+        if size > self.config.object_store_memory:
+            # no spill path exists for client uploads: refuse anything the
+            # head's store budget could never hold rather than filling
+            # /dev/shm until the whole node falls over
+            reply_err(ObjectStoreFullError(
+                f"client put of {size} bytes exceeds the head's object store "
+                f"budget ({self.config.object_store_memory})"
+            ))
+            return
+        name = f"{self.session_name}/{LOCAL_NODE}/cput_{oid.hex()}"
+        path = os.path.join("/dev/shm", name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+        try:
+            os.ftruncate(fd, max(size, 1))
+            m = _mmap.mmap(fd, max(size, 1))
+        finally:
+            os.close(fd)
+        state.setdefault("cput", {})[oid] = (name, m, size)
+        reply(name=name)
+
+    async def _h_client_put_chunk(self, state, msg, reply, reply_err):
+        ent = state.get("cput", {}).get(msg["oid"])
+        if ent is None:
+            reply_err(ValueError("client_put_begin missing for this oid"))
+            return
+        _, m, _ = ent
+        off = msg["off"]
+        data = msg["data"]
+        m[off : off + len(data)] = data
+        reply()
+
+    async def _h_client_put_seal(self, state, msg, reply, reply_err):
+        oid = msg["oid"]
+        ent = state.get("cput", {}).pop(oid, None)
+        if ent is None:
+            reply_err(ValueError("client_put_begin missing for this oid"))
+            return
+        name, m, size = ent
+        m.close()
+        existing = self.objects.get(oid)
+        if existing is not None:
+            if existing.shm_name and existing.shm_name != name:
+                self._free_shm_name(existing.shm_name, existing.node_id)
+            existing.shm_name = name
+            existing.size = size
+            existing.node_id = LOCAL_NODE
+            existing.copies.clear()
+        else:
+            rec = ObjectRec(
+                oid=oid,
+                shm_name=name,
+                size=size,
+                owner=state.get("client_id", "?"),
+                node_id=LOCAL_NODE,
+            )
+            rec.holders |= self._early_refs.pop(oid, set())
+            self.objects[oid] = rec
+            self.stats["objects_created"] += 1
+        reply(name=name)
+
     async def _h_obj_created(self, state, msg, reply, reply_err):
         oid = msg["oid"]
         existing = self.objects.get(oid)
@@ -1480,9 +1572,16 @@ class Head:
             self.stats["objects_transferred"] += 1
         reply()
 
+    def _addr_for(self, rec: WorkerRec, remote: bool) -> str:
+        """The address a client should dial for this worker: remote (Ray-
+        Client-analogue) drivers can only reach TCP listeners."""
+        return rec.addr_tcp if remote and rec.addr_tcp else rec.addr
+
     def _pull_addr_for(self, node_id: str) -> Optional[str]:
         """Where to pull a node's objects from: the head itself serves n0's
-        namespace; agents serve theirs."""
+        namespace; agents serve theirs; remote-client namespaces have no
+        server (their puts are uploaded to n0, so nothing lives there that
+        another node would pull)."""
         if node_id == LOCAL_NODE:
             return self.tcp_addr
         node = self.nodes.get(node_id)
@@ -1938,6 +2037,18 @@ class Head:
                 await self._on_node_death(node)
             return
         self._sweep_client_arenas(cid, state.get("node_id", LOCAL_NODE))
+        # abort any client uploads cut off mid-stream: close the mmaps and
+        # unlink the partial cput files, or crashed-client retries accumulate
+        # leaked multi-GB segments until teardown
+        for name, m, _size in state.pop("cput", {}).values():
+            try:
+                m.close()
+            except Exception:
+                pass
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass
         # drop this client's pubsub channel and its holder entries (incl. the
         # "<cid>#v" value pins) so departed readers can't pin objects forever
         self.subscribers.pop(f"shm_free:{cid}", None)

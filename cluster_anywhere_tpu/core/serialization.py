@@ -114,7 +114,11 @@ def pack_chunks(value: Any):
     chunk straight into place, saving a full extra copy of every large
     tensor/array buffer.  Chunk layout is byte-identical to pack()."""
     data, buffers = serialize(value)
-    raws = [b.raw() for b in buffers]
+    return pack_chunks_from_parts(data, [b.raw() for b in buffers])
+
+
+def pack_chunks_from_parts(data: bytes, raws: List[Any]):
+    """pack_chunks for already-serialized (pickle_bytes, raw_buffers)."""
     header = msgpack.packb(
         {"p": data, "l": [len(r) for r in raws]}, use_bin_type=True
     )

@@ -286,6 +286,8 @@ class Worker:
         client_id: Optional[str] = None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
         serve_addr: Optional[str] = None,
+        serve_addr_tcp: Optional[str] = None,
+        client_mode: bool = False,
     ):
         self.mode = mode  # "driver" | "worker"
         self.session_dir = session_dir
@@ -294,12 +296,18 @@ class Worker:
         self.config = config or get_config()
         self.client_id = client_id or f"{mode}-{os.getpid()}-{os.urandom(3).hex()}"
         self.serve_addr = serve_addr
+        self.serve_addr_tcp = serve_addr_tcp
+        # Ray-Client-analogue remote driver: reaches the cluster over TCP
+        # only, claims a private client node id (its /dev/shm is invisible to
+        # the cluster), and uploads escaping objects to the head's store
+        self.client_mode = client_mode
         self.job_id = JobID.from_random()
         # which node this process runs on (n0 = the head's own node; agent
-        # nodes set CA_NODE_ID for their workers).  Limitation: a driver must
-        # run on the head's host — a cross-host driver would wrongly claim n0
-        # (remote drivers belong to the Ray-Client-analogue milestone).
-        self.node_id = os.environ.get("CA_NODE_ID", "n0")
+        # nodes set CA_NODE_ID for their workers)
+        if client_mode:
+            self.node_id = f"client-{self.client_id}"
+        else:
+            self.node_id = os.environ.get("CA_NODE_ID", "n0")
         self.memory_store = MemoryStore()
         self.shm_store = ShmObjectStore(
             self.session_name,
@@ -308,9 +316,11 @@ class Worker:
             budget_bytes=(config or get_config()).object_store_memory,
         )
         self.shm_store.spill_cb = self._spill_bytes
-        if mode == "driver":
+        if mode == "driver" and not client_mode:
             # plasma-style pre-allocation: warm an arena while the driver is
-            # still bootstrapping so early puts land in pre-faulted pages
+            # still bootstrapping so early puts land in pre-faulted pages.
+            # Client mode skips it: its local store only caches pulled
+            # copies, and puts upload to the head instead
             self.shm_store.warm()
         self.fn_manager = FunctionManager()
         self.reference_counter = ReferenceCounter(self._flush_refs)
@@ -435,7 +445,9 @@ class Worker:
             client_id=self.client_id,
             pid=os.getpid(),
             addr=self.serve_addr or "",
+            addr_tcp=self.serve_addr_tcp or "",
             node_id=self.node_id,
+            remote=self.client_mode,
         )
         self.total_resources = reply["resources"]
         self._housekeeping_task = spawn_bg(self._housekeeping())
@@ -447,7 +459,9 @@ class Worker:
         if ch == "actors":
             data = msg.get("data") or {}
             aid = data.get("actor_id")
-            if aid and data.get("addr"):
+            if aid and data.get("addr") and not self.client_mode:
+                # remote clients can't use pub'd (unix) addrs; they refresh
+                # through get_actor, which maps to the TCP dual
                 self._actor_addr_cache[aid] = (data["addr"], data.get("incarnation", 0))
         elif ch == f"shm_free:{self.client_id}":
             data = msg.get("data") or {}
@@ -490,7 +504,9 @@ class Worker:
                 client_id=self.client_id,
                 pid=os.getpid(),
                 addr=self.serve_addr or "",
+                addr_tcp=self.serve_addr_tcp or "",
                 node_id=self.node_id,
+                remote=self.client_mode,
                 timeout=5,
             )
         except Exception as e:
@@ -514,10 +530,25 @@ class Worker:
         except RuntimeError:
             pass
 
+    def _normalize_peer_addr(self, addr: str) -> str:
+        """Remote clients may receive TCP duals bound to a wildcard host
+        (head_host=0.0.0.0): substitute the host we actually dialed the head
+        on — the cluster host as seen from here."""
+        if (
+            self.client_mode
+            and addr.startswith(("tcp:0.0.0.0:", "tcp:::"))
+            and self.head_sock.startswith("tcp:")
+        ):
+            head_host = self.head_sock[4:].rpartition(":")[0]
+            port = addr.rpartition(":")[2]
+            return f"tcp:{head_host}:{port}"
+        return addr
+
     async def conn_to(self, addr: str) -> Connection:
         """One connection per peer.  Concurrent first-callers share a single
         connect (a stampede would create several sockets and destroy
         per-caller actor-call ordering across them)."""
+        addr = self._normalize_peer_addr(addr)
         conn = self._conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
@@ -678,17 +709,102 @@ class Worker:
         if total < self.config.inline_object_max_bytes:
             self.memory_store.put_value(oid, value, size=total)
         else:
-            shm_name, size = self.shm_store.create_and_pack(oid, data, raws)
+            if self.client_mode:
+                # remote client: this host's shm is invisible to the cluster;
+                # stream the packed bytes to the head's store instead
+                shm_name, size = self._client_upload(oid, data, raws)
+            else:
+                shm_name, size = self.shm_store.create_and_pack(oid, data, raws)
             self.memory_store.put_shm(oid, shm_name, size)
             if nested:
                 self._promote_nested(nested)
-            self._notify_threadsafe(
-                "obj_created", oid=oid.binary(), shm_name=shm_name, size=size
-            )
+            if not self.client_mode:
+                self._notify_threadsafe(
+                    "obj_created", oid=oid.binary(), shm_name=shm_name, size=size
+                )
             if nested:
                 # borrowed refs inside the stored value live as long as the
                 # containing object (containment edges at the head)
                 self._notify_threadsafe("obj_contains", oid=oid.binary(), refs=nested)
+
+    def _client_upload(self, oid: ObjectID, data: bytes, raws: List[Any]) -> Tuple[str, int]:
+        """Client-mode put: chunk the packed bytes to the head, which hosts
+        them in its n0 namespace and registers this client as owner."""
+        from .serialization import pack_chunks_from_parts
+
+        total, chunks = pack_chunks_from_parts(data, raws)
+        return self._client_upload_chunks(oid, total, chunks)
+
+    def _client_upload_blob(self, oid: ObjectID, blob: bytes) -> Tuple[str, int]:
+        """Upload an already pack()-framed blob verbatim (client mode)."""
+        return self._client_upload_chunks(oid, len(blob), [blob])
+
+    def _client_upload_chunks(self, oid: ObjectID, total: int, chunks) -> Tuple[str, int]:
+        return self.run_coro(self._client_upload_chunks_async(oid, total, chunks))
+
+    async def _client_upload_chunks_async(
+        self, oid: ObjectID, total: int, chunks
+    ) -> Tuple[str, int]:
+        oid_b = oid.binary()
+        await self.head.call("client_put_begin", oid=oid_b, size=total)
+        limit = self.config.transfer_chunk_bytes
+        off = 0
+        for c in chunks:
+            # windowed sends straight off each chunk's memory: no concat
+            # buffer, no O(N^2) drain — one bytes() copy per packet (msgpack
+            # needs it) is the only extra traffic
+            mv = memoryview(c)
+            if mv.ndim != 1 or mv.itemsize != 1:
+                mv = mv.cast("B")
+            pos = 0
+            while pos < len(mv):
+                n = min(limit, len(mv) - pos)
+                await self.head.call(
+                    "client_put_chunk", oid=oid_b, off=off, data=bytes(mv[pos : pos + n])
+                )
+                off += n
+                pos += n
+        r = await self.head.call("client_put_seal", oid=oid_b)
+        return r["name"], total
+
+    async def _promote_nested_async(self, nested: List[bytes], depth: int = 0):
+        """Loop-thread-safe promotion for client mode: uploads await the
+        head directly instead of blocking head_call (which cannot run on
+        the IO loop).  Non-client promotion is local and needs no await."""
+        if not self.client_mode:
+            self._promote_nested(nested, depth)
+            return
+        if depth > 5:
+            return
+        for oid_b in nested:
+            oid = ObjectID(oid_b)
+            e = self.memory_store.get_entry(oid)
+            if e is None or e.shm_name is not None or e.state not in ("value", "packed"):
+                continue
+            try:
+                if e.state == "packed":
+                    sub: List[bytes] = []
+                    name, size = await self._client_upload_chunks_async(
+                        oid, len(e.packed), [e.packed]
+                    )
+                else:
+                    with serialization.ref_capture() as sub:
+                        data, buffers = serialization.serialize(e.value)
+                    from .serialization import pack_chunks_from_parts
+
+                    total, chunks = pack_chunks_from_parts(
+                        data, [b.raw() for b in buffers]
+                    )
+                    name, size = await self._client_upload_chunks_async(
+                        oid, total, chunks
+                    )
+            except Exception:
+                continue
+            e.shm_name = name
+            e.size = size
+            if sub:
+                await self._promote_nested_async(sub, depth + 1)
+                self._notify_threadsafe("obj_contains", oid=oid_b, refs=list(sub))
 
     # ------------------------------------------------------------------ get
     def get(self, refs, timeout: Optional[float] = None):
@@ -1232,26 +1348,36 @@ class Worker:
                 continue
             try:
                 if e.state == "packed":
-                    name, mv = self.shm_store.create_for_import(
-                        oid, len(e.packed), primary=True
-                    )
-                    mv[:] = e.packed
-                    mv.release()
-                    size = len(e.packed)
                     sub: List[bytes] = []
+                    if self.client_mode:
+                        # already pack()-framed: upload the blob verbatim
+                        name, size = self._client_upload_blob(oid, e.packed)
+                    else:
+                        name, mv = self.shm_store.create_for_import(
+                            oid, len(e.packed), primary=True
+                        )
+                        mv[:] = e.packed
+                        mv.release()
+                        size = len(e.packed)
                 else:
                     with serialization.ref_capture() as sub:
                         data, buffers = serialization.serialize(e.value)
-                    name, size = self.shm_store.create_and_pack(
-                        oid, data, [b.raw() for b in buffers]
-                    )
+                    if self.client_mode:
+                        name, size = self._client_upload(
+                            oid, data, [b.raw() for b in buffers]
+                        )
+                    else:
+                        name, size = self.shm_store.create_and_pack(
+                            oid, data, [b.raw() for b in buffers]
+                        )
             except Exception:
                 continue
             e.shm_name = name
             e.size = size
-            self._notify_threadsafe(
-                "obj_created", oid=oid_b, shm_name=name, size=size, node=self.node_id
-            )
+            if not self.client_mode:
+                self._notify_threadsafe(
+                    "obj_created", oid=oid_b, shm_name=name, size=size, node=self.node_id
+                )
             if sub:
                 self._promote_nested(sub, depth + 1)
                 self._notify_threadsafe("obj_contains", oid=oid_b, refs=list(sub))
@@ -1264,17 +1390,6 @@ class Worker:
         token = f"t:{self.client_id}:{self._put_counter.next()}"
         self._notify_threadsafe("obj_refs", inc=list(nested), as_id=token)
         return token
-
-    def _pack_with_transit(self, value: Any) -> dict:
-        """Pack an inline value; if it smuggles ObjectRefs, pin them at the
-        head under a transit token until the receiver acks (transit_done) —
-        the inline half of the borrowed-reference protocol."""
-        with serialization.ref_capture() as nested:
-            blob = serialization.pack(value)
-        if not nested:
-            return {"v": blob}
-        token = self.transit_pin(nested)
-        return {"v": blob, "t": token, "roids": nested}
 
     def transit_done(self, token: str, roids: List[bytes]) -> None:
         """Receiver-side ack: register this process as holder of the smuggled
@@ -1290,6 +1405,18 @@ class Worker:
             self.loop.call_soon_threadsafe(_send)
         except RuntimeError:
             pass
+
+    async def _pack_with_transit_async(self, value: Any) -> dict:
+        """_pack_with_transit usable on the IO loop: client-mode promotion
+        awaits the head instead of blocking head_call."""
+        with serialization.ref_capture() as nested:
+            blob = serialization.pack(value)
+        if not nested:
+            return {"v": blob}
+        await self._promote_nested_async(nested)
+        token = f"t:{self.client_id}:{self._put_counter.next()}"
+        self._notify_threadsafe("obj_refs", inc=list(nested), as_id=token)
+        return {"v": blob, "t": token, "roids": nested}
 
     async def _build_arg(self, value: Any) -> dict:
         """Build the wire spec for one task argument."""
@@ -1328,7 +1455,7 @@ class Worker:
             # small local value: inline (packed)
             if e.state == "packed":
                 return {"v": e.packed}
-            return self._pack_with_transit(e.value)
+            return await self._pack_with_transit_async(e.value)
         # plain value: device values stay on device when this process can
         # serve them (workers/actors); the driver materializes to host.
         if _is_device_value(value):
@@ -1342,7 +1469,7 @@ class Worker:
                 "owner": self.serve_addr,
                 "spec": _device_spec(value),
             }
-        return self._pack_with_transit(value)
+        return await self._pack_with_transit_async(value)
 
     async def _build_args(self, args: Sequence[Any], kwargs: Dict[str, Any]):
         if not args and not kwargs:

@@ -56,7 +56,13 @@ class WorkerProcess:
         if hasattr(asyncio, "eager_task_factory"):
             self.loop.set_task_factory(asyncio.eager_task_factory)
         self.worker: Optional[Worker] = None
-        self.server = Server(self.sock_path, self._handle, fast_handler=self._fast_handle)
+        # dual-bind: unix for same-host peers, a TCP dual so remote (Ray-
+        # Client-analogue) drivers can push tasks/actor calls directly
+        specs = [self.sock_path]
+        if not self.sock_path.startswith("tcp:"):
+            host = getattr(self.config, "head_host", "127.0.0.1")
+            specs.append(f"tcp:{host}:0")
+        self.server = Server(specs, self._handle, fast_handler=self._fast_handle)
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ca-exec"
         )
@@ -594,6 +600,9 @@ class WorkerProcess:
         # only known after bind (agent-spawned workers on other nodes)
         await self.server.start()
         self.sock_path = self.server.bound_addrs[0]
+        addr_tcp = next(
+            (a for a in self.server.bound_addrs if a.startswith("tcp:")), None
+        )
         self.worker = Worker(
             mode="worker",
             session_dir=self.session_dir,
@@ -602,6 +611,7 @@ class WorkerProcess:
             client_id=self.worker_id,
             loop=self.loop,
             serve_addr=self.sock_path,
+            serve_addr_tcp=addr_tcp,
         )
         set_global_worker(self.worker)
         await self.worker.connect_async()
