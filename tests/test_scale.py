@@ -1,0 +1,88 @@
+"""Scalability-envelope tests: trimmed versions of the reference's
+release/benchmarks single-node table (BASELINE.md) — many returns, many
+args, many objects, deep task queues, multi-GiB objects.  Bounds are
+completion deadlines (generous for shared CI hosts), not perf assertions;
+the envelope numbers themselves come from bench.py / ca microbenchmark."""
+
+import time
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    ca.init(num_cpus=4)
+    yield
+    ca.shutdown()
+
+
+def test_many_returns_from_one_task():
+    """3,000 returns from one task (baseline: 5.81 s)."""
+    n = 3000
+
+    @ca.remote
+    def burst():
+        return tuple(range(n))
+
+    refs = burst.options(num_returns=n).remote()
+    assert len(refs) == n
+    vals = ca.get(refs, timeout=120)
+    assert vals[0] == 0 and vals[-1] == n - 1
+
+
+def test_many_object_args_to_one_task():
+    """2,000 ObjectRef args resolved into a single task invocation
+    (baseline row: 10,000 args in 17.3 s on an m4.16xlarge)."""
+    n = 2000
+    refs = [ca.put(i) for i in range(n)]
+
+    @ca.remote
+    def total(*xs):
+        return sum(xs)
+
+    assert ca.get(total.remote(*refs), timeout=120) == n * (n - 1) // 2
+
+
+def test_get_many_objects():
+    """ca.get over 5,000 distinct objects (baseline row: 10,000 in 23.9 s)."""
+    n = 5000
+    refs = [ca.put(i) for i in range(n)]
+    vals = ca.get(refs, timeout=120)
+    assert vals == list(range(n))
+
+
+def test_deep_task_queue():
+    """20,000 tasks queued at once on 4 CPUs drain to completion (baseline
+    row: 1,000,000 queued tasks in 193 s on a 64-core box)."""
+    n = 20_000
+
+    @ca.remote
+    def one():
+        return 1
+
+    t0 = time.monotonic()
+    refs = [one.remote() for _ in range(n)]
+    out = ca.get(refs, timeout=300)
+    assert sum(out) == n
+    assert time.monotonic() - t0 < 300
+
+
+def test_multi_gib_object_roundtrip():
+    """A single ~1.5 GiB object puts at arena speed and reads back zero-copy
+    (baseline envelope: 100 GiB single object at ~3.5 GB/s on a machine
+    with the RAM for it)."""
+    size = 3 * 512 * 1024 * 1024 // 4  # 1.5 GiB of float32
+    arr = np.ones(size // 4, dtype=np.float32)
+    t0 = time.monotonic()
+    ref = ca.put(arr)
+    put_s = time.monotonic() - t0
+    back = ca.get(ref, timeout=120)
+    assert back.nbytes == arr.nbytes
+    assert back[0] == 1.0 and back[-1] == 1.0
+    assert put_s < 60, f"1.5 GiB put took {put_s:.1f}s"
+    del back, ref
