@@ -24,6 +24,7 @@ from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .controller import CONTROLLER_NAME, ServeController, get_or_create_controller
 from .multiplex import get_multiplexed_model_id, multiplexed
+from .grpc_proxy import grpc_call
 from .proxy import ProxyActor, Request
 from .replica import get_request_context
 from .router import DeploymentHandle, DeploymentResponse
@@ -150,20 +151,38 @@ def _resolve_arg(a, app_name: str):
     return a
 
 
-def start(http_options: Optional[HTTPOptions] = None, **kw) -> None:
-    """Start the Serve system actors (controller + HTTP proxy)."""
+GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
+
+
+def start(http_options: Optional[HTTPOptions] = None, grpc_port: Optional[int] = None, **kw) -> None:
+    """Start the Serve system actors (controller + HTTP proxy; pass
+    grpc_port to also start the gRPC ingress)."""
     get_or_create_controller()
     opts = http_options or HTTPOptions(**kw)
     try:
         get_actor(PROXY_NAME)
-        return
     except Exception:
-        pass
-    Proxy = ca.remote(ProxyActor).options(
-        name=PROXY_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=4
-    )
-    h = Proxy.remote(opts.host, opts.port)
-    ca.get(h.ready.remote(), timeout=30)
+        Proxy = ca.remote(ProxyActor).options(
+            name=PROXY_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=4
+        )
+        h = Proxy.remote(opts.host, opts.port)
+        ca.get(h.ready.remote(), timeout=30)
+    if grpc_port is not None:
+        start_grpc_proxy(port=grpc_port)
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Start (or find) the gRPC ingress; returns its host:port target."""
+    from .grpc_proxy import GrpcProxyActor
+
+    try:
+        h = get_actor(GRPC_PROXY_NAME)
+    except Exception:
+        Proxy = ca.remote(GrpcProxyActor).options(
+            name=GRPC_PROXY_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=4
+        )
+        h = Proxy.remote(host, port)
+    return ca.get(h.ready.remote(), timeout=30)
 
 
 def run(
@@ -246,6 +265,8 @@ __all__ = [
     "Application",
     "run",
     "start",
+    "start_grpc_proxy",
+    "grpc_call",
     "delete",
     "shutdown",
     "status",

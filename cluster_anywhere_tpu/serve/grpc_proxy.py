@@ -1,0 +1,124 @@
+"""gRPC ingress for Serve (reference serve/_private/proxy.py gRPCProxy:532).
+
+Proto-free design: a generic handler serves
+``/cluster_anywhere_tpu.serve.Ingress/Call`` unary-unary with pickled
+payloads, routing by the ``application`` request metadatum to that app's
+ingress deployment — the same controller-synced route table the HTTP proxy
+uses.  No .proto compilation step, no per-model service definitions; typed
+protos can layer on top by pickling their own bytes.
+
+Client side: ``grpc_call(target, application, *args, **kwargs)``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional
+
+SERVICE = "cluster_anywhere_tpu.serve.Ingress"
+METHOD = f"/{SERVICE}/Call"
+
+
+class GrpcProxyActor:
+    """Serve's gRPC ingress: one generic unary-unary method, app routing by
+    metadata, replica scheduling through DeploymentHandle."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import grpc
+
+        self._apps: Dict[str, Any] = {}  # app name -> DeploymentHandle
+        self._lock = threading.Lock()
+
+        outer = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method != METHOD:
+                    return None
+                md = dict(handler_call_details.invocation_metadata or ())
+                app = md.get("application", "default")
+
+                def _unary(request_bytes, context):
+                    handle = outer._handle_for(app)
+                    if handle is None:
+                        context.abort(
+                            grpc.StatusCode.NOT_FOUND,
+                            f"no serve application {app!r}",
+                        )
+                    try:
+                        args, kwargs = pickle.loads(request_bytes)
+                        result = handle.remote(*args, **kwargs).result(timeout_s=60)
+                        return pickle.dumps(result)
+                    except Exception as e:  # noqa: BLE001 — surfaced as status
+                        context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    _unary,
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((_Handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+        self._server.start()
+        self._refresher = threading.Thread(
+            target=self._refresh_loop, daemon=True, name="grpc-proxy-routes"
+        )
+        self._refresher.start()
+
+    def ready(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _handle_for(self, app: str) -> Optional[Any]:
+        with self._lock:
+            return self._apps.get(app)
+
+    def _refresh_loop(self):
+        from ..core import api as ca
+        from ..core.actor import get_actor
+        from .controller import CONTROLLER_NAME
+        from .router import DeploymentHandle
+
+        while True:
+            try:
+                ctrl = get_actor(CONTROLLER_NAME)
+                routes = ca.get(ctrl.list_routes.remote(), timeout=10)
+                new = {
+                    app: DeploymentHandle(app, info["ingress"])
+                    for app, info in routes.items()
+                    if info["ingress"]
+                }
+                with self._lock:
+                    for app, h in new.items():
+                        cur = self._apps.get(app)
+                        if cur is None or cur.deployment != h.deployment:
+                            self._apps[app] = h
+                    for app in list(self._apps):
+                        if app not in new:
+                            del self._apps[app]
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+    def stop(self):
+        self._server.stop(grace=1.0)
+
+
+def grpc_call(target: str, application: str, *args, timeout: float = 60.0, **kwargs):
+    """Invoke a serve application through the gRPC ingress."""
+    import grpc
+
+    with grpc.insecure_channel(target) as channel:
+        fn = channel.unary_unary(METHOD)
+        out = fn(
+            pickle.dumps((args, kwargs)),
+            metadata=(("application", application),),
+            timeout=timeout,
+        )
+        return pickle.loads(out)

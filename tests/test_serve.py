@@ -280,3 +280,26 @@ def test_replica_failure_recovery():
     else:
         assert False, "replica never recovered"
     serve.delete("frag")
+
+
+def test_grpc_ingress(ca_cluster_module):
+    """gRPC proxy (serve/_private/proxy.py gRPCProxy role): unary calls with
+    pickled payloads route by application metadata to the ingress."""
+    pytest.importorskip("grpc")
+    from cluster_anywhere_tpu import serve
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x, scale=2):
+            return x * scale
+
+    serve.run(Doubler.bind(), name="grpcapp", route_prefix="/grpcapp")
+    target = serve.start_grpc_proxy()
+    assert serve.grpc_call(target, "grpcapp", 21) == 42
+    assert serve.grpc_call(target, "grpcapp", 5, scale=10) == 50
+    # unknown application -> NOT_FOUND status surfaces as RpcError
+    import grpc as _grpc
+
+    with pytest.raises(_grpc.RpcError):
+        serve.grpc_call(target, "no_such_app", 1, timeout=10)
+    serve.delete("grpcapp")
