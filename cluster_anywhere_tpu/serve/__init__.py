@@ -27,7 +27,7 @@ from .multiplex import get_multiplexed_model_id, multiplexed
 from .grpc_proxy import grpc_call
 from .proxy import ProxyActor, Request
 from .replica import get_request_context
-from .router import DeploymentHandle, DeploymentResponse
+from .router import DeploymentHandle, DeploymentResponseGenerator, DeploymentResponse
 
 PROXY_NAME = "SERVE_PROXY"
 

@@ -171,6 +171,11 @@ class ProxyActor:
                 return
             _, handle = match
             loop = asyncio.get_running_loop()
+            if "text/event-stream" in req.headers.get("accept", ""):
+                # SSE: iterate the deployment's generator, one event per item
+                # (reference proxy StreamingResponse path; LLM token streams)
+                await self._respond_sse(writer, handle, req, loop)
+                return
             # handle.remote() blocks briefly (routing) and result() blocks
             # until done — run both off the event loop
             result = await loop.run_in_executor(
@@ -180,6 +185,45 @@ class ProxyActor:
         except Exception as e:
             traceback.print_exc()
             await self._respond(writer, 500, {"error": repr(e)})
+
+    async def _respond_sse(self, writer, handle, req: Request, loop):
+        import json as _json
+        import queue as _queue
+
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        q: _queue.Queue = _queue.Queue(maxsize=64)
+        _END = object()
+
+        def pump():
+            try:
+                for item in handle.options(stream=True).remote(req):
+                    q.put(item)
+            except Exception as e:  # noqa: BLE001 — forwarded as an event
+                q.put({"error": repr(e)})
+            finally:
+                q.put(_END)
+
+        loop.run_in_executor(None, pump)
+        while True:
+            item = await loop.run_in_executor(None, q.get)
+            if item is _END:
+                break
+            if isinstance(item, bytes):
+                data = item.decode("utf-8", "replace")
+            elif isinstance(item, str):
+                data = item
+            else:
+                data = _json.dumps(item, default=str)
+            writer.write(f"data: {data}\n\n".encode())
+            await writer.drain()
+        try:
+            writer.close()
+        except Exception:
+            pass
 
     async def _respond(self, writer, code: int, payload: Any):
         try:

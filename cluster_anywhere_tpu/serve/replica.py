@@ -130,3 +130,29 @@ class Replica:
         finally:
             _request_context.reset(token)
             self.num_ongoing -= 1
+
+    def handle_request_streaming(self, meta: Dict[str, Any], *args, **kwargs):
+        """Generator twin of handle_request: iterates the user method's
+        generator so items stream back as ObjectRefGenerator frames
+        (reference replica.py streaming path)."""
+        self.num_ongoing += 1
+        self.total_requests += 1
+        token = _request_context.set(
+            RequestContext(
+                request_id=meta.get("request_id", ""),
+                multiplexed_model_id=meta.get("multiplexed_model_id", ""),
+            )
+        )
+        try:
+            target = self.instance
+            fn = target if self._is_function else getattr(
+                target, meta.get("method", "__call__")
+            )
+            out = fn(*args, **kwargs)
+            if not hasattr(out, "__iter__") or isinstance(out, (str, bytes, dict)):
+                yield out  # non-generator result: one-item stream
+                return
+            yield from out
+        finally:
+            _request_context.reset(token)
+            self.num_ongoing -= 1

@@ -52,6 +52,20 @@ class DeploymentResponse:
         return _wait().__await__()
 
 
+class DeploymentResponseGenerator:
+    """Iterable result of handle.options(stream=True).remote() (reference
+    serve/handle.py DeploymentResponseGenerator): yields the replica
+    generator's items in production order with streaming backpressure."""
+
+    def __init__(self, gen_future):
+        self._gen_future = gen_future
+
+    def __iter__(self):
+        gen = self._gen_future.result(30)  # ObjectRefGenerator
+        for ref in gen:
+            yield ca.get(ref, timeout=60)
+
+
 class Router:
     def __init__(self, app: str, deployment: str):
         import concurrent.futures
@@ -148,6 +162,30 @@ class Router:
         self._watch_completion(rid, ref)
         return ref
 
+    def route_streaming(self, meta: Dict[str, Any], args, kwargs):
+        """Like route(), but invokes the replica's streaming twin and returns
+        an ObjectRefGenerator.  Inflight is released at submit: stream
+        lifetimes are unbounded (token generation), so queue-gating on them
+        would starve the replica for regular traffic."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            pick = self._pick()
+            if pick is not None:
+                rid = pick["replica_id"]
+                if self._inflight.get(rid, 0) < self._max_ongoing:
+                    break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no available replica for {self.app}/{self.deployment}"
+                )
+            time.sleep(0.01 if pick is None else 0.001)
+            self._refresh(force=pick is None)
+        h = self._handle_for(rid, pick["actor_name"])
+        return h.handle_request_streaming.options(num_returns="streaming").remote(
+            meta, *args, **kwargs
+        )
+
     def _watch_completion(self, rid: str, ref):
         """One watcher thread per router drains completions in batches (a
         thread per request would be far too heavy for the request path)."""
@@ -209,6 +247,7 @@ class DeploymentHandle:
         self.deployment = deployment
         self._method = method
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = False
         self._router: Optional[Router] = None
 
     # serialization: drop the router; the receiving process builds a new one
@@ -218,16 +257,22 @@ class DeploymentHandle:
             "deployment": self.deployment,
             "_method": self._method,
             "_multiplexed_model_id": self._multiplexed_model_id,
+            "_stream": self._stream,
         }
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self._stream = state.get("_stream", False)
         self._router = None
 
     def options(
-        self, *, method_name: Optional[str] = None, multiplexed_model_id: Optional[str] = None
+        self,
+        *,
+        method_name: Optional[str] = None,
+        multiplexed_model_id: Optional[str] = None,
+        stream: Optional[bool] = None,
     ) -> "DeploymentHandle":
-        return DeploymentHandle(
+        h = DeploymentHandle(
             self.app,
             self.deployment,
             method_name or self._method,
@@ -235,13 +280,15 @@ class DeploymentHandle:
             if multiplexed_model_id is not None
             else self._multiplexed_model_id,
         )
+        h._stream = self._stream if stream is None else bool(stream)
+        return h
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_") or name in ("app", "deployment"):
             raise AttributeError(name)
         return DeploymentHandle(self.app, self.deployment, name, self._multiplexed_model_id)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         if self._router is None:
             self._router = _shared_router(self.app, self.deployment)
         meta = {
@@ -249,6 +296,11 @@ class DeploymentHandle:
             "method": self._method,
             "multiplexed_model_id": self._multiplexed_model_id,
         }
+        if self._stream:
+            fut = self._router._dispatch.submit(
+                self._router.route_streaming, meta, args, kwargs
+            )
+            return DeploymentResponseGenerator(fut)
         fut = self._router._dispatch.submit(self._router.route, meta, args, kwargs)
         return DeploymentResponse(fut)
 

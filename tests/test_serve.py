@@ -303,3 +303,48 @@ def test_grpc_ingress(ca_cluster_module):
     with pytest.raises(_grpc.RpcError):
         serve.grpc_call(target, "no_such_app", 1, timeout=10)
     serve.delete("grpcapp")
+
+
+def test_streaming_deployment_handle_and_sse(ca_cluster_module):
+    """Generator deployments stream: handle.options(stream=True) yields items
+    in order, and the HTTP proxy serves them as SSE events when the client
+    asks for text/event-stream (LLM token-streaming path)."""
+    import socket
+
+    from cluster_anywhere_tpu import serve
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, req):
+            n = int(req.query_params.get("n", 4)) if hasattr(req, "query_params") else int(req)
+            for i in range(n):
+                yield f"tok{i}"
+
+    h = serve.run(Tokens.bind(), name="sse", route_prefix="/sse")
+    # direct streaming handle
+    got = list(h.options(stream=True).remote(3))
+    assert got == ["tok0", "tok1", "tok2"]
+
+    # SSE through the proxy
+    serve.start()
+    from cluster_anywhere_tpu.core.actor import get_actor
+
+    proxy = get_actor("SERVE_PROXY")
+    url = ca.get(proxy.ready.remote(), timeout=30)
+    host, port = url.replace("http://", "").split(":")
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.sendall(
+        b"GET /sse?n=4 HTTP/1.1\r\nHost: x\r\nAccept: text/event-stream\r\n\r\n"
+    )
+    buf = b""
+    s.settimeout(30)
+    while b"data: tok3" not in buf:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    text = buf.decode()
+    assert "Content-Type: text/event-stream" in text
+    assert [f"data: tok{i}" in text for i in range(4)] == [True] * 4
+    serve.delete("sse")
