@@ -23,6 +23,10 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import subprocess
+import threading
+import time
+import uuid
 from typing import Any
 
 _PAGE = """<!doctype html>
@@ -77,8 +81,11 @@ class Dashboard:
         self.head = head
         self._server = None
         self.addr = None
+        self._loop = None
+        self._rest_jobs = {}  # submission_id -> Popen (REST-submitted)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(self._on_client, host, port)
         h, p = self._server.sockets[0].getsockname()[:2]
         self.addr = f"http://{h}:{p}"
@@ -96,16 +103,27 @@ class Dashboard:
         try:
             req = await asyncio.wait_for(reader.readline(), 10)
             parts = req.decode("latin1").split()
-            if len(parts) < 2 or parts[0] != "GET":
-                await self._respond(writer, 405, "text/plain", b"GET only")
+            if len(parts) < 2 or parts[0] not in ("GET", "POST"):
+                await self._respond(writer, 405, "text/plain", b"GET/POST only")
                 return
-            path = parts[1]
-            while True:  # drain headers
+            method, path = parts[0], parts[1]
+            clen = 0
+            while True:  # drain headers, keep content-length
                 line = await asyncio.wait_for(reader.readline(), 10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            status, ctype, body = self._route(path)
-            await self._respond(writer, status, ctype, body)
+                k, _, v = line.decode("latin1").partition(":")
+                if k.strip().lower() == "content-length":
+                    try:
+                        clen = min(int(v.strip()), 1 << 20)
+                    except ValueError:
+                        clen = 0
+            body = await reader.readexactly(clen) if clen else b""
+            if method == "POST":
+                status, ctype, resp = self._route_post(path, body)
+            else:
+                status, ctype, resp = self._route(path)
+            await self._respond(writer, status, ctype, resp)
         except Exception:
             pass
         finally:
@@ -190,6 +208,16 @@ class Dashboard:
                     }
                 )
             return self._json(out)
+        if path == "/api/jobs":
+            return self._json(
+                [json.loads(v) for v in self._job_kv().values()]
+            )
+        if path.startswith("/api/jobs/"):
+            sid = path[len("/api/jobs/"):]
+            raw = self._job_kv().get(sid)
+            if raw is None:
+                return 404, "application/json", b'{"error": "unknown job"}'
+            return self._json(json.loads(raw))
         if path == "/api/tasks":
             limit = int(params.get("limit", 100))
             return self._json(list(h.task_events)[-limit:])
@@ -212,6 +240,95 @@ class Dashboard:
                 text = ""
             return 200, "text/plain; version=0.0.4", text.encode()
         return 404, "text/plain", b"not found"
+
+    # --------------------------------------------------------- job REST API
+    # Reference parity: dashboard/modules/job REST surface (JobSubmissionClient
+    # speaks HTTP to the dashboard).  The head spawns and tracks the job's
+    # driver subprocess itself — same contract as jobs.JobSupervisor, same KV
+    # namespace, so `ca jobs` and the SDK see REST-submitted jobs too.
+
+    def _job_kv(self):
+        return self.head.kv.setdefault("__jobs__", {})
+
+    def _route_post(self, path: str, body: bytes):
+        if path == "/api/jobs":
+            try:
+                spec = json.loads(body or b"{}")
+                entrypoint = spec["entrypoint"]
+            except (ValueError, KeyError):
+                return 400, "application/json", b'{"error": "entrypoint required"}'
+            sid = spec.get("submission_id") or f"cajob_{uuid.uuid4().hex[:10]}"
+            info = {
+                "submission_id": sid,
+                "status": "RUNNING",
+                "entrypoint": entrypoint,
+                "start_time": time.time(),
+                "end_time": None,
+                "return_code": None,
+                "message": "submitted via REST",
+            }
+            env = dict(os.environ)
+            env.update(spec.get("env_vars") or {})
+            env["CA_ADDRESS"] = self.head.session_dir
+            env["CA_JOB_SUBMISSION_ID"] = sid
+            log_path = os.path.join(self.head.session_dir, f"job-{sid}.log")
+            logf = open(log_path, "ab")
+            try:
+                proc = subprocess.Popen(
+                    entrypoint,
+                    shell=True,
+                    env=env,
+                    cwd=spec.get("cwd"),
+                    stdout=logf,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
+            except OSError as e:
+                return 500, "application/json", json.dumps({"error": repr(e)}).encode()
+            finally:
+                logf.close()
+            self._rest_jobs[sid] = proc
+            self._job_kv()[sid] = json.dumps(info).encode()
+            threading.Thread(
+                target=self._watch_job, args=(sid, proc, dict(info)), daemon=True
+            ).start()
+            return self._json({"submission_id": sid})
+        if path.startswith("/api/jobs/") and path.endswith("/stop"):
+            sid = path[len("/api/jobs/") : -len("/stop")]
+            proc = self._rest_jobs.get(sid)
+            if proc is None:
+                return 404, "application/json", b'{"error": "unknown job"}'
+            if proc.poll() is None:
+                import signal as _signal
+
+                raw = self._job_kv().get(sid)
+                if raw:
+                    info = json.loads(raw)
+                    info["status"] = "STOPPED"
+                    self._job_kv()[sid] = json.dumps(info).encode()
+                try:
+                    os.killpg(os.getpgid(proc.pid), _signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            return self._json({"submission_id": sid, "status": "STOPPED"})
+        return 404, "text/plain", b"not found"
+
+    def _watch_job(self, sid: str, proc, info: dict):
+        rc = proc.wait()
+
+        def _update():
+            raw = self._job_kv().get(sid)
+            final = json.loads(raw) if raw else dict(info)
+            if final.get("status") == "RUNNING":
+                final["status"] = "SUCCEEDED" if rc == 0 else "FAILED"
+            final["return_code"] = rc
+            final["end_time"] = time.time()
+            self._job_kv()[sid] = json.dumps(final).encode()
+
+        # marshal onto the head loop: the kv dict is also walked by the
+        # snapshot persister there
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(_update)
 
     @staticmethod
     def _json(obj: Any):
