@@ -50,6 +50,27 @@ class LLMServer:
             "num_generated_tokens": int(len(out["generated_tokens"][0])),
         }
 
+    def stream(self, request):
+        """Token-streaming twin of __call__: yields one
+        {"token_id", "text"} dict per sampled token.  Reaches HTTP clients
+        as SSE via the proxy's text/event-stream path (serve streaming
+        handles end-to-end: replica generator -> streaming actor frames ->
+        one SSE event per token)."""
+        from ..serve import Request
+
+        if isinstance(request, Request):
+            body = request.json() if request.method == "POST" else dict(request.query_params)
+        else:
+            body = request if isinstance(request, dict) else {"prompt": str(request)}
+        kwargs = {}
+        if "max_new_tokens" in body:
+            kwargs["max_new_tokens"] = int(body["max_new_tokens"])
+        if "temperature" in body:
+            kwargs["temperature"] = float(body["temperature"])
+        if "top_k" in body:
+            kwargs["top_k"] = int(body["top_k"])
+        yield from self.worker.stream(body.get("prompt", ""), **kwargs)
+
 
 def build_llm_deployment(
     config: Optional[ProcessorConfig] = None,

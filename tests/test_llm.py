@@ -134,5 +134,13 @@ def test_llm_serve_deployment():
     assert out["prompt"] == "hello"
     assert out["num_generated_tokens"] == 3
     assert isinstance(out["generated_text"], str)
+    # token streaming through the serve streaming-handle path
+    toks = list(
+        handle.options(method_name="stream", stream=True).remote(
+            {"prompt": "hi", "max_new_tokens": 4}
+        )
+    )
+    assert len(toks) == 4
+    assert all("token_id" in t and "text" in t for t in toks)
     serve.delete("llm_test")
     serve.shutdown()
