@@ -40,6 +40,62 @@ def cmd_start(args):
     _api._head_proc = None  # leave the head running
 
 
+def cmd_up(args):
+    """Bring up a cluster from a YAML config (reference `ray up` role, local
+    provider semantics: the head plus N agent nodes on this host).
+
+    Config shape:
+        head: {num_cpus: 4, num_tpus: 0}
+        nodes:
+          - {count: 2, num_cpus: 2}
+          - {count: 1, num_cpus: 1, resources: {fast_disk: 1}}
+    """
+    import yaml
+
+    import cluster_anywhere_tpu as ca
+    from cluster_anywhere_tpu.autoscaler.provider import AgentNodeProvider, NodeType
+
+    with open(args.config) as f:
+        cfg = yaml.safe_load(f) or {}
+    head = cfg.get("head") or {}
+    os.environ["CA_HEAD_PERSIST"] = "1"
+    info = ca.init(
+        num_cpus=head.get("num_cpus"), num_tpus=head.get("num_tpus")
+    )
+    print(f"head up at {info['session_dir']}")
+    provider = AgentNodeProvider()
+    n_started = 0
+    for spec in cfg.get("nodes") or []:
+        shape = {"CPU": float(spec.get("num_cpus", 2))}
+        if spec.get("num_tpus"):
+            shape["TPU"] = float(spec["num_tpus"])
+        shape.update({k: float(v) for k, v in (spec.get("resources") or {}).items()})
+        for _ in range(int(spec.get("count", 1))):
+            node = provider.create_node(NodeType("yaml", shape))
+            n_started += 1
+            print(f"node {node.node_id} up: {shape}")
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    w = global_worker()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        alive = [n for n in w.head_call("nodes")["nodes"] if n["alive"]]
+        if len(alive) >= 1 + n_started:
+            break
+        time.sleep(0.2)
+    print(f"cluster up: {len(alive)} nodes, resources {ca.cluster_resources()}")
+    from cluster_anywhere_tpu.core import api as _api
+
+    w.shutdown(stop_cluster=False)
+    _api._head_proc = None  # persists until `ca down`
+
+
+def cmd_down(args):
+    """Tear down the running cluster (reference `ray down`): agents exit on
+    head shutdown notification, the head cleans the shm namespace."""
+    cmd_stop(args)
+
+
 def cmd_stop(args):
     import cluster_anywhere_tpu as ca
     from cluster_anywhere_tpu.core.worker import global_worker
@@ -202,6 +258,14 @@ def main(argv=None):
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("up", help="bring up a cluster from a YAML config")
+    sp.add_argument("config", help="path to the cluster YAML")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down the running cluster")
+    addr(sp)
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("stop", help="stop the running cluster")
     addr(sp)

@@ -242,3 +242,45 @@ def test_rest_job_submission(ca_cluster):
     )
     status, jobs = req("GET", "/api/jobs")
     assert any(j["submission_id"] == sid for j in jobs)
+
+
+def test_ca_up_down(tmp_path):
+    """`ca up <yaml>` boots head + agent nodes from a config; `ca down`
+    tears the whole cluster back down (ray up/down role, local provider)."""
+    import subprocess
+    import sys
+
+    if ca.is_initialized():
+        ca.shutdown()
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(
+        "head: {num_cpus: 2}\n"
+        "nodes:\n"
+        "  - {count: 2, num_cpus: 2}\n"
+    )
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, "-m", "cluster_anywhere_tpu.cli", "up", str(cfg)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "cluster up: 3 nodes" in out.stdout, out.stdout
+    try:
+        info = ca.init(address="auto")
+        alive = [n for n in ca.nodes() if n["alive"]]
+        assert len(alive) == 3
+        assert ca.cluster_resources().get("CPU") == 6.0
+
+        @ca.remote
+        def f(x):
+            return x + 1
+
+        assert ca.get([f.remote(i) for i in range(12)], timeout=60) == list(range(1, 13))
+        ca.shutdown()
+    finally:
+        down = subprocess.run(
+            [sys.executable, "-m", "cluster_anywhere_tpu.cli", "down"],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+    assert down.returncode == 0, down.stdout + down.stderr
+    assert "stopping cluster" in down.stdout
