@@ -95,3 +95,17 @@ def test_wildcard_addr_normalization(tcp_cluster):
     # non-wildcard addresses pass through untouched
     assert w._normalize_peer_addr("tcp:10.0.0.7:5123") == "tcp:10.0.0.7:5123"
     assert w._normalize_peer_addr("/tmp/x.sock") == "/tmp/x.sock"
+
+
+def test_client_mode_streaming_generator(tcp_cluster):
+    """Streaming generator returns reach a remote client: item frames ride
+    the client's TCP connection to the executing worker."""
+    ca.init(address=tcp_cluster.head_tcp)
+
+    @ca.remote
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    got = [ca.get(r, timeout=60) for r in gen.options(num_returns="streaming").remote(5)]
+    assert got == [0, 10, 20, 30, 40]
