@@ -330,6 +330,7 @@ class Head:
             "workers": [
                 {
                     "worker_id": w.worker_id, "pid": w.pid, "addr": w.addr,
+                    "addr_tcp": w.addr_tcp,
                     "node_id": w.node_id, "state": w.state, "purpose": w.purpose,
                     "pool": w.pool, "lease_id": w.lease_id, "actor_id": w.actor_id,
                 }
@@ -411,6 +412,7 @@ class Head:
                 w["worker_id"], w["pid"], w["addr"], node_id=w["node_id"],
                 purpose=w["purpose"], pool=w["pool"],
             )
+            rec.addr_tcp = w.get("addr_tcp")
             rec.state = w["state"]
             rec.lease_id = w["lease_id"]
             rec.actor_id = w["actor_id"]
