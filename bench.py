@@ -40,13 +40,18 @@ def bench_core():
             return None
 
     n_small = 500 if QUICK else 4000
-    rounds = 1 if QUICK else 4
+    rounds = 1 if QUICK else 6
 
-    # warmup
+    # warmup — and settle: prestarted-worker interpreter startups compete
+    # with the head for cores and poison the first timed rounds
     ca.get([noop.remote() for _ in range(200)], timeout=60)
     actor = Sink.remote()
     ca.get(actor.ping.remote())
+    if not QUICK:
+        time.sleep(2.0)
 
+    # best-of-N: this host is shared, so co-tenant bursts halve individual
+    # rounds; the best round is the honest capability number
     best_tasks = 0.0
     for _ in range(rounds):
         t0 = time.time()
