@@ -74,7 +74,7 @@ class Algorithm:
         obs_dim, num_actions = probe.observation_dim, probe.num_actions
         if config.algo == "SAC":
             kind = "gaussian"
-        elif config.algo in ("PPO", "IMPALA"):
+        elif config.algo in ("PPO", "IMPALA", "APPO"):
             kind = "policy"
         else:
             kind = "q"
@@ -98,13 +98,14 @@ class Algorithm:
                 minibatches=config.minibatches,
                 seed=config.seed,
             )
-        elif config.algo == "IMPALA":
+        elif config.algo in ("IMPALA", "APPO"):
             self.module = DiscretePolicyModule(obs_dim, num_actions, config.hidden)
             self.learner = IMPALALearner(
                 self.module,
                 lr=config.lr,
                 gamma=config.gamma,
                 entropy_coeff=config.entropy_coeff,
+                surrogate_clip=config.clip if config.algo == "APPO" else None,
                 seed=config.seed,
             )
             self._pending: Dict[Any, int] = {}  # in-flight sample ref -> runner idx
@@ -223,7 +224,7 @@ class Algorithm:
 
     def train(self) -> Dict[str, Any]:
         cfg = self.config
-        if cfg.algo == "IMPALA":
+        if cfg.algo in ("IMPALA", "APPO"):
             return self._train_impala()
         t0 = time.monotonic()
         rollouts = ca.get(

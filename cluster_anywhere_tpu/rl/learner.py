@@ -155,7 +155,11 @@ class IMPALALearner:
     arrive from asynchronously sampling runners whose policies lag the
     learner; importance-weighted V-trace targets correct the off-policy gap.
     The whole update — target logp/value forward pass, reverse-scan V-trace,
-    policy-gradient + value + entropy losses — is one jitted XLA program."""
+    policy-gradient + value + entropy losses — is one jitted XLA program.
+
+    ``surrogate_clip`` turns this into APPO (rllib/algorithms/appo/):
+    the PPO clipped surrogate applied to the V-trace advantage instead of
+    the plain policy gradient — same async actor-learner machinery."""
 
     def __init__(
         self,
@@ -167,6 +171,7 @@ class IMPALALearner:
         entropy_coeff: float = 0.01,
         rho_clip: float = 1.0,
         c_clip: float = 1.0,
+        surrogate_clip: float = None,
         seed: int = 0,
     ):
         import optax
@@ -207,7 +212,15 @@ class IMPALALearner:
             vs = vs_minus_v + values
             next_vs = jnp.concatenate([vs[1:], boot_value[None]], axis=0)
             pg_adv = rho_bar * (batch["rewards"] + discounts * next_vs - values)
-            pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+            if surrogate_clip is not None:
+                # APPO: clipped surrogate on the V-trace advantage
+                adv = jax.lax.stop_gradient(
+                    batch["rewards"] + discounts * next_vs - values
+                )
+                clipped = jnp.clip(rho, 1 - surrogate_clip, 1 + surrogate_clip)
+                pg_loss = -jnp.mean(jnp.minimum(rho * adv, clipped * adv))
+            else:
+                pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
             vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
             entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
             total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
