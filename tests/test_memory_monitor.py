@@ -84,3 +84,46 @@ def test_oom_kill_retries_task(pressured_cluster):
     assert killed, "monitor never killed a worker under sustained pressure"
     mem.write_text("10 100")  # pressure clears; the retry can finish
     assert isinstance(ca.get(ref, timeout=30), int)
+
+
+def test_oom_kill_dispatched_to_remote_node(tmp_path, monkeypatch):
+    """A pressured AGENT node reports in heartbeats; the head picks the
+    victim there and dispatches kill_worker to the owning agent."""
+    import json
+
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+
+    mem = tmp_path / "mem"
+    mem.write_text("10 100")
+    monkeypatch.setenv("CA_TEST_MEM_USAGE_PATH", str(mem))
+    if ca.is_initialized():
+        ca.shutdown()
+    # head contributes no CPUs: every worker (and thus every victim) lives
+    # on the agent node
+    c = Cluster(head_resources={"CPU": 0.0})
+    c.add_node(num_cpus=2)
+    ca.init(address=c.session_dir)
+    try:
+
+        @ca.remote(max_retries=3)
+        def slow():
+            time.sleep(1.5)
+            return 1
+
+        ref = slow.remote()
+        time.sleep(0.5)  # running on the agent node
+        mem.write_text("97 100")
+        events_path = os.path.join(c.session_dir, "events.jsonl")
+        deadline = time.time() + 20
+        victim_node = None
+        while time.time() < deadline and victim_node is None:
+            time.sleep(0.2)
+            for line in open(events_path):
+                if '"worker_oom_killed"' in line:
+                    victim_node = json.loads(line)["node_id"]
+        assert victim_node not in (None, "n0"), victim_node
+        mem.write_text("10 100")
+        assert ca.get(ref, timeout=60) == 1  # retried to completion
+    finally:
+        ca.shutdown()
+        c.shutdown()
