@@ -264,6 +264,7 @@ __all__ = [
     "Deployment",
     "Application",
     "run",
+    "run_config",
     "start",
     "start_grpc_proxy",
     "grpc_call",
@@ -283,3 +284,67 @@ __all__ = [
     "get_multiplexed_model_id",
     "get_request_context",
 ]
+
+
+def run_config(config, *, _blocking: bool = True) -> Dict[str, DeploymentHandle]:
+    """Deploy applications from a config file/dict (reference `serve deploy`
+    + serve/schema.py ServeDeploySchema, compact):
+
+        applications:
+          - name: app1
+            route_prefix: /app1
+            import_path: my.module:app      # a module-level Application
+            deployments:                    # optional per-deployment overrides
+              - name: Doubler
+                num_replicas: 2
+
+    Returns {app_name: ingress handle}.  import_path targets must be
+    importable by replica processes (same host or shipped via runtime_env).
+    """
+    import importlib
+
+    if isinstance(config, str):
+        import yaml
+
+        with open(config) as f:
+            config = yaml.safe_load(f) or {}
+    handles: Dict[str, DeploymentHandle] = {}
+    for app_spec in config.get("applications") or []:
+        name = app_spec.get("name", "default")
+        module_name, _, attr = app_spec["import_path"].partition(":")
+        app = getattr(importlib.import_module(module_name), attr)
+        if isinstance(app, Deployment):
+            app = app.bind()
+        if not isinstance(app, Application):
+            raise TypeError(
+                f"{app_spec['import_path']} is not an Application/Deployment"
+            )
+        overrides = {
+            d["name"]: {k: v for k, v in d.items() if k != "name"}
+            for d in app_spec.get("deployments") or []
+        }
+        if overrides:
+            app = _apply_overrides(app, overrides)
+        handles[name] = run(
+            app,
+            name=name,
+            route_prefix=app_spec.get("route_prefix", f"/{name}"),
+            _blocking=_blocking,
+        )
+    return handles
+
+
+def _apply_overrides(app: Application, overrides: Dict[str, Dict[str, Any]]) -> Application:
+    """Rebuild the bind graph with per-deployment option overrides applied."""
+    def rebuild(a: Application) -> Application:
+        dep = a.deployment
+        if dep.name in overrides:
+            dep = dep.options(**overrides[dep.name])
+        new_args = tuple(rebuild(x) if isinstance(x, Application) else x for x in a.args)
+        new_kwargs = {
+            k: rebuild(v) if isinstance(v, Application) else v
+            for k, v in a.kwargs.items()
+        }
+        return Application(dep, new_args, new_kwargs)
+
+    return rebuild(app)

@@ -348,3 +348,37 @@ def test_streaming_deployment_handle_and_sse(ca_cluster_module):
     assert "Content-Type: text/event-stream" in text
     assert [f"data: tok{i}" in text for i in range(4)] == [True] * 4
     serve.delete("sse")
+
+
+def test_run_config_deploys_from_yaml(ca_cluster_module, tmp_path, monkeypatch):
+    """serve.run_config: config-file deployment with per-deployment
+    overrides (serve deploy / ServeDeploySchema role)."""
+    import sys
+
+    from cluster_anywhere_tpu import serve
+
+    mod = tmp_path / "my_serve_app.py"
+    mod.write_text(
+        "from cluster_anywhere_tpu import serve\n"
+        "@serve.deployment\n"
+        "class Adder:\n"
+        "    def __call__(self, x):\n"
+        "        return x + 1\n"
+        "app = Adder.bind()\n"
+    )
+    monkeypatch.syspath_prepend(str(tmp_path))
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: cfgapp\n"
+        "    route_prefix: /cfgapp\n"
+        "    import_path: my_serve_app:app\n"
+        "    deployments:\n"
+        "      - {name: Adder, num_replicas: 2}\n"
+    )
+    handles = serve.run_config(str(cfg))
+    assert set(handles) == {"cfgapp"}
+    assert handles["cfgapp"].remote(41).result(timeout_s=60) == 42
+    st = serve.status()
+    assert st["cfgapp"]["Adder"]["replica_states"].get("RUNNING") == 2, st
+    serve.delete("cfgapp")
