@@ -96,6 +96,28 @@ def cmd_down(args):
     cmd_stop(args)
 
 
+def cmd_serve(args):
+    """`ca serve deploy <yaml>` / `ca serve status` (reference serve CLI)."""
+    import cluster_anywhere_tpu as ca
+    from cluster_anywhere_tpu import serve
+
+    ca.init(address=getattr(args, "address", None) or "auto")
+    if args.action == "deploy":
+        handles = serve.run_config(args.config)
+        for name in handles:
+            print(f"deployed application {name!r}")
+    elif args.action == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.action == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+    from cluster_anywhere_tpu.core import api as _api
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    global_worker().shutdown(stop_cluster=False)
+    _api._head_proc = None
+
+
 def cmd_stop(args):
     import cluster_anywhere_tpu as ca
     from cluster_anywhere_tpu.core.worker import global_worker
@@ -266,6 +288,12 @@ def main(argv=None):
     sp = sub.add_parser("down", help="tear down the running cluster")
     addr(sp)
     sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("serve", help="serve deploy <yaml> / status / shutdown")
+    sp.add_argument("action", choices=["deploy", "status", "shutdown"])
+    sp.add_argument("config", nargs="?", help="YAML for deploy")
+    addr(sp)
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("stop", help="stop the running cluster")
     addr(sp)
