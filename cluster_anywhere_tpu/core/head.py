@@ -2216,9 +2216,13 @@ class Head:
             self._log_event("dashboard_failed", error=repr(e))
         monitor = asyncio.ensure_future(self._monitor_loop())
         persister = asyncio.ensure_future(self._persist_loop())
-        # readiness marker for the driver
-        with open(os.path.join(self.session_dir, "head.ready"), "w") as f:
+        # readiness marker for the driver — atomic rename: a reader must
+        # never observe the file existing but empty (the pid parse treats
+        # that as a dead cluster and refuses to connect)
+        ready_path = os.path.join(self.session_dir, "head.ready")
+        with open(ready_path + ".tmp", "w") as f:
             f.write(str(os.getpid()))
+        os.replace(ready_path + ".tmp", ready_path)
         await self._shutdown.wait()
         monitor.cancel()
         persister.cancel()
