@@ -222,8 +222,10 @@ class NodeAgent:
             pid=os.getpid(),
         )
         # readiness marker for the cluster fixture
-        with open(os.path.join(self.node_dir, "agent.ready"), "w") as f:
+        ready = os.path.join(self.node_dir, "agent.ready")
+        with open(ready + ".tmp", "w") as f:
             f.write(f"{os.getpid()}\n{self.serve_addr}\n")
+        os.replace(ready + ".tmp", ready)  # atomic: never visible half-written
         hb = spawn_bg(self._heartbeat_loop())
         head_watch = spawn_bg(self._watch_head())
         await self._shutdown.wait()
