@@ -18,6 +18,7 @@ from .config import (
     CheckpointConfig,
     FailureConfig,
     JaxConfig,
+    TorchConfig,
     RunConfig,
     ScalingConfig,
     TrainingFailedError,
@@ -48,6 +49,7 @@ __all__ = [
     "CheckpointConfig",
     "BackendConfig",
     "JaxConfig",
+    "TorchConfig",
     "FailureConfig",
     "RunConfig",
     "ScalingConfig",

@@ -78,3 +78,74 @@ class JaxBackend(Backend):
                     for rank, w in enumerate(worker_group.workers)
                 ]
             )
+
+
+def _init_torch_pg(master_addr: str, master_port: int, world_size: int, rank: int,
+                   backend: str, timeout_s: float):
+    import datetime
+    import os as _os
+
+    import torch.distributed as dist
+
+    _os.environ["MASTER_ADDR"] = master_addr
+    _os.environ["MASTER_PORT"] = str(master_port)
+    dist.init_process_group(
+        backend=backend,
+        world_size=world_size,
+        rank=rank,
+        timeout=datetime.timedelta(seconds=timeout_s),
+    )
+
+
+def _destroy_torch_pg():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class TorchBackend(Backend):
+    """torch.distributed process group across the worker group (reference
+    _TorchBackend, train/torch/config.py:66-153): rank-0's node hosts the
+    TCP store; every worker joins with its rank envs, enabling DDP/FSDP
+    training loops unchanged (gloo on CPU hosts, nccl where tenable)."""
+
+    def on_start(self, worker_group: "WorkerGroup", backend_config):
+        import cluster_anywhere_tpu as ca
+
+        n = worker_group.num_workers
+        local_ranks = worker_group.local_ranks()
+        node_ranks = worker_group.node_ranks()
+        port = backend_config.port or ca.get(
+            worker_group.workers[0].free_port.remote()
+        )
+        host = worker_group.node_infos[0]["hostname"]
+        refs = []
+        for rank, w in enumerate(worker_group.workers):
+            env = {
+                "CA_WORLD_SIZE": str(n),
+                "CA_WORLD_RANK": str(rank),
+                "CA_LOCAL_RANK": str(local_ranks[rank]),
+                "CA_NODE_RANK": str(node_ranks[rank]),
+                "MASTER_ADDR": host,
+                "MASTER_PORT": str(port),
+            }
+            refs.append(w.set_env.remote(env))
+        ca.get(refs)
+        ca.get(
+            [
+                w.execute.remote(
+                    _init_torch_pg, host, port, n, rank,
+                    backend_config.backend, backend_config.timeout_s,
+                )
+                for rank, w in enumerate(worker_group.workers)
+            ]
+        )
+
+    def on_shutdown(self, worker_group: "WorkerGroup", backend_config):
+        import cluster_anywhere_tpu as ca
+
+        try:
+            ca.get([w.execute.remote(_destroy_torch_pg) for w in worker_group.workers])
+        except Exception:
+            pass

@@ -118,3 +118,19 @@ class TrainingFailedError(Exception):
 
     def __str__(self):
         return self.message or "training failed"
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """torch.distributed backend (reference train/torch/config.py
+    TorchConfig): gloo for CPU hosts; init timeout mirrors the reference's
+    default."""
+
+    backend: str = "gloo"
+    port: int = 0  # 0 = pick a free port on rank-0's node
+    timeout_s: float = 1800.0
+
+    def backend_cls(self):
+        from .backend import TorchBackend
+
+        return TorchBackend
