@@ -48,6 +48,26 @@ def from_items(items: Sequence[Any], *, parallelism: int = -1, override_num_bloc
     return _from_source(ItemsDatasource(items), override_num_blocks or parallelism)
 
 
+def from_torch(torch_dataset, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    """Dataset over a torch map-style dataset (reference data/read_api.py
+    from_torch): rows become {"item": value} records."""
+    import builtins
+
+    # builtins.range: this module's own range() is the Dataset factory
+    n = len(torch_dataset)
+    items = [{"item": torch_dataset[i]} for i in builtins.range(n)]
+    return _from_source(
+        ItemsDatasource(items), override_num_blocks or -1
+    )
+
+
+def from_huggingface(hf_dataset, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    """Dataset over a Hugging Face datasets.Dataset (reference
+    from_huggingface): column-dict rows pass through unchanged."""
+    items = [dict(r) for r in hf_dataset]
+    return _from_source(ItemsDatasource(items), override_num_blocks or -1)
+
+
 def from_numpy(arr, column: str = "data") -> Dataset:
     import numpy as np
 

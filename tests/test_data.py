@@ -297,3 +297,19 @@ def test_iter_jax_batches(ca_cluster_module):
     sh = NamedSharding(mesh, P("dp"))
     batches = list(ds.iter_jax_batches(batch_size=32, sharding=sh))
     assert batches[0]["id"].sharding.is_equivalent_to(sh, ndim=1)
+
+
+def test_from_torch(ca_cluster_module):
+    """from_torch over a map-style torch dataset (read_api.py parity)."""
+    import torch
+
+    class Squares(torch.utils.data.Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return i * i
+
+    ds = cad.from_torch(Squares())
+    rows = ds.take_all()
+    assert [r["item"] for r in rows] == [i * i for i in range(10)]
