@@ -50,12 +50,14 @@ def from_items(items: Sequence[Any], *, parallelism: int = -1, override_num_bloc
 
 def from_torch(torch_dataset, *, override_num_blocks: Optional[int] = None) -> Dataset:
     """Dataset over a torch map-style dataset (reference data/read_api.py
-    from_torch): rows become {"item": value} records."""
+    from_torch): the dataset's values become the Dataset's rows."""
     import builtins
 
-    # builtins.range: this module's own range() is the Dataset factory
+    # builtins.range: this module's own range() is the Dataset factory.
+    # Raw values, not {"item": ...} wrappers: ItemsDatasource already speaks
+    # from_items row semantics
     n = len(torch_dataset)
-    items = [{"item": torch_dataset[i]} for i in builtins.range(n)]
+    items = [torch_dataset[i] for i in builtins.range(n)]
     return _from_source(
         ItemsDatasource(items), override_num_blocks or -1
     )
@@ -146,6 +148,8 @@ __all__ = [
     "range",
     "range_tensor",
     "from_items",
+    "from_torch",
+    "from_huggingface",
     "from_generator",
     "from_numpy",
     "from_pandas",

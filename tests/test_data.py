@@ -311,5 +311,4 @@ def test_from_torch(ca_cluster_module):
             return i * i
 
     ds = cad.from_torch(Squares())
-    rows = ds.take_all()
-    assert [r["item"] for r in rows] == [i * i for i in range(10)]
+    assert ds.take_all() == [i * i for i in range(10)]
