@@ -14,7 +14,15 @@ from .env import CartPole, Env, Pendulum, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
 from .learner import DQNLearner, IMPALALearner, PPOLearner, compute_gae
 from .module import DiscretePolicyModule, QModule
-from .offline import BCLearner, RolloutReader, RolloutWriter, record_rollouts, train_bc
+from .offline import (
+    BCLearner,
+    CQLLearner,
+    RolloutReader,
+    RolloutWriter,
+    record_rollouts,
+    train_bc,
+    train_cql,
+)
 from .multi_agent import (
     CoordinationGame,
     MultiAgentEnv,
@@ -33,6 +41,8 @@ __all__ = [
     "CoordinationGame",
     "RockPaperScissors",
     "BCLearner",
+    "CQLLearner",
+    "train_cql",
     "RolloutReader",
     "RolloutWriter",
     "record_rollouts",

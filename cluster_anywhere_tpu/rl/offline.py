@@ -181,3 +181,107 @@ def train_bc(
         stats = learner.update(reader.sample(batch_size))
     learner.last_stats = stats
     return learner
+
+
+class CQLLearner:
+    """Discrete conservative Q-learning (reference rllib/algorithms/cql):
+    double-DQN TD loss plus the CQL regularizer — logsumexp over all
+    actions minus the logged action's Q — which penalizes out-of-dataset
+    actions so purely offline data can't inflate unseen-action values.
+    One jitted update."""
+
+    def __init__(self, module, *, lr: float = 1e-3, gamma: float = 0.99,
+                 cql_alpha: float = 1.0, target_update_freq: int = 100,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.module = module
+        self.target_update_freq = target_update_freq
+        self.opt = optax.adam(lr)
+        self.params = module.init(jax.random.key(seed))
+        self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.opt_state = self.opt.init(self.params)
+        self.updates_done = 0
+
+        def loss_fn(params, target_params, batch):
+            q = module.q_values(params, batch["obs"])
+            q_taken = jnp.take_along_axis(q, batch["actions"][:, None], -1)[:, 0]
+            q_next_online = module.q_values(params, batch["next_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next_target = module.q_values(target_params, batch["next_obs"])
+            q_next = jnp.take_along_axis(q_next_target, best[:, None], -1)[:, 0]
+            target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * q_next
+            td = jnp.mean((q_taken - jax.lax.stop_gradient(target)) ** 2)
+            # conservative penalty: push down the soft-max over ALL actions,
+            # push up the action the dataset actually took
+            cql = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1) - q_taken)
+            return td + cql_alpha * cql, (td, cql)
+
+        def update_step(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch
+            )
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update_step)
+        self._tree_copy = jax.tree_util.tree_map
+
+    def get_weights(self):
+        return self.params
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        jb = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+        }
+        self.params, self.opt_state, loss, (td, cql) = self._update(
+            self.params, self.target_params, self.opt_state, jb
+        )
+        self.updates_done += 1
+        if self.updates_done % self.target_update_freq == 0:
+            self.target_params = self._tree_copy(lambda x: x, self.params)
+        return {"loss": float(loss), "td_loss": float(td), "cql_penalty": float(cql)}
+
+
+def train_cql(
+    path: str,
+    obs_dim: int,
+    num_actions: int,
+    *,
+    hidden=(64, 64),
+    lr: float = 1e-3,
+    gamma: float = 0.99,
+    cql_alpha: float = 1.0,
+    batch_size: int = 256,
+    num_updates: int = 1000,
+    seed: int = 0,
+):
+    """Offline CQL over logged transitions (shards must carry obs/actions/
+    rewards/dones/next_obs; record_rollouts writes obs/actions/rewards/dones
+    — next_obs is derived by shifting within each shard)."""
+    from .module import QModule
+
+    reader = RolloutReader(path, seed=seed)
+    data = reader._all()
+    if "next_obs" not in data:
+        nxt = np.concatenate([data["obs"][1:], data["obs"][-1:]], axis=0)
+        data = dict(data, next_obs=nxt)
+        reader._cache = data
+    learner = CQLLearner(
+        QModule(obs_dim, num_actions, hidden),
+        lr=lr, gamma=gamma, cql_alpha=cql_alpha, seed=seed,
+    )
+    stats = {}
+    for _ in range(num_updates):
+        stats = learner.update(reader.sample(batch_size))
+    learner.last_stats = stats
+    return learner
