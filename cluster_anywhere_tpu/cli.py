@@ -101,6 +101,9 @@ def cmd_serve(args):
     import cluster_anywhere_tpu as ca
     from cluster_anywhere_tpu import serve
 
+    if args.action == "deploy" and not args.config:
+        print("usage: ca serve deploy <config.yaml>", file=sys.stderr)
+        sys.exit(2)
     ca.init(address=getattr(args, "address", None) or "auto")
     if args.action == "deploy":
         handles = serve.run_config(args.config)

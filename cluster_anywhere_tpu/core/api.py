@@ -34,8 +34,17 @@ def _sweep_stale_sessions(root: str):
     for name in os.listdir(root):
         path = os.path.join(root, name)
         if name.startswith("client_"):
-            # client-mode scratch (pull caches): no head to probe — sweep
-            # once clearly abandoned
+            # client-mode scratch (pull caches): probe the owning pid
+            # (embedded in the dir name) and sweep once clearly abandoned —
+            # live clients also refresh their dir mtime every 30s
+            try:
+                cpid = int(name.rsplit("_", 1)[1])
+                os.kill(cpid, 0)
+                continue  # owner still running
+            except PermissionError:
+                continue  # pid exists under another uid — still running
+            except (ValueError, IndexError, ProcessLookupError):
+                pass
             try:
                 if time.time() - os.path.getmtime(path) > 3600:
                     shutil.rmtree(path, ignore_errors=True)

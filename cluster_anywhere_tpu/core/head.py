@@ -1379,8 +1379,15 @@ class Head:
         reply(value=ns.get(msg["key"]))
 
     async def _h_kv_del(self, state, msg, reply, reply_err):
-        ns = self.kv.get(msg.get("ns", ""), {})
-        reply(deleted=1 if ns.pop(msg["key"], None) is not None else 0)
+        ns_name = msg.get("ns", "")
+        ns = self.kv.get(ns_name, {})
+        deleted = 1 if ns.pop(msg["key"], None) is not None else 0
+        if not ns and ns_name in self.kv:
+            # drop emptied namespaces: per-op rendezvous namespaces
+            # (collectives) would otherwise leave O(ops) empty dicts in
+            # the KV and in every debounced snapshot
+            del self.kv[ns_name]
+        reply(deleted=deleted)
 
     async def _h_kv_keys(self, state, msg, reply, reply_err):
         ns = self.kv.get(msg.get("ns", ""), {})

@@ -471,9 +471,20 @@ class Worker:
 
     async def _housekeeping(self):
         period = 0.25
+        last_touch = time.monotonic()
         while not self._stopped:
             await asyncio.sleep(period)
             now = time.monotonic()
+            if self.client_mode and now - last_touch > 30:
+                # keep the client session dir's mtime fresh so another
+                # ca.init on this host's stale-session sweep (api.py
+                # _sweep_stale_sessions, 1h horizon) never reaps a live
+                # client's scratch/pull-cache out from under it
+                last_touch = now
+                try:
+                    os.utime(self.session_dir)
+                except OSError:
+                    pass
             if self.head is not None and self.head.closed and not self._head_fenced:
                 # head died (restart-in-progress): keep redialing; the
                 # restarted head re-adopts us from its snapshot

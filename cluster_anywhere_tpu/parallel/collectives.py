@@ -55,6 +55,19 @@ class HostCollectiveGroup:
         self.group_name = group_name
         self._seq = 0
         self._live = deque(maxlen=self._RETAIN_OPS * max(world_size, 2))
+        # (seq, ns, key) for every rooted-collective KV entry this rank
+        # published; entries older than _RETAIN_OPS ops are kv_del'd so a
+        # long run's rendezvous keys don't accumulate in the head's KV (and
+        # its debounced snapshots).  p2p send keys are tracked separately:
+        # they are consumed (and deleted) by recv, which is NOT lockstep-
+        # bounded, so they must never be horizon-GC'd.
+        self._published: deque = deque()
+        self._p2p_published: deque = deque()
+        # p2p sequence numbers are per-destination and independent of the
+        # collective op counter: bumping the shared _seq on send() would
+        # desynchronize the per-op rendezvous namespaces between ranks
+        # that send and ranks that only recv
+        self._p2p_seq: Dict[int, int] = {}
 
     def _ns(self, op: str) -> str:
         return f"__collective__/{self.group_name}/{self._seq}/{op}"
@@ -64,7 +77,7 @@ class HostCollectiveGroup:
 
         return global_worker()
 
-    def _publish(self, ns: str, key: str, value: np.ndarray):
+    def _publish(self, ns: str, key: str, value: np.ndarray, p2p: bool = False):
         """ca.put the tensor; only the ref crosses the head's KV.  Small
         tensors put inline must be promoted to cluster-visible shm first —
         a ref smuggled through KV bypasses the task-arg promotion path."""
@@ -74,6 +87,42 @@ class HostCollectiveGroup:
         self._kv()._promote_nested([ref.id.binary()])
         self._live.append(ref)
         self._kv().head_call("kv_put", ns=ns, key=key, value=pickle.dumps(ref))
+        # rooted ops bump _seq before publishing, so the op being published
+        # is _seq - 1; recording _seq itself would widen retention by one op
+        (self._p2p_published if p2p else self._published).append(
+            (self._seq if p2p else self._seq - 1, ns, key)
+        )
+        self._gc_published()
+
+    def _gc_published(self):
+        """Delete this rank's rooted rendezvous keys older than _RETAIN_OPS
+        ops.  By then every peer has fetched (SPMD lockstep bounds lag), so
+        the keys are dead weight in the head KV and every snapshot write."""
+        w = self._kv()
+        horizon = self._seq - self._RETAIN_OPS
+        while self._published and self._published[0][0] < horizon:
+            _, ns, key = self._published.popleft()
+            try:
+                w.head_call("kv_del", ns=ns, key=key)
+            except Exception:
+                pass  # head restart mid-run: stale keys die with the old KV
+
+    def close(self):
+        """Drop this rank's expired rendezvous keys and unconsumed p2p sends.
+        Keys from the most recent _RETAIN_OPS rooted ops are deliberately
+        left alive — a lagging peer may still be fetching them (barrier()
+        before destroy for a fully clean teardown); at most _RETAIN_OPS
+        keys per rank remain, bounded, not a leak-over-time."""
+        w = self._kv()
+        for q in (self._published, self._p2p_published):
+            while q:
+                seq, ns, key = q.popleft()
+                if q is self._published and seq >= self._seq - self._RETAIN_OPS:
+                    continue
+                try:
+                    w.head_call("kv_del", ns=ns, key=key)
+                except Exception:
+                    return
 
     def _fetch(self, ns: str, key: str, timeout: float = 60.0) -> np.ndarray:
         """Poll one KV key for a ref, then read the payload from the store."""
@@ -142,8 +191,9 @@ class HostCollectiveGroup:
 
     def send(self, tensor: np.ndarray, dst_rank: int):
         ns = f"__collective__/{self.group_name}/p2p/{self.rank}->{dst_rank}"
-        self._publish(ns, str(self._seq), np.asarray(tensor))
-        self._seq += 1
+        k = self._p2p_seq.get(dst_rank, 0)
+        self._p2p_seq[dst_rank] = k + 1
+        self._publish(ns, str(k), np.asarray(tensor), p2p=True)
 
     def recv(self, src_rank: int, timeout: float = 60.0) -> np.ndarray:
         from ..core import api as ca_api
@@ -223,7 +273,9 @@ def get_group(group_name: str = "default") -> HostCollectiveGroup:
 
 
 def destroy_collective_group(group_name: str = "default"):
-    _groups.pop(group_name, None)
+    g = _groups.pop(group_name, None)
+    if g is not None:
+        g.close()
 
 
 def allreduce(tensor, op: str = "sum", group_name: str = "default"):

@@ -100,9 +100,11 @@ class SquashedGaussianModule:
         eps = jax.random.normal(key, mean.shape)
         pre = mean + std * eps
         act = jnp.tanh(pre)
-        # N(pre; mean, std) log-density with the tanh change of variables
+        # N(pre; mean, std) log-density with the change of variables for
+        # tanh AND the affine action_scale (d(scale*tanh)/dpre adds a
+        # log(scale) per dim — omitting it biases entropy by log(scale)/dim)
         logp = -0.5 * (((pre - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
-        logp = logp - jnp.log(1.0 - act**2 + 1e-6)
+        logp = logp - jnp.log(1.0 - act**2 + 1e-6) - jnp.log(self.action_scale)
         logp = jnp.sum(logp, axis=-1)
         return act * self.action_scale, logp
 

@@ -286,7 +286,9 @@ class DeploymentHandle:
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_") or name in ("app", "deployment"):
             raise AttributeError(name)
-        return DeploymentHandle(self.app, self.deployment, name, self._multiplexed_model_id)
+        h = DeploymentHandle(self.app, self.deployment, name, self._multiplexed_model_id)
+        h._stream = self._stream  # h.options(stream=True).method.remote() keeps streaming
+        return h
 
     def remote(self, *args, **kwargs):
         if self._router is None:
