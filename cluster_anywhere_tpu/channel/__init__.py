@@ -5,10 +5,18 @@ backed by versioned shared-memory segments instead of mutable plasma objects
 (reference C++ experimental_mutable_object_manager.h:49).
 
 These are the zero-RPC transport under compiled DAGs: a writer publishes a new
-version in place; readers ack.  Device (jax.Array) payloads cross processes by
-host staging; the in-graph ICI path (parallel/) is the TPU fast plane.
+version in place; readers ack.  Device (jax.Array) payloads cross processes via
+device_transport: per-shard zero-copy buffer borrows with sharding metadata,
+landed shard-by-shard on the consumer's devices (never assembled on host); the
+in-graph ICI path (parallel/) is the TPU fast plane.
 """
 
+from .device_transport import (
+    DeviceEnvelope,
+    pack_device_value,
+    set_transfer_mesh,
+    unpack_device_value,
+)
 from .shm_channel import (
     BufferedShmChannel,
     ChannelClosedError,
@@ -23,4 +31,8 @@ __all__ = [
     "BufferedShmChannel",
     "IntraProcessChannel",
     "ChannelClosedError",
+    "DeviceEnvelope",
+    "pack_device_value",
+    "unpack_device_value",
+    "set_transfer_mesh",
 ]

@@ -34,17 +34,11 @@ def _sweep_stale_sessions(root: str):
     for name in os.listdir(root):
         path = os.path.join(root, name)
         if name.startswith("client_"):
-            # client-mode scratch (pull caches): probe the owning pid
-            # (embedded in the dir name) and sweep once clearly abandoned —
-            # live clients also refresh their dir mtime every 30s
-            try:
-                cpid = int(name.rsplit("_", 1)[1])
-                os.kill(cpid, 0)
-                continue  # owner still running
-            except PermissionError:
-                continue  # pid exists under another uid — still running
-            except (ValueError, IndexError, ProcessLookupError):
-                pass
+            # client-mode scratch (pull caches): live clients refresh their
+            # dir mtime every 30s (worker housekeeping), so a >1h-stale
+            # mtime means abandoned — no pid probe (the embedded pid may
+            # have been recycled by an unrelated process, which would make
+            # the dir unreclaimable forever)
             try:
                 if time.time() - os.path.getmtime(path) > 3600:
                     shutil.rmtree(path, ignore_errors=True)

@@ -1066,7 +1066,9 @@ class Worker:
     def _fetch_remote(self, ref: ObjectRef, e: _Entry) -> Any:
         owner_addr = e.shm_name  # device entries store owner addr here
         reply = self.run_coro(self._fetch_remote_async(owner_addr, ref.id.binary()))
-        value = serialization.unpack(reply["packed"])
+        from ..channel.device_transport import maybe_unpack
+
+        value = maybe_unpack(serialization.unpack(reply["packed"]))
         self.memory_store.put_value(ref.id, value)
         return value
 
@@ -1450,12 +1452,13 @@ class Worker:
                 return {"shm": e.shm_name, "size": e.size, "oid": oid.binary()}
             if oid.binary() in self.device_objects:
                 if not self.serve_addr:
-                    # driver has no serving socket: materialize to host
-                    import jax
+                    # driver has no serving socket: ship inline, but as a
+                    # sharding-preserving shard envelope, not a host copy
+                    from ..channel.device_transport import pack_device_value
 
                     return {
                         "v": serialization.pack(
-                            jax.device_get(self.device_objects[oid.binary()])
+                            pack_device_value(self.device_objects[oid.binary()])
                         )
                     }
                 return {
@@ -1468,12 +1471,12 @@ class Worker:
                 return {"v": e.packed}
             return await self._pack_with_transit_async(e.value)
         # plain value: device values stay on device when this process can
-        # serve them (workers/actors); the driver materializes to host.
+        # serve them (workers/actors); the driver ships a shard envelope.
         if _is_device_value(value):
             if not self.serve_addr:
-                import jax
+                from ..channel.device_transport import pack_device_value
 
-                return {"v": serialization.pack(jax.device_get(value))}
+                return {"v": serialization.pack(pack_device_value(value))}
             ref = self.put(value)
             return {
                 "dev": ref.id.binary(),

@@ -78,7 +78,9 @@ class WorkerProcess:
     # ----------------------------------------------------------- args/results
     def _resolve_arg(self, spec: dict) -> Any:
         if "v" in spec:
-            value = serialization.unpack(spec["v"])
+            from ..channel.device_transport import maybe_unpack
+
+            value = maybe_unpack(serialization.unpack(spec["v"]))
             if "t" in spec:
                 # ack smuggled refs: our rehydrated handles are registered,
                 # release the sender's transit pin (borrowing protocol)
@@ -110,7 +112,11 @@ class WorkerProcess:
             reply = asyncio.run_coroutine_threadsafe(
                 self.worker._fetch_remote_async(spec["owner"], oid), self.loop
             ).result(self.config.push_timeout_s)
-            return serialization.unpack(reply["packed"])
+            from ..channel.device_transport import maybe_unpack
+
+            # a DeviceEnvelope lands shard-by-shard on this process's
+            # devices with the producer's sharding reconstructed
+            return maybe_unpack(serialization.unpack(reply["packed"]))
         raise ValueError(f"bad arg spec keys: {list(spec)}")
 
     def _resolve_args(self, specs, kwspecs):
@@ -573,9 +579,11 @@ class WorkerProcess:
                 None, self.worker._resolve_entry, ObjectRef(ObjectID(oid))
             )
         if _is_device_value(value):
-            import jax
+            # device-native: ship per-shard buffer borrows + sharding
+            # metadata, not a device_get'd host copy (channel/device_transport)
+            from ..channel.device_transport import pack_device_value
 
-            value = jax.device_get(value)
+            value = pack_device_value(value)
         return await self.loop.run_in_executor(None, serialization.pack, value)
 
     async def _graceful_exit(self):

@@ -65,16 +65,30 @@ def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple
     readers = {nid: open_channel(spec, ridx) for nid, (spec, ridx) in reader_specs.items()}
     writers = {nid: open_channel(spec) for nid, spec in writer_specs.items()}
     tensor_nids = {nid for nid, (spec, _) in reader_specs.items() if spec.get("tensor")}
+    tensor_writers = {nid for nid, spec in writer_specs.items() if spec.get("tensor")}
 
     def _to_device(v):
-        """with_tensor_transport consumer side: array leaves re-enter the
-        local accelerator so downstream methods compute on device arrays."""
+        """with_tensor_transport consumer side: DeviceEnvelopes land their
+        shards directly on local devices under the producer's sharding;
+        legacy plain-ndarray payloads re-enter the default device."""
         import jax
         import numpy as _np
 
+        from ..channel.device_transport import DeviceEnvelope, unpack_device_value
+
+        if isinstance(v, DeviceEnvelope):
+            return unpack_device_value(v)
         return jax.tree.map(
             lambda x: jax.device_put(x) if isinstance(x, _np.ndarray) else x, v
         )
+
+    def _pack_tensor(v):
+        """with_tensor_transport producer side: decompose array leaves into
+        per-shard zero-copy buffer borrows (no host assembly, no pickle of
+        array bytes; sharding metadata rides along)."""
+        from ..channel.device_transport import pack_device_value
+
+        return pack_device_value(v)
 
     ticks = 0
     try:
@@ -151,8 +165,15 @@ def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple
                     result = err
                 tick_vals[op["node_id"]] = result
                 if op["node_id"] in writers:
+                    out = result
+                    if op["node_id"] in tensor_writers and not isinstance(result, _DagError):
+                        try:
+                            out = _pack_tensor(result)
+                        except BaseException as e:  # noqa: BLE001 — surfaced to driver
+                            out = _DagError(e)
+                            err = err or out
                     try:
-                        writers[op["node_id"]].write(result, timeout)
+                        writers[op["node_id"]].write(out, timeout)
                     except ChannelClosedError:
                         closed = True
                         break
@@ -353,7 +374,10 @@ class CompiledDAG:
                     }
                 )
                 if n._id in self._channels:
-                    writer_specs[n._id] = self._channels[n._id].spec()
+                    wspec = dict(self._channels[n._id].spec())
+                    if getattr(n, "_tensor_transport", False):
+                        wspec["tensor"] = True
+                    writer_specs[n._id] = wspec
             from ..core.actor import ActorMethod
 
             ref = ActorMethod(handle, "__ca_exec__").remote(
@@ -386,7 +410,12 @@ class CompiledDAG:
         if self._torn_down:
             raise RuntimeError("compiled DAG has been torn down")
         if self._input_node is not None:
-            self._channels[self._INPUT_ID].write((tuple(args), kwargs), self._timeout)
+            payload = (tuple(args), kwargs)
+            if getattr(self._input_node, "_tensor_transport", False):
+                from ..channel.device_transport import pack_device_value
+
+                payload = pack_device_value(payload)
+            self._channels[self._INPUT_ID].write(payload, self._timeout)
         ref = CompiledDAGRef(self, self._exec_seq)
         self._exec_seq += 1
         return ref
@@ -411,7 +440,12 @@ class CompiledDAG:
                 # clamp to 0 rather than pre-raising: a 0-timeout read still
                 # returns a value that is already published (poll semantics)
                 remaining = max(0.0, deadline - _time.monotonic())
-                self._partial_vals[nid] = self._driver_readers[nid].read(remaining)
+                v = self._driver_readers[nid].read(remaining)
+                if not isinstance(v, _DagError):
+                    from ..channel.device_transport import maybe_unpack
+
+                    v = maybe_unpack(v)
+                self._partial_vals[nid] = v
             outs = [self._partial_vals[leaf._id] for leaf in self._output_leaves]
             self._partial_vals = {}
             self._result_cache[self._read_seq] = outs

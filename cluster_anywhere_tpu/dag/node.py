@@ -19,17 +19,20 @@ class DAGNode:
         self._tensor_transport = False
 
     def with_tensor_transport(self) -> "DAGNode":
-        """Mark this node's output as tensor data: every cross-process
-        consumer materializes array leaves onto its local accelerator
-        (jax.device_put) immediately after the channel read, so downstream
-        compute sees device arrays, not host numpy.
+        """Mark this node's output as tensor data: array leaves cross the
+        channel as per-shard zero-copy buffer borrows with sharding
+        metadata (channel/device_transport), and land shard-by-shard on the
+        consumer's devices under a reconstructed NamedSharding — the full
+        array is never assembled on the host and never passes through
+        pickle bytes.
 
-        TPU-native stand-in for the reference's
+        TPU-native counterpart of the reference's
         experimental/channel/torch_tensor_nccl_channel.py:44 transport
         annotation: separate jax processes cannot share one ICI runtime, so
-        tensors cross processes host-staged through the shm channel (a
-        scatter-write of the raw buffers — no pickle assembly copy) and
-        re-enter the device on the consumer side."""
+        the shm channel scatter-writes the device shard buffers directly
+        (one memcpy per side — the physical minimum for a process hop);
+        in-graph transfers inside jit/shard_map ride ICI collectives and
+        never come through here."""
         self._tensor_transport = True
         return self
 

@@ -62,7 +62,11 @@ class HostCollectiveGroup:
         # they are consumed (and deleted) by recv, which is NOT lockstep-
         # bounded, so they must never be horizon-GC'd.
         self._published: deque = deque()
-        self._p2p_published: deque = deque()
+        # bounded: recv deletes consumed keys itself, so old entries here
+        # are almost certainly already gone — the cap keeps a long-running
+        # sender's bookkeeping (and close()'s kv_del sweep) O(1), at the
+        # cost of not sweeping ancient unconsumed sends on close
+        self._p2p_published: deque = deque(maxlen=512)
         # p2p sequence numbers are per-destination and independent of the
         # collective op counter: bumping the shared _seq on send() would
         # desynchronize the per-op rendezvous namespaces between ranks
@@ -112,8 +116,15 @@ class HostCollectiveGroup:
         Keys from the most recent _RETAIN_OPS rooted ops are deliberately
         left alive — a lagging peer may still be fetching them (barrier()
         before destroy for a fully clean teardown); at most _RETAIN_OPS
-        keys per rank remain, bounded, not a leak-over-time."""
-        w = self._kv()
+        keys per rank remain, bounded, not a leak-over-time.  A no-op after
+        ca.shutdown (cleanup must stay safe in any teardown order)."""
+        from ..core.worker import try_global_worker
+
+        w = try_global_worker()
+        if w is None:
+            self._published.clear()
+            self._p2p_published.clear()
+            return
         for q in (self._published, self._p2p_published):
             while q:
                 seq, ns, key = q.popleft()

@@ -1,0 +1,250 @@
+"""Device-native tensor plane tests (VERDICT r3 #1, SURVEY §7.5).
+
+The claim under test: a device array crossing an actor/DAG boundary never
+materializes as a full host ndarray — shards move as zero-copy buffer
+borrows with sharding metadata, and land shard-by-shard on the consumer's
+devices under a reconstructed NamedSharding.  Strict mode
+(CA_DEVICE_TRANSPORT_STRICT) turns any host-assembly fallback into an
+error, so these tests would fail loudly if the fast path regressed.
+
+Reference parity: torch_tensor_nccl_channel.py:44 (tensor transport
+annotation), experimental_mutable_object_manager.h:49 (device channels).
+"""
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.channel import device_transport as dt
+from cluster_anywhere_tpu.core import serialization
+from cluster_anywhere_tpu.dag import InputNode
+
+
+def _mesh(shape, names):
+    import jax
+
+    return jax.sharding.Mesh(np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape), names)
+
+
+# --------------------------------------------------------------------------
+# in-process transport semantics
+# --------------------------------------------------------------------------
+
+
+def test_roundtrip_preserves_named_sharding(monkeypatch):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    monkeypatch.setenv("CA_DEVICE_TRANSPORT_STRICT", "1")
+    dt.reset_stats()
+    mesh = _mesh((4, 2), ("x", "y"))
+    x = jax.numpy.arange(64, dtype=jax.numpy.float32).reshape(8, 8)
+    xs = jax.device_put(x, NamedSharding(mesh, P("x", "y")))
+
+    blob = serialization.pack(dt.pack_device_value({"w": xs, "meta": 7}))
+    out = dt.unpack_device_value(serialization.unpack(blob))
+
+    assert out["meta"] == 7
+    y = out["w"]
+    assert isinstance(y, jax.Array)
+    assert isinstance(y.sharding, NamedSharding)
+    assert tuple(y.sharding.mesh.devices.shape) == (4, 2)
+    assert tuple(y.sharding.spec) == ("x", "y")
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    s = dt.stats()
+    assert s["host_assembles"] == 0
+    assert s["sharded_landings"] == 1
+    assert s["dlpack_views"] > 0 and s["asarray_views"] == 0  # pure zero-copy borrows
+
+
+def test_replicated_shards_deduplicated():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh((4, 2), ("x", "y"))
+    x = jax.numpy.arange(32, dtype=jax.numpy.float32).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "y")))  # 8 shards, 2 unique
+
+    env = dt.pack_device_value(xs)
+    assert len(env.leaves[0].bufs) == 2  # one buffer per distinct shard, not per device
+
+    out = dt.unpack_device_value(serialization.unpack(serialization.pack(env)))
+    assert tuple(out.sharding.spec) == (None, "y")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_bf16_rides_asarray_fallback():
+    import jax
+
+    x = jax.numpy.arange(16, dtype=jax.numpy.bfloat16)
+    out = dt.unpack_device_value(
+        serialization.unpack(serialization.pack(dt.pack_device_value(x)))
+    )
+    assert out.dtype == jax.numpy.bfloat16
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_registered_transfer_mesh_wins():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh((8,), ("ring",))
+    # register a mesh with the same signature but reversed device order
+    rev = jax.sharding.Mesh(np.array(jax.devices()[::-1]), ("ring",))
+    dt.set_transfer_mesh(rev)
+    try:
+        x = jax.device_put(
+            jax.numpy.arange(8, dtype=jax.numpy.float32), NamedSharding(mesh, P("ring"))
+        )
+        out = dt.unpack_device_value(
+            serialization.unpack(serialization.pack(dt.pack_device_value(x)))
+        )
+        assert out.sharding.mesh is rev  # landing used the registered mesh
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    finally:
+        dt._mesh_registry.clear()
+
+
+def test_strict_forbids_host_assembly(monkeypatch):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh((8,), ("x",))
+    x = jax.device_put(
+        jax.numpy.arange(8, dtype=jax.numpy.float32), NamedSharding(mesh, P("x"))
+    )
+    env = serialization.unpack(serialization.pack(dt.pack_device_value(x)))
+    # sabotage the landing mesh so reconstruction is impossible
+    env.leaves[0].desc["mesh_shape"] = (16,)
+    monkeypatch.setenv("CA_DEVICE_TRANSPORT_STRICT", "1")
+    with pytest.raises(RuntimeError, match="host assembly"):
+        dt.unpack_device_value(env)
+    monkeypatch.delenv("CA_DEVICE_TRANSPORT_STRICT")
+    out = dt.unpack_device_value(env)  # non-strict: falls back, data intact
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert dt.stats()["host_assembles"] >= 1
+
+
+# --------------------------------------------------------------------------
+# cross-process: compiled DAG hops
+# --------------------------------------------------------------------------
+
+
+@ca.remote
+class _ShardProducer:
+    """Emits a NamedSharding-ed array over this process's 8-device mesh."""
+
+    def __init__(self):
+        import os
+
+        os.environ["CA_DEVICE_TRANSPORT_STRICT"] = "1"
+
+    def make(self, scale):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("x",))
+        x = jax.numpy.arange(32, dtype=jax.numpy.float32).reshape(8, 4) * scale
+        return jax.device_put(x, NamedSharding(mesh, P("x", None)))
+
+
+@ca.remote
+class _ShardConsumer:
+    def __init__(self):
+        import os
+
+        os.environ["CA_DEVICE_TRANSPORT_STRICT"] = "1"
+
+    def check(self, y):
+        import jax
+
+        stats = dt.stats()
+        return {
+            "is_device": isinstance(y, jax.Array),
+            "named": isinstance(y.sharding, jax.sharding.NamedSharding),
+            "axes": tuple(y.sharding.mesh.axis_names)
+            if isinstance(y.sharding, jax.sharding.NamedSharding)
+            else None,
+            "n_devices": len(y.sharding.device_set),
+            "sum": float(y.sum()),
+            "host_assembles": stats["host_assembles"],
+            "sharded_landings": stats["sharded_landings"],
+        }
+
+
+def test_dag_sharded_hop_stays_device_native(ca_cluster_module):
+    """Two DAG actors exchange a sharded array; the consumer receives a
+    NamedSharding-ed jax.Array and its process recorded zero host
+    assemblies (strict mode would have raised on any)."""
+    p, c = _ShardProducer.remote(), _ShardConsumer.remote()
+    with InputNode() as inp:
+        out = c.check.bind(p.make.bind(inp).with_tensor_transport())
+    dag = out.experimental_compile()
+    try:
+        res = dag.execute(2.0).get(timeout=60)
+        assert res["is_device"] and res["named"]
+        assert res["axes"] == ("x",)
+        assert res["n_devices"] == 8
+        assert res["sum"] == float(np.arange(32).sum() * 2.0)
+        assert res["host_assembles"] == 0
+        assert res["sharded_landings"] >= 1
+    finally:
+        dag.teardown()
+    ca.kill(p)
+    ca.kill(c)
+
+
+def test_dag_driver_lands_sharded_output(ca_cluster_module):
+    """A tensor-transport output leaf arrives in the driver as a sharded
+    jax.Array over the driver's own mesh."""
+    import jax
+
+    p = _ShardProducer.remote()
+    with InputNode() as inp:
+        out = p.make.bind(inp).with_tensor_transport()
+    dag = out.experimental_compile()
+    try:
+        y = dag.execute(1.0).get(timeout=60)
+        assert isinstance(y, jax.Array)
+        assert isinstance(y.sharding, jax.sharding.NamedSharding)
+        assert len(y.sharding.device_set) == 8
+        np.testing.assert_array_equal(
+            np.asarray(y), np.arange(32, dtype=np.float32).reshape(8, 4)
+        )
+    finally:
+        dag.teardown()
+    ca.kill(p)
+
+
+# --------------------------------------------------------------------------
+# cross-process: DeviceRef fetch path (plain tasks/actors, no DAG)
+# --------------------------------------------------------------------------
+
+
+def test_device_ref_fetch_preserves_sharding(ca_cluster_module):
+    """An actor-returned sharded array, passed by ref to another actor,
+    arrives as a NamedSharding-ed jax.Array — not a host numpy copy."""
+    p, c = _ShardProducer.remote(), _ShardConsumer.remote()
+    ref = p.make.remote(3.0)
+    res = ca.get(c.check.remote(ref))
+    assert res["is_device"] and res["named"]
+    assert res["n_devices"] == 8
+    assert res["sum"] == float(np.arange(32).sum() * 3.0)
+    assert res["host_assembles"] == 0
+    ca.kill(p)
+    ca.kill(c)
+
+
+def test_driver_get_of_device_ref_lands_sharded(ca_cluster_module):
+    import jax
+
+    p = _ShardProducer.remote()
+    y = ca.get(p.make.remote(1.0))
+    assert isinstance(y, jax.Array)
+    assert isinstance(y.sharding, jax.sharding.NamedSharding)
+    assert len(y.sharding.device_set) == 8
+    np.testing.assert_array_equal(
+        np.asarray(y), np.arange(32, dtype=np.float32).reshape(8, 4)
+    )
+    ca.kill(p)
