@@ -47,7 +47,12 @@ from .core.placement import (
 )
 from .core.runtime_context import get_runtime_context
 from .core.scheduling_strategies import (
+    DoesNotExist,
+    Exists,
+    In,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    NotIn,
     PlacementGroupSchedulingStrategy,
     SpreadSchedulingStrategy,
 )
@@ -80,6 +85,11 @@ __all__ = [
     "PlacementGroupSchedulingStrategy",
     "NodeAffinitySchedulingStrategy",
     "SpreadSchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "In",
+    "NotIn",
+    "Exists",
+    "DoesNotExist",
     "get_runtime_context",
     "exceptions",
     "CAError",

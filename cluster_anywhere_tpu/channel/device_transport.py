@@ -247,11 +247,10 @@ def pack_device_value(value: Any) -> DeviceEnvelope:
 
     def repl(x):
         if isinstance(x, jax.Array):
-            if not x.is_fully_addressable:
-                # multi-host global array: its shards belong to a jit
-                # program's domain, not a channel's.  Ship the addressable
-                # part; the consumer lands what this process could see.
-                pass
+            # A multi-host global array ships only its addressable shards
+            # (its other shards belong to a jit program's domain, not a
+            # channel's); landing verifies coverage and refuses to fabricate
+            # the missing regions (_host_assemble's coverage check).
             keepalive.append(x)
             leaves.append(_pack_jax_leaf(x))
             return _LeafMarker(len(leaves) - 1)
@@ -307,6 +306,23 @@ def _host_assemble(leaf: _LeafPack) -> np.ndarray:
             "CA_DEVICE_TRANSPORT_STRICT (incompatible mesh or sharding)"
         )
     _bump("host_assembles")
+    total = 1
+    for d in leaf.shape:
+        total *= d
+    covered = 0
+    for key in leaf.keys:
+        n = 1
+        for a, b in key:
+            n *= b - a
+        covered += n
+    if covered < total:
+        # producer shipped only its addressable shards (multi-host array);
+        # fabricating the uncovered regions would be silent corruption
+        raise RuntimeError(
+            f"device transport cannot assemble leaf {leaf.shape}: shards cover "
+            f"{covered} of {total} elements (array was not fully addressable "
+            f"on the producer)"
+        )
     out = np.empty(leaf.shape, dtype=leaf.dtype)
     for key, buf in zip(leaf.keys, leaf.bufs):
         shard_shape = tuple(b - a for a, b in key)

@@ -114,8 +114,12 @@ class Cluster:
         num_tpus: float = 0,
         resources: Optional[Dict[str, float]] = None,
         node_id: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        env_overrides: Optional[Dict[str, str]] = None,
     ) -> str:
-        """Start a node-agent process and wait for it to join the cluster."""
+        """Start a node-agent process and wait for it to join the cluster.
+        `labels` become the node's scheduling labels; `env_overrides` lets a
+        test simulate e.g. a TPU host's TPU_* environment on the agent."""
         self._node_seq += 1
         nid = node_id or f"node{self._node_seq}"
         shape: Dict[str, float] = {"CPU": float(num_cpus)}
@@ -128,6 +132,10 @@ class Cluster:
         env["CA_HEAD_ADDR"] = self.head_tcp
         env["CA_NODE_ID"] = nid
         env["CA_NODE_RESOURCES"] = json.dumps(shape)
+        if labels:
+            env["CA_NODE_LABELS"] = json.dumps(labels)
+        if env_overrides:
+            env.update(env_overrides)
         node_dir = os.path.join(self.session_dir, "nodes", nid)
         os.makedirs(node_dir, exist_ok=True)
         agent_log = open(os.path.join(node_dir, "agent.log"), "ab")

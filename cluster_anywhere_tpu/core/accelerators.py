@@ -174,6 +174,57 @@ def additional_resources() -> Dict[str, float]:
     return out
 
 
+def node_labels() -> Dict[str, str]:
+    """Topology labels this node registers with the head, feeding
+    NodeLabelSchedulingStrategy (the reference's ray.io/* node labels +
+    the TPU fields its autoscaler puts in node metadata).  Keys:
+
+      ca.io/accelerator-type   "TPU-V5E" marker (generation, upper-case)
+      ca.io/tpu-generation     "v5e"
+      ca.io/tpu-pod-type       "v5e-16" (slice type)
+      ca.io/tpu-topology       TPU_CHIPS_PER_HOST_BOUNDS, e.g. "2,2,1"
+      ca.io/tpu-slice-name     TPU_NAME (pod/slice identity for gang placement)
+      ca.io/tpu-worker-id      "0".."N-1" within the slice
+    """
+    out: Dict[str, str] = {}
+    if num_tpu_chips() <= 0:
+        return out
+    at = accelerator_type()
+    if at:
+        out["ca.io/accelerator-type"] = at
+    pt = pod_type()
+    if pt:
+        out["ca.io/tpu-pod-type"] = pt
+        out["ca.io/tpu-generation"] = pt.split("-")[0]
+    bounds = os.environ.get(CHIPS_PER_HOST_BOUNDS_ENV)
+    if bounds:
+        out["ca.io/tpu-topology"] = bounds
+    nm = pod_name()
+    if nm:
+        out["ca.io/tpu-slice-name"] = nm
+    wid = worker_id()
+    if wid is not None:
+        out["ca.io/tpu-worker-id"] = str(wid)
+    return out
+
+
+def parse_labels_env(env_val: Optional[str]) -> Dict[str, str]:
+    """Parse a CA_NODE_LABELS-style JSON object into a str->str label map;
+    malformed or non-object JSON yields {} (a bad env var must not kill a
+    node agent at startup)."""
+    if not env_val:
+        return {}
+    import json
+
+    try:
+        obj = json.loads(env_val)
+    except ValueError:
+        return {}
+    if not isinstance(obj, dict):
+        return {}
+    return {str(k): str(v) for k, v in obj.items()}
+
+
 def visible_chips_env_for_worker(chip_id) -> Dict[str, str]:
     """Env a spawned TPU-pool worker should receive to pin it to one chip
     (set_current_process_visible_accelerator_ids analogue).  Empty when

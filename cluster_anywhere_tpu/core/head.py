@@ -22,6 +22,7 @@ deaths, and serves chunked object pulls from its node's shm namespace.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import signal
 import subprocess
@@ -43,6 +44,20 @@ LOCAL_NODE = "n0"
 # --------------------------------------------------------------------------
 
 
+def _detect_labels(node_id: str, explicit: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Labels a node carries into the table: auto-detected TPU topology
+    labels, CA_NODE_LABELS env overrides (JSON), and the node id itself
+    (ray.io/node-id analogue)."""
+    from . import accelerators
+
+    labels = dict(accelerators.node_labels())
+    labels.update(accelerators.parse_labels_env(os.environ.get("CA_NODE_LABELS")))
+    if explicit:
+        labels.update({str(k): str(v) for k, v in explicit.items()})
+    labels["ca.io/node-id"] = node_id
+    return labels
+
+
 @dataclass
 class NodeRec:
     node_id: str
@@ -58,6 +73,7 @@ class NodeRec:
     max_workers: int = 64
     mem_pressured: bool = False  # agent-reported memory pressure (monitor)
     load: Dict[str, float] = field(default_factory=dict)  # heartbeat telemetry
+    labels: Dict[str, str] = field(default_factory=dict)  # static node labels
 
     @property
     def is_local(self) -> bool:
@@ -158,6 +174,7 @@ class BundleRec:
     resources: Dict[str, float]
     used: Dict[str, float] = field(default_factory=dict)
     node_id: Optional[str] = None  # assigned node (None until placed)
+    labels: Optional[dict] = None  # hard label selector constraining placement
 
 
 @dataclass
@@ -181,7 +198,12 @@ class Head:
         # -- node table (gcs_node_manager.h analogue); the head embeds n0 --
         self.nodes: Dict[str, NodeRec] = {}
         self._node_index = 0
-        self._add_node(NodeRec(LOCAL_NODE, None, dict(resources), dict(resources)))
+        self._add_node(
+            NodeRec(
+                LOCAL_NODE, None, dict(resources), dict(resources),
+                labels=_detect_labels(LOCAL_NODE),
+            )
+        )
         # chip allocator for TPU-worker pinning; active only on multi-chip
         # hosts (a single chip needs no TPU_VISIBLE_CHIPS restriction)
         n_chips = int(resources.get("TPU", 0))
@@ -293,7 +315,7 @@ class Head:
 
     def _node_views(self, nodes: Optional[List[NodeRec]] = None) -> List[scheduling.NodeView]:
         return [
-            scheduling.NodeView(n.node_id, n.total, n.avail, n.index)
+            scheduling.NodeView(n.node_id, n.total, n.avail, n.index, labels=n.labels)
             for n in (nodes if nodes is not None else self._alive_nodes())
         ]
 
@@ -322,7 +344,7 @@ class Head:
                 {
                     "node_id": n.node_id, "addr": n.addr, "total": n.total,
                     "avail": n.avail, "index": n.index, "state": n.state,
-                    "pid": n.pid,
+                    "pid": n.pid, "labels": n.labels,
                 }
                 for n in self.nodes.values()
             ],
@@ -361,7 +383,10 @@ class Head:
                 {
                     "pg_id": p.pg_id, "strategy": p.strategy, "state": p.state,
                     "bundles": [
-                        {"resources": b.resources, "used": b.used, "node_id": b.node_id}
+                        {
+                            "resources": b.resources, "used": b.used,
+                            "node_id": b.node_id, "labels": b.labels,
+                        }
                         for b in p.bundles
                     ],
                 }
@@ -401,6 +426,7 @@ class Head:
             rec = NodeRec(
                 n["node_id"], n["addr"], n["total"], n["avail"],
                 index=n["index"], state=n["state"], pid=n["pid"],
+                labels=n.get("labels") or {},
             )
             rec.max_workers = int(rec.total.get("CPU", 4)) * 4 + 4
             rec.last_heartbeat = now  # grace: agents get time to reconnect
@@ -723,7 +749,12 @@ class Head:
                     return True
                 return False
             kind = "DEFAULT"
-        if len(alive) > 1:
+        if kind == "NODE_LABEL":
+            # label-filtered candidates (hard drops, soft prefers); an
+            # unmatchable selector leaves the request pending, same as an
+            # unsatisfiable resource shape — a matching node may join later
+            alive = scheduling.filter_rank_labels(alive, req.strategy, threshold)
+        elif len(alive) > 1:
             # rank over the live NodeRecs in place (no snapshot copies)
             if kind == "SPREAD":
                 alive = scheduling.rank_spread(alive)
@@ -1186,6 +1217,11 @@ class Head:
                 dict(msg.get("resources") or {}),
                 dict(msg.get("resources") or {}),
                 pid=msg.get("pid", 0),
+                # the agent detects its own labels (its env, not the head's)
+                labels={
+                    **{str(k): str(v) for k, v in (msg.get("labels") or {}).items()},
+                    "ca.io/node-id": node_id,
+                },
             )
         )
         state["node_id"] = node_id
@@ -1724,8 +1760,14 @@ class Head:
         if strategy == "STRICT_SPREAD" and len(bundles) > len(alive):
             return f"STRICT_SPREAD: {len(bundles)} bundles > {len(alive)} nodes"
         for b in bundles:
-            if not any(self._fits(n.total, b.resources) for n in alive):
-                return f"bundle {b.resources} fits no node's total capacity"
+            cands = [
+                n for n in alive
+                if b.labels is None or scheduling.match_labels(n.labels, b.labels)
+            ]
+            if not cands:
+                return f"bundle label selector {b.labels} matches no alive node"
+            if not any(self._fits(n.total, b.resources) for n in cands):
+                return f"bundle {b.resources} fits no eligible node's total capacity"
         demand = self._pg_demand(bundles)
         if not self._fits(self._agg_total(), demand):
             return f"need {demand}, cluster total {self._agg_total()}"
@@ -1748,6 +1790,7 @@ class Head:
             [rec.bundles[i].resources for i in unplaced],
             rec.strategy,
             self.config.scheduler_spread_threshold,
+            bundle_labels=[rec.bundles[i].labels for i in unplaced],
         )
         if assignment is None:
             return False
@@ -1763,7 +1806,11 @@ class Head:
         that fits total but not currently-free resources is PENDING and is
         created FIFO as leases/actors/PGs release resources (pg_wait blocks
         on it).  Bundles are placed onto nodes per PACK/SPREAD/STRICT_*."""
-        bundles = [BundleRec(resources=b) for b in msg["bundles"]]
+        blabels = msg.get("bundle_labels") or [None] * len(msg["bundles"])
+        bundles = [
+            BundleRec(resources=b, labels=l)
+            for b, l in zip(msg["bundles"], blabels)
+        ]
         strategy = msg.get("strategy", "PACK")
         why = self._pg_infeasible(bundles, strategy)
         if why is not None:
@@ -1870,6 +1917,7 @@ class Head:
                     "alive": n.state == "alive",
                     "resources": n.total,
                     "available": n.avail,
+                    "labels": n.labels,
                     "load": n.load if not n.is_local else node_load_sample(),
                     "is_head_node": n.is_local,
                     "n_workers": sum(

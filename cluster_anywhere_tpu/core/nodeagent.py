@@ -60,6 +60,13 @@ class NodeAgent:
         import json
 
         self.resources = json.loads(os.environ.get("CA_NODE_RESOURCES", '{"CPU": 4}'))
+        # labels travel with registration: auto-detected TPU topology plus
+        # CA_NODE_LABELS overrides, detected HERE (the agent's env, not the
+        # head's) — NodeLabelSchedulingStrategy matches against these
+        from .accelerators import node_labels, parse_labels_env
+
+        self.labels = dict(node_labels())
+        self.labels.update(parse_labels_env(os.environ.get("CA_NODE_LABELS")))
         self.config = CAConfig.from_json(os.environ["CA_CONFIG_JSON"])
         set_config(self.config)
         self.serve_addr_spec = os.environ.get("CA_AGENT_SERVE", "tcp:127.0.0.1:0")
@@ -219,6 +226,7 @@ class NodeAgent:
             client_id=self.node_id,
             addr=self.serve_addr,
             resources=self.resources,
+            labels=self.labels,
             pid=os.getpid(),
         )
         # readiness marker for the cluster fixture
@@ -263,6 +271,7 @@ class NodeAgent:
                     client_id=self.node_id,
                     addr=self.serve_addr,
                     resources=self.resources,
+                    labels=self.labels,
                     pid=os.getpid(),
                     timeout=5,
                 )

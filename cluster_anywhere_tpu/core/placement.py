@@ -70,7 +70,12 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: Optional[str] = None,
+    bundle_label_selectors: Optional[List[Optional[dict]]] = None,
 ) -> PlacementGroup:
+    """`bundle_label_selectors` optionally gives one label selector per bundle
+    (dict of label key -> In/NotIn/Exists/DoesNotExist or bare string); that
+    bundle is then only placed on nodes matching it — e.g. pin a bundle per
+    TPU slice via {"ca.io/tpu-slice-name": "pod-a"}."""
     if strategy not in STRATEGIES:
         raise ValueError(f"strategy must be one of {STRATEGIES}")
     if not bundles or any(not b for b in bundles):
@@ -78,6 +83,13 @@ def placement_group(
     for b in bundles:
         if any(v < 0 for v in b.values()):
             raise ValueError("bundle resources must be non-negative")
+    wire_labels = None
+    if bundle_label_selectors is not None:
+        from .scheduling_strategies import selector_wire
+
+        if len(bundle_label_selectors) != len(bundles):
+            raise ValueError("bundle_label_selectors must match bundles 1:1")
+        wire_labels = [selector_wire(s) for s in bundle_label_selectors]
     pg_id = PlacementGroupID.from_random()
     w = global_worker()
     w.head_call(
@@ -85,6 +97,7 @@ def placement_group(
         pg_id=pg_id.hex(),
         bundles=[{k: float(v) for k, v in b.items()} for b in bundles],
         strategy=strategy,
+        bundle_labels=wire_labels,
     )
     return PlacementGroup(pg_id, bundles)
 

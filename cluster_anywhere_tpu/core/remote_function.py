@@ -48,6 +48,7 @@ def _normalize_pg(opts: Dict[str, Any]) -> Dict[str, Any]:
     from .placement import PlacementGroup
     from .scheduling_strategies import (
         NodeAffinitySchedulingStrategy,
+        NodeLabelSchedulingStrategy,
         PlacementGroupSchedulingStrategy,
         SpreadSchedulingStrategy,
     )
@@ -63,6 +64,8 @@ def _normalize_pg(opts: Dict[str, Any]) -> Dict[str, Any]:
             "node_id": strat.node_id,
             "soft": strat.soft,
         }
+    elif isinstance(strat, NodeLabelSchedulingStrategy):
+        out["strategy"] = strat.to_wire()
     elif isinstance(strat, SpreadSchedulingStrategy) or strat == "SPREAD":
         out["strategy"] = {"type": "SPREAD"}
     pg = out.get("placement_group")

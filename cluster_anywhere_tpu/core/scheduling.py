@@ -11,8 +11,13 @@ Policies mirrored from the reference:
 - spread (spread_scheduling_policy.h): least-utilized first.
 - node affinity (node_affinity_scheduling_policy.h): a specific node, with a
   soft fallback to hybrid.
+- node labels (node_label_scheduling_policy.h): hard/soft key->condition
+  selectors over the labels each node registered with.  On TPU pods the
+  labels carry generation/topology/slice, so this is the gang-placement
+  vocabulary (schedule onto "generation in v5e, worker-id 0", etc).
 - bundle placement (bundle_scheduling_policy.h): PACK / SPREAD /
-  STRICT_PACK / STRICT_SPREAD over placement-group bundles.
+  STRICT_PACK / STRICT_SPREAD over placement-group bundles, each bundle
+  optionally constrained to label-matching nodes.
 """
 
 from __future__ import annotations
@@ -46,13 +51,21 @@ class NodeView:
     """Mutable scheduling snapshot of one node (the policy `take`s from it
     while simulating multi-item placement)."""
 
-    __slots__ = ("node_id", "total", "avail", "index")
+    __slots__ = ("node_id", "total", "avail", "index", "labels")
 
-    def __init__(self, node_id: str, total: Shape, avail: Shape, index: int = 0):
+    def __init__(
+        self,
+        node_id: str,
+        total: Shape,
+        avail: Shape,
+        index: int = 0,
+        labels: Optional[Dict[str, str]] = None,
+    ):
         self.node_id = node_id
         self.total = dict(total)
         self.avail = dict(avail)
         self.index = index  # join order; lower = longer-lived (head node first)
+        self.labels = labels or {}
 
 
 def rank_hybrid(nodes: Sequence, threshold: float) -> List:
@@ -72,6 +85,47 @@ def rank_spread(nodes: Sequence) -> List:
     return sorted(nodes, key=lambda n: (utilization(n.total, n.avail), n.index))
 
 
+def match_labels(labels: Dict[str, str], selector: Optional[Dict[str, dict]]) -> bool:
+    """Does a node's label map satisfy a selector?  Selector values are wire
+    dicts: {"op": "in"|"!in"|"exists"|"!exists", "values": [...]}
+    (label_selector semantics of node_label_scheduling_policy.h)."""
+    if not selector:
+        return True
+    for key, cond in selector.items():
+        op = cond.get("op", "in")
+        present = key in labels
+        if op == "exists":
+            if not present:
+                return False
+        elif op == "!exists":
+            if present:
+                return False
+        elif op == "in":
+            if not present or labels[key] not in cond.get("values", ()):
+                return False
+        elif op == "!in":
+            if present and labels[key] in cond.get("values", ()):
+                return False
+        else:
+            raise ValueError(f"unknown label-selector op {op!r}")
+    return True
+
+
+def filter_rank_labels(nodes: Sequence, strategy: dict, threshold: float) -> List:
+    """NODE_LABEL ranking: drop nodes failing the hard selector, then order
+    soft-selector matches first; hybrid rank within each tier (so labels pick
+    the candidate set and the usual utilization policy picks within it)."""
+    hard = strategy.get("hard")
+    soft = strategy.get("soft")
+    cands = [n for n in nodes if match_labels(getattr(n, "labels", None) or {}, hard)]
+    if not soft:
+        return rank_hybrid(cands, threshold)
+    pref = [n for n in cands if match_labels(getattr(n, "labels", None) or {}, soft)]
+    pref_ids = {id(n) for n in pref}
+    rest = [n for n in cands if id(n) not in pref_ids]
+    return rank_hybrid(pref, threshold) + rank_hybrid(rest, threshold)
+
+
 def pick_node(
     nodes: Sequence[NodeView],
     shape: Shape,
@@ -80,7 +134,8 @@ def pick_node(
 ) -> Optional[NodeView]:
     """Choose a node for one resource shape. `strategy` is a wire dict:
     None/{"type":"DEFAULT"} = hybrid; {"type":"SPREAD"};
-    {"type":"NODE_AFFINITY","node_id":...,"soft":bool}."""
+    {"type":"NODE_AFFINITY","node_id":...,"soft":bool};
+    {"type":"NODE_LABEL","hard":selector,"soft":selector}."""
     kind = (strategy or {}).get("type", "DEFAULT")
     if kind == "NODE_AFFINITY":
         want = strategy.get("node_id")
@@ -92,7 +147,12 @@ def pick_node(
         if not strategy.get("soft", False):
             return None
         kind = "DEFAULT"
-    ranked = rank_spread(nodes) if kind == "SPREAD" else rank_hybrid(nodes, threshold)
+    if kind == "NODE_LABEL":
+        ranked = filter_rank_labels(nodes, strategy, threshold)
+    elif kind == "SPREAD":
+        ranked = rank_spread(nodes)
+    else:
+        ranked = rank_hybrid(nodes, threshold)
     for n in ranked:
         if fits(n.avail, shape):
             return n
@@ -104,14 +164,25 @@ def place_bundles(
     bundles: Sequence[Shape],
     strategy: str,
     threshold: float = 0.5,
+    bundle_labels: Optional[Sequence[Optional[Dict[str, dict]]]] = None,
 ) -> Optional[List[str]]:
     """Assign each bundle a node id per the PG strategy, simulating resource
     consumption as it goes.  Returns the node id per bundle, or None if the
     assignment is not currently possible (caller decides pending/infeasible).
-    Mutates the passed NodeViews' avail (callers pass snapshots)."""
+    Mutates the passed NodeViews' avail (callers pass snapshots).
+    `bundle_labels` optionally gives a hard label selector per bundle; a
+    bundle only lands on nodes matching its selector."""
+
+    def ok(n: NodeView, i: int) -> bool:
+        if bundle_labels is None or bundle_labels[i] is None:
+            return True
+        return match_labels(n.labels, bundle_labels[i])
+
     out: List[Optional[str]] = [None] * len(bundles)
     if strategy == "STRICT_PACK":
         for n in rank_hybrid(nodes, threshold):
+            if not all(ok(n, i) for i in range(len(bundles))):
+                continue
             sim = dict(n.avail)
             if all(_sim_take(sim, b) for b in bundles):
                 for i, b in enumerate(bundles):
@@ -124,7 +195,7 @@ def place_bundles(
         for i, b in enumerate(bundles):
             chosen = None
             for n in rank_spread(nodes):
-                if n.node_id in used or not fits(n.avail, b):
+                if n.node_id in used or not ok(n, i) or not fits(n.avail, b):
                     continue
                 chosen = n
                 break
@@ -139,7 +210,7 @@ def place_bundles(
         # bundles than nodes (soft spread)
         for i, b in enumerate(bundles):
             chosen = None
-            ranked = rank_spread(nodes)
+            ranked = [n for n in rank_spread(nodes) if ok(n, i)]
             # prefer a node not used yet by this PG
             used_ids = set(x for x in out if x is not None)
             for n in ranked:
@@ -160,7 +231,7 @@ def place_bundles(
     for i, b in enumerate(bundles):
         chosen = None
         for n in rank_hybrid(nodes, threshold):
-            if fits(n.avail, b):
+            if ok(n, i) and fits(n.avail, b):
                 chosen = n
                 break
         if chosen is None:

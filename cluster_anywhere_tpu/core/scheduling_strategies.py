@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional, Union
 
 
 class PlacementGroupSchedulingStrategy:
@@ -25,3 +25,81 @@ class NodeAffinitySchedulingStrategy:
 
 class SpreadSchedulingStrategy:
     pass
+
+
+# ---------------------------------------------------------------------------
+# node-label scheduling (python/ray/util/scheduling_strategies.py:135
+# NodeLabelSchedulingStrategy + In/NotIn/Exists/DoesNotExist operators)
+# ---------------------------------------------------------------------------
+
+
+class In:
+    def __init__(self, *values: str):
+        if not values:
+            raise ValueError("In() needs at least one value")
+        self.values = [str(v) for v in values]
+
+
+class NotIn:
+    def __init__(self, *values: str):
+        if not values:
+            raise ValueError("NotIn() needs at least one value")
+        self.values = [str(v) for v in values]
+
+
+class Exists:
+    pass
+
+
+class DoesNotExist:
+    pass
+
+
+LabelCondition = Union[In, NotIn, Exists, DoesNotExist, str]
+
+
+def _cond_wire(cond: LabelCondition) -> dict:
+    """Wire form consumed by scheduling.match_labels.  A bare string is
+    shorthand for In(value)."""
+    if isinstance(cond, str):
+        return {"op": "in", "values": [cond]}
+    if isinstance(cond, In):
+        return {"op": "in", "values": cond.values}
+    if isinstance(cond, NotIn):
+        return {"op": "!in", "values": cond.values}
+    if isinstance(cond, Exists):
+        return {"op": "exists"}
+    if isinstance(cond, DoesNotExist):
+        return {"op": "!exists"}
+    raise TypeError(f"label condition must be In/NotIn/Exists/DoesNotExist/str, got {cond!r}")
+
+
+def selector_wire(selector: Optional[Dict[str, LabelCondition]]) -> Optional[dict]:
+    if not selector:
+        return None
+    return {str(k): _cond_wire(v) for k, v in selector.items()}
+
+
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes whose labels satisfy `hard` (required), preferring
+    nodes that also satisfy `soft`.  On TPU clusters the auto-populated
+    labels (ca.io/tpu-generation, ca.io/tpu-pod-type, ca.io/tpu-slice-name,
+    ca.io/tpu-worker-id, ...) make this the natural slice/topology targeting
+    vocabulary."""
+
+    def __init__(
+        self,
+        hard: Optional[Dict[str, LabelCondition]] = None,
+        soft: Optional[Dict[str, LabelCondition]] = None,
+    ):
+        if not hard and not soft:
+            raise ValueError("NodeLabelSchedulingStrategy needs hard and/or soft constraints")
+        self.hard = hard or {}
+        self.soft = soft or {}
+
+    def to_wire(self) -> dict:
+        return {
+            "type": "NODE_LABEL",
+            "hard": selector_wire(self.hard),
+            "soft": selector_wire(self.soft),
+        }

@@ -14,6 +14,7 @@ MemoryStore (condition-variable waits) so `get`/`wait` never touch the loop.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import threading
 import time
@@ -1624,7 +1625,9 @@ class Worker:
         if opts.get("placement_group") is not None:
             pg = (opts["placement_group"], opts.get("placement_group_bundle_index", 0))
         strat = opts.get("strategy")
-        strat_key = tuple(sorted(strat.items())) if strat else None
+        # canonical JSON: NODE_LABEL strategies carry nested selector dicts,
+        # which a tuple-of-items key cannot hash
+        strat_key = json.dumps(strat, sort_keys=True) if strat else None
         key = (tuple(sorted(shape.items())), pg, strat_key)
         pool = self._lease_pools.get(key)
         if pool is None:

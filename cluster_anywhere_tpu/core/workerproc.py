@@ -580,10 +580,14 @@ class WorkerProcess:
             )
         if _is_device_value(value):
             # device-native: ship per-shard buffer borrows + sharding
-            # metadata, not a device_get'd host copy (channel/device_transport)
+            # metadata, not a device_get'd host copy (channel/device_transport).
+            # Packing does per-shard D2H DMAs — executor thread, not the IO
+            # loop, or a multi-GB transfer stalls heartbeats and RPC serving
             from ..channel.device_transport import pack_device_value
 
-            value = pack_device_value(value)
+            return await self.loop.run_in_executor(
+                None, lambda: serialization.pack(pack_device_value(value))
+            )
         return await self.loop.run_in_executor(None, serialization.pack, value)
 
     async def _graceful_exit(self):

@@ -1,0 +1,234 @@
+"""Node-label scheduling (analogue of NodeLabelSchedulingStrategy,
+python/ray/util/scheduling_strategies.py:135 and
+src/ray/raylet/scheduling/policy/node_label_scheduling_policy.h).
+
+Two layers: pure policy unit tests over NodeViews (no cluster), and a
+Cluster-fixture test where labeled agent nodes — one simulating a TPU host
+via its TPU_* env — receive tasks/actors/PG bundles by label.
+"""
+
+import os
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.cluster_utils import Cluster
+from cluster_anywhere_tpu.core import scheduling
+from cluster_anywhere_tpu.core.scheduling import NodeView, match_labels, pick_node, place_bundles
+from cluster_anywhere_tpu.core.scheduling_strategies import (
+    DoesNotExist,
+    Exists,
+    In,
+    NodeLabelSchedulingStrategy,
+    NotIn,
+    selector_wire,
+)
+
+
+# ---------------------------------------------------------------- unit layer
+
+
+def test_match_labels_operators():
+    labels = {"region": "us-east", "gen": "v5e"}
+    assert match_labels(labels, selector_wire({"region": In("us-east", "us-west")}))
+    assert not match_labels(labels, selector_wire({"region": In("eu")}))
+    assert match_labels(labels, selector_wire({"region": NotIn("eu")}))
+    assert not match_labels(labels, selector_wire({"gen": NotIn("v5e")}))
+    assert match_labels(labels, selector_wire({"gen": Exists()}))
+    assert not match_labels(labels, selector_wire({"zone": Exists()}))
+    assert match_labels(labels, selector_wire({"zone": DoesNotExist()}))
+    assert not match_labels(labels, selector_wire({"gen": DoesNotExist()}))
+    # bare string is In(value); absent key fails In and NotIn passes on absent
+    assert match_labels(labels, selector_wire({"region": "us-east"}))
+    assert not match_labels(labels, selector_wire({"zone": In("a")}))
+    assert match_labels(labels, selector_wire({"zone": NotIn("a")}))
+    # empty/None selector matches everything
+    assert match_labels(labels, None)
+    assert match_labels({}, None)
+
+
+def _views():
+    return [
+        NodeView("a", {"CPU": 4}, {"CPU": 4}, 0, labels={"gen": "v4", "disk": "ssd"}),
+        NodeView("b", {"CPU": 4}, {"CPU": 4}, 1, labels={"gen": "v5e"}),
+        NodeView("c", {"CPU": 4}, {"CPU": 4}, 2, labels={"gen": "v5e", "disk": "ssd"}),
+    ]
+
+
+def test_pick_node_hard_label():
+    strat = NodeLabelSchedulingStrategy(hard={"gen": In("v5e")}).to_wire()
+    got = pick_node(_views(), {"CPU": 1}, strat)
+    assert got is not None and got.node_id == "b"  # earliest matching by join order
+    # unmatchable -> None (stays pending at the head, like infeasible shapes)
+    strat = NodeLabelSchedulingStrategy(hard={"gen": In("v6e")}).to_wire()
+    assert pick_node(_views(), {"CPU": 1}, strat) is None
+
+
+def test_pick_node_soft_prefers_but_falls_back():
+    strat = NodeLabelSchedulingStrategy(
+        hard={"gen": In("v5e")}, soft={"disk": In("ssd")}
+    ).to_wire()
+    got = pick_node(_views(), {"CPU": 1}, strat)
+    assert got.node_id == "c"  # soft match wins over join order
+    # soft-only strategy: prefers matches, falls back to any node
+    strat = NodeLabelSchedulingStrategy(soft={"disk": In("nvme")}).to_wire()
+    got = pick_node(_views(), {"CPU": 1}, strat)
+    assert got is not None  # nothing matches soft; still places
+
+
+def test_pick_node_hard_respects_resources():
+    views = _views()
+    views[1].avail = {"CPU": 0}  # b full
+    strat = NodeLabelSchedulingStrategy(hard={"gen": In("v5e")}).to_wire()
+    got = pick_node(views, {"CPU": 1}, strat)
+    assert got.node_id == "c"  # next eligible
+
+
+def test_place_bundles_with_label_constraints():
+    views = _views()
+    sel = selector_wire({"disk": In("ssd")})
+    out = place_bundles(
+        views, [{"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD", bundle_labels=[sel, sel]
+    )
+    assert out is not None and set(out) == {"a", "c"}
+    # STRICT_PACK: the one node must satisfy every bundle's selector
+    views = _views()
+    out = place_bundles(
+        views,
+        [{"CPU": 1}, {"CPU": 1}],
+        "STRICT_PACK",
+        bundle_labels=[sel, selector_wire({"gen": In("v5e")})],
+    )
+    assert out == ["c", "c"]
+    # no node satisfies both selectors at once
+    views = _views()
+    out = place_bundles(
+        views,
+        [{"CPU": 1}],
+        "PACK",
+        bundle_labels=[selector_wire({"gen": In("v4"), "disk": In("hdd")})],
+    )
+    assert out is None
+
+
+def test_strategy_wire_validation():
+    with pytest.raises(ValueError):
+        NodeLabelSchedulingStrategy()
+    with pytest.raises(ValueError):
+        In()
+    with pytest.raises(ValueError):
+        match_labels({}, {"k": {"op": "bogus"}})
+
+
+# ------------------------------------------------------------- cluster layer
+
+
+@pytest.fixture(scope="module")
+def label_cluster():
+    """head + a 'cpu' labeled node + a simulated TPU host (labels derived
+    from its TPU_* env, as a real v5e worker would present them)."""
+    c = Cluster(head_resources={"CPU": 1})
+    c.add_node(num_cpus=2, labels={"market-type": "spot", "region": "us-east"})
+    c.add_node(
+        num_cpus=2,
+        num_tpus=4,
+        node_id="tpunode",
+        env_overrides={
+            "TPU_ACCELERATOR_TYPE": "v5e-8",
+            "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+            "TPU_NAME": "slice-a",
+            "TPU_WORKER_ID": "0",
+        },
+    )
+    c.connect()
+    c.wait_for_nodes(3)
+    yield c
+    c.shutdown()
+
+
+@ca.remote
+def which_node():
+    return os.environ.get("CA_NODE_ID", "n0")
+
+
+def test_labels_visible_in_node_table(label_cluster):
+    nodes = {n["node_id"]: n for n in label_cluster.nodes() if n["alive"]}
+    assert nodes["node1"]["labels"]["market-type"] == "spot"
+    assert nodes["node1"]["labels"]["ca.io/node-id"] == "node1"
+    tl = nodes["tpunode"]["labels"]
+    # auto-populated from the agent's TPU_* env (accelerators.node_labels)
+    assert tl["ca.io/tpu-generation"] == "v5e"
+    assert tl["ca.io/tpu-pod-type"] == "v5e-8"
+    assert tl["ca.io/tpu-slice-name"] == "slice-a"
+    assert tl["ca.io/tpu-worker-id"] == "0"
+    assert tl["ca.io/tpu-topology"] == "2,2,1"
+    assert tl["ca.io/accelerator-type"] == "TPU-V5E"
+
+
+def test_task_placed_by_label(label_cluster):
+    strat = NodeLabelSchedulingStrategy(hard={"market-type": In("spot")})
+    got = ca.get(
+        which_node.options(scheduling_strategy=strat).remote(), timeout=60
+    )
+    assert got == "node1"
+
+
+def test_task_placed_by_tpu_topology_label(label_cluster):
+    strat = NodeLabelSchedulingStrategy(
+        hard={"ca.io/tpu-generation": In("v5e"), "ca.io/tpu-worker-id": In("0")}
+    )
+    got = ca.get(
+        which_node.options(scheduling_strategy=strat).remote(), timeout=60
+    )
+    assert got == "tpunode"
+
+
+def test_task_not_in_label(label_cluster):
+    # !in: avoid the spot node AND the head (which lacks the label entirely —
+    # NotIn passes on absent, so exclude by node-id too)
+    strat = NodeLabelSchedulingStrategy(
+        hard={"market-type": NotIn("spot"), "ca.io/node-id": NotIn("n0")}
+    )
+    got = ca.get(
+        which_node.options(scheduling_strategy=strat).remote(), timeout=60
+    )
+    assert got == "tpunode"
+
+
+def test_actor_placed_by_label(label_cluster):
+    @ca.remote
+    class Where:
+        def node(self):
+            return os.environ.get("CA_NODE_ID", "n0")
+
+    a = Where.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"ca.io/tpu-slice-name": In("slice-a")}
+        )
+    ).remote()
+    assert ca.get(a.node.remote(), timeout=60) == "tpunode"
+    ca.kill(a)
+
+
+def test_pg_bundle_label_selector(label_cluster):
+    pg = ca.placement_group(
+        [{"CPU": 1}, {"CPU": 1}],
+        strategy="SPREAD",
+        bundle_label_selectors=[
+            {"ca.io/tpu-slice-name": In("slice-a")},
+            {"market-type": In("spot")},
+        ],
+    )
+    assert pg.wait(30)
+    table = {p["pg_id"]: p for p in ca.placement_group_table()}
+    nodes = table[pg.id.hex()]["bundle_nodes"]
+    assert nodes == ["tpunode", "node1"]
+    ca.remove_placement_group(pg)
+
+
+def test_pg_infeasible_label_selector(label_cluster):
+    with pytest.raises(ca.exceptions.PlacementGroupError):
+        ca.placement_group(
+            [{"CPU": 1}],
+            bundle_label_selectors=[{"ca.io/tpu-generation": In("v99")}],
+        )
