@@ -82,23 +82,65 @@ def bench_core():
     # ndarray, not bytes: pickle-5 only emits out-of-band buffers for
     # ndarray/bytearray, and the zero-copy shm path is what the baseline measures
     arr = np.frombuffer(np.random.bytes(size), dtype=np.uint8)
+    probe = _MemcpyProbe(arr)
     reps = 2 if QUICK else 5
     warm = [ca.put(arr) for _ in range(reps)]
     del warm
     time.sleep(1.0)  # slice reclaim drains; pages stay faulted
     best_put = 0.0
-    # best-of-3: the shared host's memcpy bandwidth swings >2x run to run
+    ceiling = 0.0
+    # best-of-3, the ceiling probe interleaved with the put rounds: this
+    # host's memcpy bandwidth swings >2x with co-tenant load, so the ratio
+    # is only meaningful when both sides see the same conditions
     for _ in range(3):
+        ceiling = max(ceiling, probe.measure())
         t0 = time.time()
         refs = [ca.put(arr) for _ in range(reps)]
         dt = time.time() - t0
         best_put = max(best_put, reps * size / dt / 1e9)
         del refs
         time.sleep(0.5)
-    log(f"put_gb_per_s: {best_put:.2f} (baseline 18.52)")
+    log(
+        f"put_gb_per_s: {best_put:.2f} (baseline 18.52; this host's 1-thread "
+        f"memcpy ceiling {ceiling:.2f} -> put at {best_put/ceiling:.0%} of ceiling)"
+    )
 
     ca.shutdown()
     return best_tasks, best_actor, sync_rate
+
+
+class _MemcpyProbe:
+    """Raw single-thread memcpy bandwidth into pre-faulted /dev/shm, GB/s —
+    the physical bound a put (one serialize-free copy into the store) can
+    approach on this host.  Printing it next to put_gb_per_s separates
+    framework overhead from host memory physics."""
+
+    def __init__(self, src):
+        import mmap
+        import os
+
+        import numpy as np
+
+        self.src = src
+        size = len(src)
+        path = f"/dev/shm/ca_memcpy_probe_{os.getpid()}"
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            self._m = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+            os.unlink(path)
+        self.dst = np.frombuffer(memoryview(self._m), dtype=np.uint8)
+        self.dst[:] = src  # fault the pages before any timed copy
+
+    def measure(self, rounds: int = 2) -> float:
+        best = 0.0
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            self.dst[:] = self.src
+            best = max(best, len(self.src) / (time.perf_counter() - t0) / 1e9)
+        return best
 
 
 def _check_flash_numerics():
@@ -142,6 +184,18 @@ def bench_model():
         on_tpu = devs[0].platform not in ("cpu",)
         flash_ok = _check_flash_numerics() if on_tpu else False
 
+        # v5e bf16 peak per chip; MFU printed against it so every round is
+        # accountable to the number (SURVEY §7.6 bar: >=40%)
+        PEAK_TFLOPS = 197.0
+
+        def model_flops_per_step(cfg, b, t):
+            e, h, kv, d = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+            f, L, V = cfg.d_ff, cfg.n_layers, cfg.vocab_size
+            per_tok_layer = 2 * (e * h * d + 2 * e * kv * d + h * d * e + 3 * e * f)
+            attn_per_seq_layer = 4 * t * t * d * h
+            fwd = b * t * per_tok_layer * L + b * attn_per_seq_layer * L + b * t * 2 * e * V
+            return 3 * fwd  # bwd ~= 2x fwd
+
         def run(attn_impl: str):
             cfg = TransformerConfig(
                 vocab_size=32000,
@@ -173,19 +227,30 @@ def bench_model():
                 params, opt_state, loss = jstep(params, opt_state, batch)
             _ = float(loss)
             dt = (time.time() - t0) / n
+            # peak scales with the dp mesh size: the step's FLOPs spread
+            # across every local chip
+            mfu = model_flops_per_step(cfg, b, t) / dt / 1e12 / (
+                PEAK_TFLOPS * len(devs)
+            ) * 100
             log(
                 f"model_step[{attn_impl}]: {dt*1000:.1f} ms, "
-                f"tokens_per_s: {b*t/dt:,.0f} ({devs[0].platform})"
+                f"tokens_per_s: {b*t/dt:,.0f}, mfu_pct: {mfu:.1f} ({devs[0].platform})"
             )
-            return dt, b * t / dt
+            return dt, b * t / dt, mfu
 
-        dt_jnp, tok_jnp = run("jnp")
+        dt_jnp, tok_jnp, mfu_jnp = run("jnp")
         if flash_ok:  # a numerically wrong kernel must not set the headline
-            dt_flash, tok_flash = run("flash")
+            dt_flash, tok_flash, mfu_flash = run("flash")
         else:
-            dt_flash, tok_flash = dt_jnp, tok_jnp
-        dt, tokens = min((dt_jnp, tok_jnp), (dt_flash, tok_flash), key=lambda x: x[0])
-        log(f"model_step_s: {dt*1000:.1f} ms, tokens_per_s: {tokens:,.0f} ({devs[0].platform})")
+            dt_flash, tok_flash, mfu_flash = dt_jnp, tok_jnp, mfu_jnp
+        dt, tokens, mfu = min(
+            (dt_jnp, tok_jnp, mfu_jnp), (dt_flash, tok_flash, mfu_flash),
+            key=lambda x: x[0],
+        )
+        log(
+            f"model_step_s: {dt*1000:.1f} ms, tokens_per_s: {tokens:,.0f}, "
+            f"mfu_pct: {mfu:.1f} ({devs[0].platform})"
+        )
     except Exception as e:
         log(f"model bench skipped: {type(e).__name__}: {e}")
 

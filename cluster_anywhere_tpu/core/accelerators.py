@@ -208,6 +208,18 @@ def node_labels() -> Dict[str, str]:
     return out
 
 
+def detect_node_labels(node_id: Optional[str] = None) -> Dict[str, str]:
+    """The one label-derivation used by every node: auto-detected TPU
+    topology labels + CA_NODE_LABELS env overrides (+ ca.io/node-id when the
+    caller knows it).  Head-embedded node and agents must share this, or
+    NodeLabelSchedulingStrategy selectors behave differently per node kind."""
+    labels = dict(node_labels())
+    labels.update(parse_labels_env(os.environ.get("CA_NODE_LABELS")))
+    if node_id is not None:
+        labels["ca.io/node-id"] = node_id
+    return labels
+
+
 def parse_labels_env(env_val: Optional[str]) -> Dict[str, str]:
     """Parse a CA_NODE_LABELS-style JSON object into a str->str label map;
     malformed or non-object JSON yields {} (a bad env var must not kill a

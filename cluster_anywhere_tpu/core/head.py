@@ -44,20 +44,6 @@ LOCAL_NODE = "n0"
 # --------------------------------------------------------------------------
 
 
-def _detect_labels(node_id: str, explicit: Optional[Dict[str, str]] = None) -> Dict[str, str]:
-    """Labels a node carries into the table: auto-detected TPU topology
-    labels, CA_NODE_LABELS env overrides (JSON), and the node id itself
-    (ray.io/node-id analogue)."""
-    from . import accelerators
-
-    labels = dict(accelerators.node_labels())
-    labels.update(accelerators.parse_labels_env(os.environ.get("CA_NODE_LABELS")))
-    if explicit:
-        labels.update({str(k): str(v) for k, v in explicit.items()})
-    labels["ca.io/node-id"] = node_id
-    return labels
-
-
 @dataclass
 class NodeRec:
     node_id: str
@@ -198,10 +184,12 @@ class Head:
         # -- node table (gcs_node_manager.h analogue); the head embeds n0 --
         self.nodes: Dict[str, NodeRec] = {}
         self._node_index = 0
+        from .accelerators import detect_node_labels
+
         self._add_node(
             NodeRec(
                 LOCAL_NODE, None, dict(resources), dict(resources),
-                labels=_detect_labels(LOCAL_NODE),
+                labels=detect_node_labels(LOCAL_NODE),
             )
         )
         # chip allocator for TPU-worker pinning; active only on multi-chip

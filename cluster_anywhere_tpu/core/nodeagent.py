@@ -60,13 +60,11 @@ class NodeAgent:
         import json
 
         self.resources = json.loads(os.environ.get("CA_NODE_RESOURCES", '{"CPU": 4}'))
-        # labels travel with registration: auto-detected TPU topology plus
-        # CA_NODE_LABELS overrides, detected HERE (the agent's env, not the
-        # head's) — NodeLabelSchedulingStrategy matches against these
-        from .accelerators import node_labels, parse_labels_env
+        # labels travel with registration: detected HERE (the agent's env,
+        # not the head's); the head adds ca.io/node-id when recording
+        from .accelerators import detect_node_labels
 
-        self.labels = dict(node_labels())
-        self.labels.update(parse_labels_env(os.environ.get("CA_NODE_LABELS")))
+        self.labels = detect_node_labels()
         self.config = CAConfig.from_json(os.environ["CA_CONFIG_JSON"])
         set_config(self.config)
         self.serve_addr_spec = os.environ.get("CA_AGENT_SERVE", "tcp:127.0.0.1:0")

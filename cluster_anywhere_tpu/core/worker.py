@@ -129,9 +129,14 @@ class LeasePool:
         self.shape = shape
         self.pg = pg
         self.strategy = strategy
-        self.inflight_total = 0  # tasks currently pushed across all leases
+        self.inflight_total = 0  # pushed + backlogged + acquiring, all lanes
         self.leases: List[_Lease] = []
         self.waiters: deque = deque()
+        # fast lane for argless known-function tasks that found no pushable
+        # lease: plain (task_id, fn_id, opts, oids) records drained by
+        # release()/new-lease callbacks — no per-task coroutine, no Future
+        # (the 4k-noop flood otherwise spawns one asyncio.Task per task)
+        self.backlog: deque = deque()
         self.requests_outstanding = 0
         cfg = worker.config
         self.max_leases = cfg.max_leases_per_shape
@@ -188,6 +193,9 @@ class LeasePool:
         return live + self.requests_outstanding < min(self.max_leases, self.inflight_total)
 
     def _pipeline_ok(self) -> bool:
+        return self._pipeline_ok_for(self.inflight_total)
+
+    def _pipeline_ok_for(self, demand: int) -> bool:
         """Pushing onto a BUSY lease is right only when the leases we already
         have plus those on the way cannot cover demand (the tiny-task flood
         case).  While expected leases >= demand, waiting for one is right —
@@ -195,7 +203,7 @@ class LeasePool:
         rest of the cluster idles."""
         live = sum(1 for l in self.leases if not l.dead)
         expected = live + self.requests_outstanding
-        if expected >= self.inflight_total:
+        if expected >= demand:
             return False
         return (
             expected >= self.max_leases
@@ -234,6 +242,7 @@ class LeasePool:
             lease = _Lease(reply["lease_id"], reply["worker_id"], reply["addr"])
             self.leases.append(lease)
             self.requests_outstanding -= 1
+            self._drain_backlog()
             self._wake(self.max_inflight)
             return
 
@@ -249,6 +258,43 @@ class LeasePool:
             fut = self.waiters.popleft()
             if not fut.done():
                 fut.set_exception(exc)
+        while self.backlog:
+            task_id, fn_id, opts, oids = self.backlog.popleft()
+            self.inflight_total -= 1
+            self.worker._store_error(oids, exc)
+
+    def enqueue_fast(self, task_id, fn_id, opts, oids) -> None:
+        """Queue an argless known-function task for callback-drained push
+        (IO thread only).  Counts as demand so growth/pipelining see it."""
+        self.inflight_total += 1
+        self.backlog.append((task_id, fn_id, opts, oids))
+        if self._should_grow():
+            self.requests_outstanding += 1
+            spawn_bg(self._request_lease())
+
+    def _drain_backlog(self) -> None:
+        """Push backlogged tasks onto leases while the same admission rules
+        the submit path uses allow it (idle lease, or pipelining regime)."""
+        while self.backlog:
+            lease = self._pick()
+            if lease is None:
+                if self._should_grow():
+                    self.requests_outstanding += 1
+                    spawn_bg(self._request_lease())
+                return
+            if lease.inflight > 0 and not self._pipeline_ok():
+                if self._should_grow():
+                    self.requests_outstanding += 1
+                    spawn_bg(self._request_lease())
+                return
+            task_id, fn_id, opts, oids = self.backlog.popleft()
+            if not self.worker._push_fast(self, lease, task_id, fn_id, opts, oids):
+                # connection gone: this item takes the retrying slow path
+                self.inflight_total -= 1
+                t = spawn_bg(
+                    self.worker._submit_task(task_id, fn_id, None, (), {}, opts, oids)
+                )
+                t.add_done_callback(Worker._report_task_exc)
 
     def release(self, lease: _Lease, dead: bool = False):
         self.inflight_total -= 1
@@ -257,6 +303,7 @@ class LeasePool:
             lease.dead = True
         if lease.inflight == 0:
             lease.last_idle = time.monotonic()
+        self._drain_backlog()
         self._wake()
 
     def reap_idle(self, now: float, timeout: float) -> List[str]:
@@ -266,7 +313,12 @@ class LeasePool:
         for l in self.leases:
             if l.dead:
                 continue
-            if l.inflight == 0 and now - l.last_idle > timeout and not self.waiters:
+            if (
+                l.inflight == 0
+                and now - l.last_idle > timeout
+                and not self.waiters
+                and not self.backlog
+            ):
                 l.dead = True
                 out.append(l.lease_id)
             else:
@@ -1550,26 +1602,38 @@ class Worker:
     def _task_entry(self, task_id, fn_id, blob, args, kwargs, opts, oids):
         """Runs on the IO thread.  Fast path: an argless task of an
         already-exported function pushed onto an available lease entirely via
-        callbacks — no per-task coroutine/Task.  Anything needing awaiting
-        (arg resolution, function export, lease growth/waiting) returns the
-        slow coroutine instead."""
+        callbacks — no per-task coroutine/Task.  When every lease is
+        saturated, the task joins the pool's backlog (still no coroutine;
+        release callbacks drain it).  Anything needing awaiting (arg
+        resolution, function export) returns the slow coroutine instead."""
         if blob is not None or args or kwargs or opts.get("runtime_env"):
             return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
         pool = self._lease_pool(opts)
         lease = pool._pick()
-        if lease is None:
-            return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
         # count this task as demand BEFORE deciding (both predicates read
         # inflight_total); a busy lease is only used when pipelining is the
-        # right regime, else the slow path grows/waits
+        # right regime, else the task backlogs until growth/release
+        if (
+            lease is None
+            or (lease.inflight > 0 and not pool._pipeline_ok_for(pool.inflight_total + 1))
+        ):
+            pool.enqueue_fast(task_id, fn_id, opts, oids)
+            return None
         pool.inflight_total += 1
-        if lease.inflight > 0 and not pool._pipeline_ok():
+        if not self._push_fast(pool, lease, task_id, fn_id, opts, oids):
             pool.inflight_total -= 1
-            return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+            return self._submit_task(task_id, fn_id, None, args, kwargs, opts, oids)
+        return None
+
+    def _push_fast(self, pool, lease, task_id, fn_id, opts, oids) -> bool:
+        """Push one argless task onto `lease` purely via callbacks.  Returns
+        False (without touching counters) if the connection is unusable —
+        the caller decides the fallback.  On success the reply callback
+        releases the lease and stores results/errors, retrying worker death
+        within the task's budget."""
         conn = self._conns.get(lease.addr)
         if conn is None or conn.closed:
-            pool.inflight_total -= 1
-            return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
+            return False
         lease.inflight += 1
         addr = lease.addr
 
@@ -1582,7 +1646,7 @@ class Worker:
                 if retries > 0:
                     retry_opts = dict(opts, max_retries=retries - 1)
                     t = spawn_bg(
-                        self._submit_task(task_id, fn_id, None, args, kwargs, retry_opts, oids)
+                        self._submit_task(task_id, fn_id, None, (), {}, retry_opts, oids)
                     )
                     t.add_done_callback(self._report_task_exc)
                 else:
@@ -1608,9 +1672,10 @@ class Worker:
                 num_returns=opts.get("num_returns", 1),
             )
         except ConnectionError:
-            pool.release(lease, dead=True)
-            return self._submit_task(task_id, fn_id, None, args, kwargs, opts, oids)
-        return None
+            lease.inflight -= 1
+            lease.dead = True
+            return False
+        return True
 
     def _shape_of(self, opts) -> Dict[str, float]:
         shape = dict(opts.get("resources") or {})

@@ -436,8 +436,8 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     pad: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
 ):
@@ -447,20 +447,40 @@ def flash_attention(
     < pad[b] are masked out (the left-padded-prompt mask the LLM prefill
     needs; models/generate.py _prefill_block).
 
-    Requires T % block_q == 0 and T_kv % block_k == 0 (the dispatcher
-    `attention()` falls back to the jnp reference otherwise).  With
-    return_lse=True also returns the per-row log-sum-exp [B, H, T] — the
-    carry ring attention needs to merge per-block results (merge_attention).
+    block_q/block_k default to the largest power-of-two divisor of T / T_kv
+    capped at 256 / 512 — measured best for fwd+bwd on v5e at d_head=64
+    (vs 128/128: bigger K tiles amortize the half-empty 64-lane contraction
+    and cut grid-step overhead; Q tiles above 256 pay more bwd recompute
+    than they save).  Requires T % block_q == 0 and T_kv % block_k == 0 (the
+    dispatcher `attention()` falls back to the jnp reference otherwise).
+    With return_lse=True also returns the per-row log-sum-exp [B, H, T] —
+    the carry ring attention needs to merge per-block results
+    (merge_attention).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = _platform() == "cpu"
+    if block_q is None:
+        block_q = _auto_block(q.shape[1], 256)
+    if block_k is None:
+        block_k = _auto_block(k.shape[1], 512)
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
     if return_lse:
         return _flash_with_lse(q, k, v, pad, causal, scale, block_q, block_k, interpret)
     return _flash(q, k, v, pad, causal, scale, block_q, block_k, interpret)
+
+
+def _auto_block(t: int, cap: int) -> int:
+    """Largest power-of-two divisor of t, capped.  When t has no power-of-two
+    divisor >= 8, fall back to t itself (one full block — always valid:
+    a block equal to the array dim satisfies the TPU tiling rule, whereas
+    returning a non-divisor would leave grid-uncovered rows unwritten)."""
+    b = cap
+    while b > 8 and t % b != 0:
+        b //= 2
+    return b if t % b == 0 else t
 
 
 def merge_attention(o1, lse1, o2, lse2):
