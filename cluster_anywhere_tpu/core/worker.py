@@ -137,6 +137,7 @@ class LeasePool:
         # release()/new-lease callbacks — no per-task coroutine, no Future
         # (the 4k-noop flood otherwise spawns one asyncio.Task per task)
         self.backlog: deque = deque()
+        self._dialing: set = set()  # lease addrs with a connect in flight
         self.requests_outstanding = 0
         cfg = worker.config
         self.max_leases = cfg.max_leases_per_shape
@@ -168,10 +169,7 @@ class LeasePool:
                 if lease is not None and lease.inflight == 0:
                     lease.inflight += 1
                     return lease
-                if self._should_grow():
-                    self.requests_outstanding += 1
-                    spawn_bg(self._request_lease())
-                elif lease is not None and self._pipeline_ok():
+                if not self._maybe_grow() and lease is not None and self._pipeline_ok():
                     lease.inflight += 1
                     return lease
                 fut = asyncio.get_running_loop().create_future()
@@ -263,38 +261,63 @@ class LeasePool:
             self.inflight_total -= 1
             self.worker._store_error(oids, exc)
 
+    def _maybe_grow(self) -> bool:
+        """Issue one lease request when admission allows (the single place
+        growth bookkeeping lives)."""
+        if not self._should_grow():
+            return False
+        self.requests_outstanding += 1
+        spawn_bg(self._request_lease())
+        return True
+
     def enqueue_fast(self, task_id, fn_id, opts, oids) -> None:
         """Queue an argless known-function task for callback-drained push
         (IO thread only).  Counts as demand so growth/pipelining see it."""
         self.inflight_total += 1
         self.backlog.append((task_id, fn_id, opts, oids))
-        if self._should_grow():
-            self.requests_outstanding += 1
-            spawn_bg(self._request_lease())
+        self._maybe_grow()
 
     def _drain_backlog(self) -> None:
         """Push backlogged tasks onto leases while the same admission rules
-        the submit path uses allow it (idle lease, or pipelining regime)."""
+        the submit path uses allow it (idle lease, or pipelining regime).
+        A lease whose connection isn't established yet pauses the drain
+        behind ONE dial coroutine (never a per-task coroutine); a lease
+        whose connection broke is marked dead and the item retries on the
+        next pick."""
         while self.backlog:
             lease = self._pick()
-            if lease is None:
-                if self._should_grow():
-                    self.requests_outstanding += 1
-                    spawn_bg(self._request_lease())
+            if lease is None or (lease.inflight > 0 and not self._pipeline_ok()):
+                self._maybe_grow()
                 return
-            if lease.inflight > 0 and not self._pipeline_ok():
-                if self._should_grow():
-                    self.requests_outstanding += 1
-                    spawn_bg(self._request_lease())
+            conn = self.worker._conns.get(lease.addr)
+            if conn is None or conn.closed:
+                self._dial_then_drain(lease)
                 return
-            task_id, fn_id, opts, oids = self.backlog.popleft()
-            if not self.worker._push_fast(self, lease, task_id, fn_id, opts, oids):
-                # connection gone: this item takes the retrying slow path
-                self.inflight_total -= 1
-                t = spawn_bg(
-                    self.worker._submit_task(task_id, fn_id, None, (), {}, opts, oids)
-                )
-                t.add_done_callback(Worker._report_task_exc)
+            item = self.backlog.popleft()
+            if not self.worker._push_fast(self, lease, *item):
+                # call_cb raised: _push_fast marked the lease dead; retry the
+                # item on whatever _pick finds next round
+                self.backlog.appendleft(item)
+
+    def _dial_then_drain(self, lease: _Lease) -> None:
+        """The granted lease's worker was never contacted (cold client):
+        connect once in the background, then resume draining.  Without this,
+        every backlogged item would divert to its own slow-path coroutine —
+        exactly the flood the backlog lane exists to avoid."""
+        if lease.addr in self._dialing:
+            return
+        self._dialing.add(lease.addr)
+
+        async def _dial():
+            try:
+                await self.worker.conn_to(lease.addr)
+            except Exception:
+                lease.dead = True
+            finally:
+                self._dialing.discard(lease.addr)
+                self._drain_backlog()
+
+        spawn_bg(_dial())
 
     def release(self, lease: _Lease, dead: bool = False):
         self.inflight_total -= 1

@@ -9,11 +9,17 @@ AlgorithmConfig, EnvRunner actors, jax Learners; PPO + DQN + IMPALA).
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
-from .buffer import ReplayBuffer
-from .env import CartPole, Env, Pendulum, VectorEnv, make_env, register_env
+from .buffer import PrioritizedReplayBuffer, ReplayBuffer
+from .env import CartPole, Env, MemoryChain, Pendulum, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
-from .learner import DQNLearner, IMPALALearner, PPOLearner, compute_gae
-from .module import DiscretePolicyModule, QModule
+from .learner import (
+    DQNLearner,
+    IMPALALearner,
+    PPOLearner,
+    RecurrentPPOLearner,
+    compute_gae,
+)
+from .module import DiscretePolicyModule, QModule, RecurrentPolicyModule
 from .offline import (
     BCLearner,
     CQLLearner,
@@ -54,8 +60,12 @@ __all__ = [
     "PPOLearner",
     "DQNLearner",
     "IMPALALearner",
+    "RecurrentPPOLearner",
     "compute_gae",
     "ReplayBuffer",
+    "PrioritizedReplayBuffer",
     "DiscretePolicyModule",
     "QModule",
+    "RecurrentPolicyModule",
+    "MemoryChain",
 ]

@@ -43,6 +43,14 @@ class AlgorithmConfig:
         self.epsilon_decay = 0.99
         self.min_epsilon = 0.05
         self.updates_per_iteration = 32
+        # prioritized replay (DQN): proportional PER with IS correction
+        self.replay = "uniform"  # uniform | prioritized
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
+        self.per_beta_anneal_steps = 100_000
+        # recurrent policy (PPO): GRU core instead of the plain MLP
+        self.use_lstm = False
+        self.lstm_hidden = 64
         # sac
         self.tau = 0.005
         self.target_entropy = None  # default: -action_dim
@@ -74,6 +82,8 @@ class Algorithm:
         obs_dim, num_actions = probe.observation_dim, probe.num_actions
         if config.algo == "SAC":
             kind = "gaussian"
+        elif config.algo == "PPO" and config.use_lstm:
+            kind = "recurrent"
         elif config.algo in ("PPO", "IMPALA", "APPO"):
             kind = "policy"
         else:
@@ -83,11 +93,27 @@ class Algorithm:
             "obs_dim": obs_dim,
             "num_actions": num_actions,
             "hidden": config.hidden,
+            "lstm_hidden": config.lstm_hidden,
         }
         if kind == "gaussian":
             module_spec["action_dim"] = probe.action_dim
             module_spec["action_scale"] = getattr(probe, "action_scale", 1.0)
-        if config.algo == "PPO":
+        if kind == "recurrent":
+            from .learner import RecurrentPPOLearner
+            from .module import RecurrentPolicyModule
+
+            self.module = RecurrentPolicyModule(
+                obs_dim, num_actions, config.lstm_hidden
+            )
+            self.learner = RecurrentPPOLearner(
+                self.module,
+                lr=config.lr,
+                clip=config.clip,
+                entropy_coeff=config.entropy_coeff,
+                epochs=config.epochs,
+                seed=config.seed,
+            )
+        elif config.algo == "PPO":
             self.module = DiscretePolicyModule(obs_dim, num_actions, config.hidden)
             self.learner = PPOLearner(
                 self.module,
@@ -110,7 +136,7 @@ class Algorithm:
             )
             self._pending: Dict[Any, int] = {}  # in-flight sample ref -> runner idx
         elif config.algo == "DQN":
-            from .buffer import ReplayBuffer
+            from .buffer import PrioritizedReplayBuffer, ReplayBuffer
 
             self.module = QModule(obs_dim, num_actions, config.hidden)
             self.learner = DQNLearner(
@@ -120,7 +146,14 @@ class Algorithm:
                 target_update_freq=config.target_update_freq,
                 seed=config.seed,
             )
-            self.buffer = ReplayBuffer(config.buffer_capacity, obs_dim, config.seed)
+            if config.replay == "prioritized":
+                self.buffer = PrioritizedReplayBuffer(
+                    config.buffer_capacity, obs_dim, config.seed,
+                    alpha=config.per_alpha, beta=config.per_beta,
+                    beta_anneal_steps=config.per_beta_anneal_steps,
+                )
+            else:
+                self.buffer = ReplayBuffer(config.buffer_capacity, obs_dim, config.seed)
             self.epsilon = 1.0
         elif config.algo == "SAC":
             from .buffer import ReplayBuffer
@@ -237,7 +270,32 @@ class Algorithm:
             episodes += m.get("episodes", 0)
             if "episode_return_mean" in m:
                 ep_returns.append(m["episode_return_mean"])
-        if cfg.algo == "PPO":
+        if cfg.algo == "PPO" and cfg.use_lstm:
+            # sequence-shaped batch: runners concatenate along the env axis,
+            # each sequence unrolled from its recorded initial hidden state
+            batches = []
+            for ro in rollouts:
+                a, r = compute_gae(ro, cfg.gamma, cfg.lam)
+                T, N = ro["rewards"].shape
+                batches.append(
+                    {
+                        "obs": ro["obs"],
+                        "actions": ro["actions"],
+                        "logp_old": ro["logp"],
+                        "dones": ro["dones"].astype(np.float32),
+                        "advantages": a.reshape(T, N),
+                        "returns": r.reshape(T, N),
+                        "state0": ro["state0"],
+                    }
+                )
+            batch = {
+                k: np.concatenate(
+                    [b[k] for b in batches], axis=0 if k == "state0" else 1
+                )
+                for k in batches[0]
+            }
+            stats = self.learner.update(batch)
+        elif cfg.algo == "PPO":
             advs, rets, batches = [], [], []
             for ro in rollouts:
                 a, r = compute_gae(ro, cfg.gamma, cfg.lam)
@@ -274,6 +332,10 @@ class Algorithm:
             if len(self.buffer) >= cfg.train_batch_size:
                 for _ in range(cfg.updates_per_iteration):
                     stats = self.learner.update(self.buffer.sample(cfg.train_batch_size))
+                    td_abs = stats.pop("td_abs", None)
+                    indices = stats.pop("indices", None)
+                    if td_abs is not None and hasattr(self.buffer, "update_priorities"):
+                        self.buffer.update_priorities(indices, td_abs)
             if cfg.algo == "DQN":
                 self.epsilon = max(cfg.min_epsilon, self.epsilon * cfg.epsilon_decay)
         self._broadcast()

@@ -123,9 +123,50 @@ class Pendulum(Env):
         return self._obs(), -float(cost), self.steps >= self.max_steps, {}
 
 
+class MemoryChain(Env):
+    """Memory-requiring diagnostic env (the T-maze family rllib uses to
+    exercise use_lstm): a binary cue is visible ONLY on the first step; after
+    `corridor` blank steps the agent must emit the cue as its action.
+    Reward +1 for recalling correctly at the final step, -1 otherwise, 0 in
+    the corridor.  A memoryless policy cannot beat 0 expected return; a
+    recurrent one reaches ~+1."""
+
+    observation_dim = 3  # [cue_is_0, cue_is_1, at_query_step]
+    num_actions = 2
+
+    def __init__(self, corridor: int = 4):
+        self.corridor = corridor
+        self.rng = np.random.default_rng()
+        self.cue = 0
+        self.t = 0
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(3, np.float32)
+        if self.t == 0:
+            o[self.cue] = 1.0
+        if self.t == self.corridor:
+            o[2] = 1.0
+        return o
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.cue = int(self.rng.integers(0, 2))
+        self.t = 0
+        return self._obs()
+
+    def step(self, action: int):
+        if self.t >= self.corridor:
+            r = 1.0 if int(action) == self.cue else -1.0
+            return self._obs(), r, True, {}
+        self.t += 1
+        return self._obs(), 0.0, False, {}
+
+
 _ENV_REGISTRY: Dict[str, Callable[[], Env]] = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
+    "MemoryChain-v0": MemoryChain,
 }
 
 

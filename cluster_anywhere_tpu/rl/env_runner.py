@@ -26,7 +26,15 @@ class EnvRunner:
         self.env_spec = env_spec
         self.vec = VectorEnv(env_spec, num_envs, seed)
         kind = module_spec.get("kind", "policy")
-        if kind == "policy":
+        if kind == "recurrent":
+            from .module import RecurrentPolicyModule
+
+            self.module = RecurrentPolicyModule(
+                module_spec["obs_dim"], module_spec["num_actions"],
+                module_spec.get("lstm_hidden", 64),
+            )
+            self.state = self.module.initial_state(num_envs)
+        elif kind == "policy":
             self.module = DiscretePolicyModule(
                 module_spec["obs_dim"], module_spec["num_actions"],
                 module_spec.get("hidden", (64, 64)),
@@ -55,6 +63,8 @@ class EnvRunner:
             self._jit_mean = jax.jit(self.module.mean_action)
             self._jit_logits = None
             self._jit_value = None
+        elif kind == "recurrent":
+            self._jit_step = jax.jit(self.module.step)
         else:
             self._jit_logits = jax.jit(
                 self.module.logits if kind == "policy" else self.module.q_values
@@ -72,6 +82,8 @@ class EnvRunner:
         bootstrap values and episode metrics."""
         import jax.numpy as jnp
 
+        if self.kind == "recurrent":
+            return self._sample_recurrent(num_steps)
         obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
         for _ in range(num_steps):
             obs = self.vec.obs
@@ -132,8 +144,50 @@ class EnvRunner:
             "metrics": self.vec.drain_metrics(),
         }
 
+    def _sample_recurrent(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Recurrent rollout: hidden state carried across sample() calls and
+        zeroed per env at episode ends; the state at rollout start ships
+        with the batch so the learner unrolls from the same point."""
+        import jax.numpy as jnp
+
+        from .module import softmax_sample
+
+        state0 = self.state.copy()
+        obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
+        for _ in range(num_steps):
+            obs = self.vec.obs
+            logits, values, new_state = self._jit_step(
+                self.params, jnp.asarray(obs), jnp.asarray(self.state)
+            )
+            actions, logp = softmax_sample(self.rng, np.asarray(logits))
+            next_obs, rewards, dones = self.vec.step(actions)
+            self.state = np.array(new_state)  # copy: jax buffers are read-only
+            self.state[dones.astype(bool)] = 0.0
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            done_l.append(dones)
+            logp_l.append(logp)
+            val_l.append(np.asarray(values))
+        _, last_values, _ = self._jit_step(
+            self.params, jnp.asarray(self.vec.obs), jnp.asarray(self.state)
+        )
+        return {
+            "obs": np.stack(obs_l),
+            "actions": np.stack(act_l),
+            "rewards": np.stack(rew_l),
+            "dones": np.stack(done_l),
+            "logp": np.stack(logp_l),
+            "values": np.stack(val_l),
+            "last_values": np.asarray(last_values),
+            "next_obs": self.vec.obs.copy(),
+            "state0": state0,
+            "metrics": self.vec.drain_metrics(),
+        }
+
     def evaluate(self, num_episodes: int = 5) -> float:
-        """Greedy episode returns on a fresh env."""
+        """Greedy episode returns on a fresh env (recurrent policies thread
+        their hidden state through the episode)."""
         import jax.numpy as jnp
 
         from .env import make_env
@@ -143,10 +197,17 @@ class EnvRunner:
         for ep in range(num_episodes):
             obs = env.reset(seed=1000 + ep)
             done, ret = False, 0.0
+            if self.kind == "recurrent":
+                state = self.module.initial_state(1)
             while not done:
                 if self.kind == "gaussian":
                     a = np.asarray(self._jit_mean(self.params, jnp.asarray(obs[None])))[0]
                     obs, r, done, _ = env.step(a)
+                elif self.kind == "recurrent":
+                    logits, _, state = self._jit_step(
+                        self.params, jnp.asarray(obs[None]), jnp.asarray(state)
+                    )
+                    obs, r, done, _ = env.step(int(np.asarray(logits)[0].argmax()))
                 else:
                     out = np.asarray(
                         self._jit_logits(self.params, jnp.asarray(obs[None]))

@@ -91,6 +91,91 @@ class PPOLearner:
         return stats
 
 
+class RecurrentPPOLearner:
+    """PPO over sequences with a recurrent (GRU) module (reference: rllib
+    use_lstm=True through rllib/core/rl_module/ + PPO).  The loss unrolls
+    the whole [T, N] rollout from each sequence's stored initial state,
+    resetting hidden state at episode boundaries — no shuffled flat
+    minibatches (that would sever the temporal chain); epochs re-unroll the
+    same sequences, which is valid because logp_old/state0 were recorded at
+    sample time."""
+
+    def __init__(
+        self,
+        module,
+        *,
+        lr: float = 3e-4,
+        clip: float = 0.2,
+        vf_coeff: float = 0.5,
+        entropy_coeff: float = 0.01,
+        epochs: int = 4,
+        seed: int = 0,
+    ):
+        import optax
+
+        self.module = module
+        self.clip = clip
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.epochs = epochs
+        self.opt = optax.adam(lr)
+        self.params = module.init(jax.random.key(seed))
+        self.opt_state = self.opt.init(self.params)
+
+        def loss_fn(params, batch):
+            # dones shifted: done at t resets the state entering t+1; the
+            # state entering t=0 is state0 (recorded by the runner)
+            prev_dones = jnp.concatenate(
+                [jnp.zeros_like(batch["dones"][:1]), batch["dones"][:-1]], axis=0
+            )
+            logits, values, _ = module.unroll(
+                params, batch["obs"], batch["state0"], prev_dones
+            )
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1
+            )[..., 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["advantages"]
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - self.clip, 1 + self.clip) * adv
+            pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss, "entropy": entropy}
+
+        def update_step(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update_step)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+        return "ok"
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """batch: sequence-shaped obs [T,N,D], actions/logp_old/advantages/
+        returns/dones [T,N], state0 [N,H]."""
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        for _ in range(self.epochs):
+            self.params, self.opt_state, loss, aux = self._update(
+                self.params, self.opt_state, jb
+            )
+        stats = {"loss": float(loss)}
+        for k, v in aux.items():
+            stats[k] = float(v)
+        return stats
+
+
 class DQNLearner:
     """Double-DQN update with a periodically synced target net
     (reference rllib/algorithms/dqn/)."""
@@ -115,7 +200,7 @@ class DQNLearner:
         self.opt_state = self.opt.init(self.params)
         self.updates_done = 0
 
-        def loss_fn(params, target_params, batch):
+        def td_errors(params, target_params, batch):
             q = module.q_values(params, batch["obs"])
             q_taken = jnp.take_along_axis(q, batch["actions"][:, None], -1)[:, 0]
             # double dqn: online net picks the argmax, target net evaluates it
@@ -124,15 +209,21 @@ class DQNLearner:
             q_next_target = module.q_values(target_params, batch["next_obs"])
             q_next = jnp.take_along_axis(q_next_target, best[:, None], -1)[:, 0]
             target = batch["rewards"] + self.gamma * (1.0 - batch["dones"]) * q_next
-            return jnp.mean((q_taken - jax.lax.stop_gradient(target)) ** 2)
+            return q_taken - jax.lax.stop_gradient(target)
 
-        def update_step(params, target_params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, target_params, batch)
+        def loss_fn(params, target_params, batch, weights):
+            td = td_errors(params, target_params, batch)
+            return jnp.mean(weights * td**2), jnp.abs(td)
+
+        def update_step(params, target_params, opt_state, batch, weights):
+            (loss, td_abs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch, weights
+            )
             updates, opt_state = self.opt.update(grads, opt_state, params)
             import optax as _optax
 
             params = _optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            return params, opt_state, loss, td_abs
 
         self._update = jax.jit(update_step)
 
@@ -140,14 +231,28 @@ class DQNLearner:
         return self.params
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        jb = {k: jnp.asarray(v) for k, v in batch.items()}
-        self.params, self.opt_state, loss = self._update(
-            self.params, self.target_params, self.opt_state, jb
+        """PER batches carry "weights" (importance correction applied to the
+        per-sample squared TD) and "indices"; td_abs_* come back so the
+        caller can feed buffer.update_priorities."""
+        jb = {
+            k: jnp.asarray(v)
+            for k, v in batch.items()
+            if k not in ("weights", "indices")
+        }
+        w = jnp.asarray(
+            batch.get("weights", np.ones(len(batch["rewards"]), np.float32))
+        )
+        self.params, self.opt_state, loss, td_abs = self._update(
+            self.params, self.target_params, self.opt_state, jb, w
         )
         self.updates_done += 1
         if self.updates_done % self.target_update_freq == 0:
             self.target_params = jax.tree_util.tree_map(lambda x: x, self.params)
-        return {"loss": float(loss)}
+        out = {"loss": float(loss)}
+        if "indices" in batch:
+            out["td_abs"] = np.asarray(td_abs)
+            out["indices"] = batch["indices"]
+        return out
 
 
 class IMPALALearner:

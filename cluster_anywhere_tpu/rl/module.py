@@ -55,6 +55,98 @@ class DiscretePolicyModule:
         return mlp_forward(params["vf"], obs)[..., 0]
 
 
+class RecurrentPolicyModule:
+    """GRU-core actor-critic (analogue of rllib/core/rl_module/ recurrent
+    modules, use_lstm=True model config): obs -> encoder MLP -> GRU ->
+    policy/value heads.  The hidden state is the API surface — runners carry
+    it across steps and learners unroll sequences with the rollout's initial
+    state, resetting where episodes ended (lax.scan; no python loops under
+    jit)."""
+
+    def __init__(
+        self,
+        obs_dim: int,
+        num_actions: int,
+        hidden: int = 64,
+        encoder: Sequence[int] = (64,),
+    ):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = int(hidden)
+        self.encoder = tuple(encoder)
+
+    def init(self, key) -> Dict[str, Any]:
+        ke, kg, kp, kv = jax.random.split(key, 4)
+        enc_out = self.encoder[-1] if self.encoder else self.obs_dim
+        h = self.hidden
+        s = (2.0 / (enc_out + h)) ** 0.5
+        kz, kr, kn = jax.random.split(kg, 3)
+        gru = {
+            # update / reset / candidate gates, each over [x, h]
+            name: {
+                "w": jax.random.normal(k, (enc_out + h, h)) * s,
+                "b": jnp.zeros((h,)),
+            }
+            for name, k in (("z", kz), ("r", kr), ("n", kn))
+        }
+        return {
+            "enc": init_mlp(ke, (self.obs_dim, *self.encoder)) if self.encoder else [],
+            "gru": gru,
+            "pi": init_mlp(kp, (h, self.num_actions)),
+            "vf": init_mlp(kv, (h, 1)),
+        }
+
+    def initial_state(self, batch_size: int):
+        import numpy as np
+
+        return np.zeros((batch_size, self.hidden), np.float32)
+
+    @staticmethod
+    def _encode(params, obs):
+        x = obs
+        if params["enc"]:
+            x = mlp_forward(params["enc"], x)
+            x = jnp.tanh(x)  # mlp_forward leaves the last layer linear
+        return x
+
+    @staticmethod
+    def _gru_cell(params, x, state):
+        xh = jnp.concatenate([x, state], axis=-1)
+        g = params["gru"]
+        z = jax.nn.sigmoid(xh @ g["z"]["w"] + g["z"]["b"])
+        r = jax.nn.sigmoid(xh @ g["r"]["w"] + g["r"]["b"])
+        xr = jnp.concatenate([x, r * state], axis=-1)
+        n = jnp.tanh(xr @ g["n"]["w"] + g["n"]["b"])
+        return (1.0 - z) * n + z * state
+
+    def step(self, params, obs, state):
+        """One timestep: obs [B, D], state [B, H] ->
+        (logits [B, A], value [B], new_state [B, H])."""
+        x = self._encode(params, obs)
+        h = self._gru_cell(params, x, state)
+        return (
+            mlp_forward(params["pi"], h),
+            mlp_forward(params["vf"], h)[..., 0],
+            h,
+        )
+
+    def unroll(self, params, obs_seq, state0, resets):
+        """Sequence forward: obs_seq [T, B, D], state0 [B, H], resets [T, B]
+        — resets[t] = 1 zeroes the state BEFORE consuming obs[t] (callers
+        pass the dones shifted by one step, so an episode ending at t-1
+        starts t fresh, matching the vector env's auto-reset).  Returns
+        (logits [T, B, A], values [T, B], final state)."""
+
+        def body(state, xs):
+            obs_t, reset_t = xs
+            state = jnp.where(reset_t[:, None] > 0, 0.0, state)
+            logits, value, h = self.step(params, obs_t, state)
+            return h, (logits, value)
+
+        final, (logits, values) = jax.lax.scan(body, state0, (obs_seq, resets))
+        return logits, values, final
+
+
 class QModule:
     """Q-network (+ the same arch reused for the DQN target net)."""
 

@@ -1,0 +1,86 @@
+"""The task-submit backlog fast lane (LeasePool.backlog): argless tasks
+beyond every lease's pipeline depth queue as plain records drained by reply
+callbacks — no per-task coroutine.  These tests pin the three behaviors the
+suite only exercised indirectly before: floods drain with balanced
+counters, worker death mid-flood retries within budget, and a cold client's
+first flood rides one dial, not a coroutine per task.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.core.worker import global_worker
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    ca.init(num_cpus=2)
+    yield
+    ca.shutdown()
+
+
+@ca.remote
+def noop():
+    return None
+
+
+def _pools_drained(w) -> bool:
+    return all(
+        p.inflight_total == 0 and not p.backlog for p in w._lease_pools.values()
+    )
+
+
+def test_flood_drains_with_balanced_counters():
+    """A flood far beyond leases x max_inflight must route through the
+    backlog and leave every counter at zero afterwards (a leak here means a
+    slow client death under sustained load)."""
+    ca.get([noop.remote() for _ in range(50)], timeout=60)  # warm leases
+    refs = [noop.remote() for _ in range(3000)]
+    assert ca.get(refs, timeout=120) == [None] * 3000
+    w = global_worker()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if _pools_drained(w):
+            break
+        time.sleep(0.05)
+    for key, p in w._lease_pools.items():
+        assert p.inflight_total == 0, (key, p.inflight_total)
+        assert not p.backlog, (key, len(p.backlog))
+        assert not p.waiters, key
+
+
+def test_worker_death_mid_flood_retries():
+    """SIGKILL one pool worker while a flood is in flight: tasks pushed onto
+    the dead lease must re-run within their retry budget; nothing hangs."""
+    ca.get([noop.remote() for _ in range(50)], timeout=60)
+    w = global_worker()
+
+    @ca.remote
+    def slow():
+        time.sleep(0.01)
+        return os.getpid()
+
+    refs = [slow.remote() for _ in range(600)]
+    time.sleep(0.2)  # let pushes land on both workers
+    workers = w.head_call("list_workers")["workers"]
+    victims = [x for x in workers if x["state"] in ("leased", "idle") and x["pid"]]
+    assert victims
+    os.kill(victims[0]["pid"], signal.SIGKILL)
+    got = ca.get(refs, timeout=120)
+    assert len(got) == 600 and all(isinstance(p, int) for p in got)
+
+
+def test_flood_completes_after_fresh_init():
+    """Cold-start flood: the very first submissions race lease grants on
+    never-contacted workers — the backlog must pause behind the dial, not
+    divert to per-task coroutines (regression: _dial_then_drain)."""
+    # fresh pool shape (distinct resources) => no warm leases, no conns
+    f = noop.options(num_cpus=2)
+    refs = [f.remote() for _ in range(500)]
+    assert ca.get(refs, timeout=120) == [None] * 500

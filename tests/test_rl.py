@@ -386,3 +386,130 @@ def test_offline_cql_beats_random(tmp_path):
             ret += r
         total += ret
     assert total / 3 > 80.0, total / 3
+
+
+def test_prioritized_buffer_mechanics():
+    """Sum-tree sampling is proportional to priority^alpha; IS weights
+    correct the induced bias; update_priorities redirects sampling mass
+    (rllib prioritized_episode_buffer semantics, transition-level)."""
+    buf = rl.PrioritizedReplayBuffer(
+        capacity=128, obs_dim=2, seed=0, alpha=1.0, beta=1.0
+    )
+    n = 100
+    obs = np.arange(2 * n, dtype=np.float32).reshape(n, 2)
+    buf.add_batch(obs, np.zeros(n, np.int32), np.zeros(n, np.float32),
+                  np.zeros(n, np.float32), obs)
+    assert len(buf) == n
+    # all priorities equal -> near-uniform sampling, weights all 1
+    s = buf.sample(64)
+    assert s["weights"].max() == 1.0 and s["weights"].min() > 0.99
+    # spike one index's priority: it must dominate samples
+    buf.update_priorities(np.arange(n), np.full(n, 0.01))
+    buf.update_priorities(np.array([7]), np.array([100.0]))
+    counts = np.zeros(n)
+    for _ in range(20):
+        s = buf.sample(64)
+        for i in s["indices"]:
+            counts[i] += 1
+    assert counts[7] > counts.sum() * 0.8, counts[7] / counts.sum()
+    # and its IS weight is the smallest (most-oversampled => most down-weighted)
+    s = buf.sample(64)
+    w_spiked = s["weights"][s["indices"] == 7]
+    assert len(w_spiked) and w_spiked.min() <= s["weights"].min() + 1e-9
+
+
+def test_dqn_per_prioritizes_surprising_transitions():
+    """DQN + PER end to end: the learner's td_abs feeds back into the
+    buffer, and sampling concentrates on high-TD transitions.  Seeds pinned;
+    asserts the mechanism (priorities diverge from uniform), plus learning
+    still happens on CartPole with PER on."""
+    algo = (
+        rl.AlgorithmConfig("DQN")
+        .environment("CartPole-v1")
+        .env_runners(2, num_envs_per_runner=4)
+        .training(
+            lr=1e-3,
+            rollout_length=64,
+            epsilon_decay=0.9,
+            updates_per_iteration=64,
+            replay="prioritized",
+            seed=0,
+        )
+        .build()
+    )
+    try:
+        rets = []
+        for _ in range(15):
+            result = algo.train()
+            if "episode_return_mean" in result:
+                rets.append(result["episode_return_mean"])
+        assert max(rets[-3:]) > np.mean(rets[:3]) * 1.5, rets
+        # the tree must have differentiated: spread between the most and
+        # least surprising stored transition
+        leaves = algo.buffer.tree.tree[algo.buffer.tree.n_leaves:][: len(algo.buffer)]
+        assert leaves.max() > leaves[leaves > 0].min() * 10, (
+            leaves.max(), leaves.min())
+    finally:
+        algo.stop()
+
+
+def test_memory_chain_env():
+    env = rl.MemoryChain(corridor=3)
+    obs = env.reset(seed=0)
+    cue = int(obs[:2].argmax())
+    assert obs[2] == 0.0
+    for _ in range(3):
+        obs, r, done, _ = env.step(0)
+        assert r == 0.0 and not done
+        assert obs[:2].sum() == 0.0  # cue hidden in the corridor
+    assert obs[2] == 1.0  # query flag
+    _, r, done, _ = env.step(cue)
+    assert done and r == 1.0
+
+
+def test_recurrent_module_unroll_matches_steps():
+    """unroll() over T steps == stepping the cell T times by hand, including
+    the done-boundary state reset."""
+    import jax
+
+    m = rl.RecurrentPolicyModule(3, 2, hidden=8)
+    params = m.init(jax.random.key(0))
+    T, B = 5, 2
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(T, B, 3)).astype(np.float32)
+    dones = np.zeros((T, B), np.float32)
+    dones[2, 0] = 1.0  # env 0 resets after step 2
+    prev_dones = np.concatenate([np.zeros((1, B), np.float32), dones[:-1]])
+    state0 = m.initial_state(B)
+    logits_u, values_u, _ = m.unroll(params, obs, state0, prev_dones)
+    state = state0
+    for t in range(T):
+        state = np.where(prev_dones[t][:, None] > 0, 0.0, state)
+        lg, vl, state = m.step(params, obs[t], state)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(logits_u)[t], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(vl), np.asarray(values_u)[t], rtol=1e-5)
+
+
+def test_recurrent_ppo_learns_memory_env():
+    """A GRU policy must solve MemoryChain (recall the first-step cue after
+    a blank corridor) — structurally impossible for the memoryless MLP,
+    whose expected return is 0.  rllib counterpart: use_lstm=True on a
+    stateless-obs env."""
+    algo = (
+        rl.AlgorithmConfig("PPO")
+        .environment("MemoryChain-v0")
+        .env_runners(2, num_envs_per_runner=8)
+        .training(
+            lr=3e-3, rollout_length=64, epochs=6, use_lstm=True,
+            lstm_hidden=32, entropy_coeff=0.003, seed=1,
+        )
+        .build()
+    )
+    try:
+        for _ in range(15):
+            algo.train()
+        final = algo.evaluate(10)
+        # greedy recall accuracy: +1 right, -1 wrong; demand near-perfect
+        assert final >= 0.8, final
+    finally:
+        algo.stop()
