@@ -261,6 +261,12 @@ def cmd_microbenchmark(args):
     """Single-node microbenchmarks (reference _private/ray_perf.py main):
     the canonical table — tasks/actors sync+async, put/get call rates, put
     bandwidth, placement-group churn — for comparison with BASELINE.md."""
+    if getattr(args, "saturation", False):
+        from .microbenchmark import head_saturation
+
+        head_saturation(quick=getattr(args, "quick", False))
+        return
+
     import cluster_anywhere_tpu as ca
 
     from .microbenchmark import run_microbenchmarks
@@ -356,6 +362,10 @@ def main(argv=None):
 
     sp = sub.add_parser("microbenchmark", help="single-node perf microbenchmarks")
     sp.add_argument("--quick", action="store_true", help="scaled-down run")
+    sp.add_argument(
+        "--saturation", action="store_true",
+        help="head-saturation sweep: control-plane ops/s vs clients and nodes",
+    )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)
 

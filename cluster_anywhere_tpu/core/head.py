@@ -748,6 +748,16 @@ class Head:
                 alive = scheduling.rank_spread(alive)
             else:
                 alive = scheduling.rank_hybrid(alive, threshold)
+        if kind == "SPREAD":
+            # spread semantics: hold the request for the policy-chosen node
+            # even when its worker pool is still spawning — skipping to
+            # whichever node already has an idle worker would pack the flood
+            # onto the few warm nodes (the opposite of SPREAD)
+            for node in alive:
+                if not scheduling.fits(node.avail, req.shape):
+                    continue
+                return self._grant_on_node(node, req)
+            return False
         for node in alive:
             if not scheduling.fits(node.avail, req.shape):
                 continue

@@ -315,12 +315,23 @@ class ShmObjectStore:
         # oldest): name -> (alloc_offset, alloc_size, oid_bytes)
         self._live_slices: Dict[str, Tuple[int, int, bytes]] = {}
         self._slice_seq = 0
+        self._live_bytes = 0  # sum of live-slice allocations (watermark input)
         self.budget_bytes = budget_bytes  # 0 = uncapped
         self.spill_cb = None  # set by the Worker; fn(bytes_needed) -> None
+        # proactive spill (local_object_manager.h IO-worker analogue): when
+        # live bytes cross the high watermark, kick the owner's background
+        # spiller (non-blocking) so the hard inline path above stays a last
+        # resort and puts don't eat spill latency
+        self.spill_kick_cb = None  # fn() -> None, must not block
+        self.spill_high_frac = 0.8
 
     def arena_bytes(self) -> int:
         with self._lock:
             return sum(a.size for a in self._arenas.values())
+
+    def live_bytes(self) -> int:
+        with self._lock:
+            return self._live_bytes
 
     def live_slices_oldest_first(self) -> List[Tuple[str, int, bytes]]:
         """Spill-candidate view: (shm_name, payload_size, oid) oldest first.
@@ -451,10 +462,16 @@ class ShmObjectStore:
             seq = self._slice_seq
         arena.mm[off : off + _SLICE_HDR] = seq.to_bytes(_SLICE_HDR, "little")
         name = f"{arena.name}@{off}+{payload_size}#{seq}"
+        alloc = _align_up(payload_size + _SLICE_HDR)
         with self._lock:
-            self._live_slices[name] = (
-                off, _align_up(payload_size + _SLICE_HDR), oid.binary() if primary else b"",
-            )
+            self._live_slices[name] = (off, alloc, oid.binary() if primary else b"")
+            self._live_bytes += alloc
+        if (
+            self.budget_bytes
+            and self.spill_kick_cb is not None
+            and self._live_bytes > self.budget_bytes * self.spill_high_frac
+        ):
+            self.spill_kick_cb()
         return name, memoryview(arena.mm)[off + _SLICE_HDR : off + _SLICE_HDR + payload_size]
 
     def _pack_into(self, mv, data: bytes, raws: List[Any]):
@@ -545,6 +562,8 @@ class ShmObjectStore:
             return
         with self._lock:
             entry = self._live_slices.pop(shm_name, None)
+            if entry is not None:
+                self._live_bytes -= entry[1]
         if entry is None:
             return  # unknown or already freed
         arena = self._arenas.get(arena_name)

@@ -292,6 +292,63 @@ async def connect_addr(addr: str) -> Connection:
     return Connection(reader, writer)
 
 
+class BlockingClient:
+    """Minimal synchronous client over the same frame protocol — for probe
+    tools (head-saturation microbenchmark) that want N independent OS
+    threads hammering the head without N event loops.  Sequential
+    request/response only; interleaved push frames are skipped."""
+
+    def __init__(self, addr: str):
+        parsed = parse_addr(addr)
+        if parsed[0] == "unix":
+            self._sock = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            self._sock.connect(parsed[1])
+        else:
+            self._sock = _socket.create_connection((parsed[1], parsed[2]))
+            self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self._seq = itertools.count(1)
+        self._buf = b""
+
+    def _read_frame(self) -> dict:
+        while True:
+            while len(self._buf) < 4:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("connection closed")
+                self._buf += chunk
+            (length,) = _LEN.unpack(self._buf[:4])
+            while len(self._buf) < 4 + length:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("connection closed")
+                self._buf += chunk
+            frame = msgpack.unpackb(self._buf[4 : 4 + length], raw=False)
+            self._buf = self._buf[4 + length :]
+            return frame
+
+    def call(self, method: str, **fields) -> dict:
+        rid = next(self._seq)
+        fields["m"] = method
+        fields["i"] = rid
+        payload = msgpack.packb(fields, use_bin_type=True)
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        while True:
+            msg = self._read_frame()
+            if msg.get("i") != rid:
+                continue  # push/pubsub frame interleaved: not our reply
+            if not msg.get("ok", True) and "err" in msg:
+                import pickle
+
+                raise pickle.loads(msg["err"])
+            return msg
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 class Server:
     """Asyncio socket server dispatching frames to a handler; listens on one
     or more addresses (unix and/or tcp) with a shared handler.

@@ -198,15 +198,18 @@ class LeasePool:
         have plus those on the way cannot cover demand (the tiny-task flood
         case).  While expected leases >= demand, waiting for one is right —
         pipelining there would serialize long tasks on one worker while the
-        rest of the cluster idles."""
+        rest of the cluster idles.  SPREAD pools never pipeline before the
+        lease cap: queueing depth on a warm node is exactly what the
+        strategy exists to avoid, so they keep growing instead."""
         live = sum(1 for l in self.leases if not l.dead)
         expected = live + self.requests_outstanding
         if expected >= demand:
             return False
-        return (
-            expected >= self.max_leases
-            or self.requests_outstanding >= self._MAX_OUTSTANDING
-        )
+        if expected >= self.max_leases:
+            return True
+        if self.strategy is not None and self.strategy.get("type") == "SPREAD":
+            return False
+        return self.requests_outstanding >= self._MAX_OUTSTANDING
 
     async def _request_lease(self):
         kw = {}
@@ -289,7 +292,9 @@ class LeasePool:
             if lease is None or (lease.inflight > 0 and not self._pipeline_ok()):
                 self._maybe_grow()
                 return
-            conn = self.worker._conns.get(lease.addr)
+            conn = self.worker._conns.get(
+                self.worker._normalize_peer_addr(lease.addr)
+            )
             if conn is None or conn.closed:
                 self._dial_then_drain(lease)
                 return
@@ -303,7 +308,10 @@ class LeasePool:
         """The granted lease's worker was never contacted (cold client):
         connect once in the background, then resume draining.  Without this,
         every backlogged item would divert to its own slow-path coroutine —
-        exactly the flood the backlog lane exists to avoid."""
+        exactly the flood the backlog lane exists to avoid.  A failed dial
+        gives the lease BACK to the head (the worker may be fine — only this
+        client's connect failed; keeping it would leak its capacity, since
+        only return_lease or worker death ever releases it head-side)."""
         if lease.addr in self._dialing:
             return
         self._dialing.add(lease.addr)
@@ -313,6 +321,10 @@ class LeasePool:
                 await self.worker.conn_to(lease.addr)
             except Exception:
                 lease.dead = True
+                try:
+                    self.worker.head.notify("return_lease", lease_ids=[lease.lease_id])
+                except Exception:
+                    pass  # head unreachable: its worker-death path reclaims
             finally:
                 self._dialing.discard(lease.addr)
                 self._drain_backlog()
@@ -392,6 +404,14 @@ class Worker:
             budget_bytes=(config or get_config()).object_store_memory,
         )
         self.shm_store.spill_cb = self._spill_bytes
+        self.shm_store.spill_kick_cb = self._spill_kick
+        self._spill_lock = threading.Lock()  # one spill pass at a time
+        self._spill_start_lock = threading.Lock()  # thread creation only
+        self._spill_queue: Optional[Any] = None
+        self._spill_thread: Optional[threading.Thread] = None
+        # inline = a put paid spill latency (hard wall); background = the
+        # watermark spiller ran instead.  Watched by tests and `ca status`.
+        self.spill_stats = {"inline": 0, "background": 0}
         if mode == "driver" and not client_mode:
             # plasma-style pre-allocation: warm an arena while the driver is
             # still bootstrapping so early puts land in pre-faulted pages.
@@ -1362,22 +1382,69 @@ class Worker:
             pass
 
     # ------------------------------------------------------------- spilling
+    def _spill_kick(self):
+        """Non-blocking: wake (or start) the background spill thread — the
+        IO-worker analogue of local_object_manager.h.  Called from the
+        store's seal path when live bytes cross the high watermark, so the
+        allocating put never waits on disk."""
+        import queue as _queue
+
+        with self._spill_start_lock:
+            if self._spill_thread is None:
+                self._spill_queue = _queue.Queue(maxsize=2)
+                self._spill_thread = threading.Thread(
+                    target=self._spill_loop, name="ca-spill", daemon=True
+                )
+                self._spill_thread.start()
+        try:
+            self._spill_queue.put_nowait(1)
+        except _queue.Full:
+            pass  # a pass is already queued; it will see the latest usage
+
+    def _spill_loop(self):
+        import queue as _queue
+
+        low_frac = 0.5  # spill down to this fraction of the budget
+        while not self._stopped:
+            try:
+                self._spill_queue.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            store = self.shm_store
+            if not store.budget_bytes:
+                continue
+            need = store.live_bytes() - int(store.budget_bytes * low_frac)
+            if need > 0:
+                self.spill_stats["background"] += 1
+                self._spill_pass(need)
+
     def _spill_bytes(self, need: int):
-        """Move the oldest live slices of this process to disk until `need`
-        bytes are freed (plus a batch margin), keeping the arena footprint
-        inside the budget (LocalObjectManager spill analogue).  The head
-        arbitrates: a slice under zero-copy pins is relocated but its memory
-        reclaim is deferred to the last pin drop."""
+        """Hard-wall spill on the allocating path: an allocation could not
+        fit the budget even after the watermark spiller's work.  Kept as the
+        correctness backstop; the proactive path (_spill_kick) exists so
+        this rarely runs."""
         try:
             asyncio.get_running_loop()
             return  # IO-loop context (pull imports): cannot block on RPCs
         except RuntimeError:
             pass
+        self.spill_stats["inline"] += 1
+        self._spill_pass(max(need, self.shm_store.budget_bytes // 8))
+
+    def _spill_pass(self, target: int):
+        """Move the oldest live slices of this process to disk until `target`
+        bytes are freed (LocalObjectManager spill analogue).  The head
+        arbitrates: a slice under zero-copy pins is relocated but its memory
+        reclaim is deferred to the last pin drop.  Serialized: concurrent
+        inline + background passes would re-spill the same slices."""
         if self.head is None or self.head.closed:
             return
+        with self._spill_lock:
+            self._spill_pass_locked(target)
+
+    def _spill_pass_locked(self, target: int):
         spill_dir = os.path.join(self.session_dir, "spill", self.node_id)
         os.makedirs(spill_dir, exist_ok=True)
-        target = max(need, self.shm_store.budget_bytes // 8)
         freed = 0
         for name, size, oid_b in self.shm_store.live_slices_oldest_first():
             if freed >= target:
@@ -1654,11 +1721,11 @@ class Worker:
         the caller decides the fallback.  On success the reply callback
         releases the lease and stores results/errors, retrying worker death
         within the task's budget."""
-        conn = self._conns.get(lease.addr)
+        addr = self._normalize_peer_addr(lease.addr)
+        conn = self._conns.get(addr)
         if conn is None or conn.closed:
             return False
         lease.inflight += 1
-        addr = lease.addr
 
         def on_reply(msg):
             pool.release(lease, dead=msg is None)

@@ -86,3 +86,44 @@ def test_multi_gib_object_roundtrip():
     assert back[0] == 1.0 and back[-1] == 1.0
     assert put_s < 60, f"1.5 GiB put took {put_s:.1f}s"
     del back, ref
+
+
+def test_sixteen_node_scheduling_stress():
+    """16 one-CPU virtual nodes + head: a SPREAD flood must fan out across
+    most of the cluster and a PG spanning all 16 must place (trimmed
+    release/benchmarks many_nodes_tests analogue; honest for one physical
+    core — the assertion is placement breadth + completion, not speed)."""
+    import os as _os
+
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+
+    ca.shutdown()
+    c = Cluster(head_resources={"CPU": 1})
+    try:
+        for _ in range(16):
+            c.add_node(num_cpus=1)
+        c.connect()
+        c.wait_for_nodes(17)
+
+        @ca.remote
+        def where(t):
+            time.sleep(t)
+            return _os.environ.get("CA_NODE_ID", "n0")
+
+        f = where.options(scheduling_strategy="SPREAD")
+        spots = set(ca.get([f.remote(0.5) for _ in range(32)], timeout=180))
+        assert len(spots) >= 12, f"SPREAD used only {len(spots)} of 17 nodes: {spots}"
+        # a 16-bundle STRICT_SPREAD PG: every bundle on a distinct agent node
+        pg = ca.placement_group([{"CPU": 1}] * 16, strategy="STRICT_SPREAD")
+        assert pg.wait(60)
+        table = {p["pg_id"]: p for p in ca.placement_group_table()}
+        nodes = table[pg.id.hex()]["bundle_nodes"]
+        assert len(set(nodes)) == 16, nodes
+        ca.remove_placement_group(pg)
+    finally:
+        try:
+            ca.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
+        ca.init(num_cpus=4)  # restore the module fixture's cluster

@@ -122,3 +122,32 @@ def test_cross_node_read_of_spilled_object():
         del churn
     finally:
         c.shutdown()
+
+
+def test_background_spill_keeps_puts_off_disk_latency(small_store_cluster):
+    """The watermark spiller (IO-worker analogue) must do the spilling in
+    the background: a steady put stream that stays under the hard wall
+    between iterations sees zero inline (allocating-path) spills, while the
+    background pass runs and the data remains readable."""
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    w = global_worker()
+    refs = []
+    for i in range(24):  # 24 x 4MB vs 64MB budget; watermark at ~51MB
+        refs.append(ca.put(np.full(4 * MB, i, dtype=np.uint8)))
+        time.sleep(0.03)  # realistic inter-put gap: background pass can run
+    deadline = time.time() + 10
+    while time.time() < deadline and w.spill_stats["background"] == 0:
+        time.sleep(0.1)
+    assert w.spill_stats["background"] >= 1, w.spill_stats
+    # tolerance of one: a slow shared-CI disk can let the put stream catch
+    # the hard wall once before the first background pass lands; the claim
+    # under test is that the background path does the work, not that the
+    # backstop can never fire
+    assert w.spill_stats["inline"] <= 1, (
+        "puts paid spill latency despite the background spiller",
+        w.spill_stats,
+    )
+    for i, r in enumerate(refs):
+        v = ca.get(r)
+        assert v[0] == i and v.shape == (4 * MB,)
