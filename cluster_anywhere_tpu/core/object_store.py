@@ -314,6 +314,11 @@ class ShmObjectStore:
         # live slices this process sealed, insertion-ordered (spill picks the
         # oldest): name -> (alloc_offset, alloc_size, oid_bytes)
         self._live_slices: Dict[str, Tuple[int, int, bytes]] = {}
+        # slices whose payload is still being written (packed locally or
+        # filled from the network): NOT spill candidates — the background
+        # spiller would persist torn bytes and recycle memory under the
+        # writer.  seal_done() graduates them.
+        self._writing: set = set()
         self._slice_seq = 0
         self._live_bytes = 0  # sum of live-slice allocations (watermark input)
         self.budget_bytes = budget_bytes  # 0 = uncapped
@@ -341,7 +346,7 @@ class ShmObjectStore:
             return [
                 (name, alloc - _SLICE_HDR, oid)
                 for name, (off, alloc, oid) in self._live_slices.items()
-                if oid
+                if oid and name not in self._writing
             ]
 
     # -- native acceleration ------------------------------------------------
@@ -466,13 +471,22 @@ class ShmObjectStore:
         with self._lock:
             self._live_slices[name] = (off, alloc, oid.binary() if primary else b"")
             self._live_bytes += alloc
-        if (
-            self.budget_bytes
-            and self.spill_kick_cb is not None
-            and self._live_bytes > self.budget_bytes * self.spill_high_frac
-        ):
-            self.spill_kick_cb()
+            self._writing.add(name)
         return name, memoryview(arena.mm)[off + _SLICE_HDR : off + _SLICE_HDR + payload_size]
+
+    def seal_done(self, shm_name: str) -> None:
+        """The slice's payload is fully written: it becomes a spill candidate,
+        and crossing the high watermark kicks the background spiller (AFTER
+        the write, so the spiller can never persist torn bytes)."""
+        with self._lock:
+            self._writing.discard(shm_name)
+            over = (
+                self.budget_bytes
+                and self.spill_kick_cb is not None
+                and self._live_bytes > self.budget_bytes * self.spill_high_frac
+            )
+        if over:
+            self.spill_kick_cb()
 
     def _pack_into(self, mv, data: bytes, raws: List[Any]):
         native = self._native_lib()
@@ -494,6 +508,7 @@ class ShmObjectStore:
                     self._pack_into(mv, data, raws)
                 finally:
                     mv.release()
+                self.seal_done(name)
                 return name, size
         # dedicated segment path (huge objects, or arena creation failed)
         name = self.name_for(oid)

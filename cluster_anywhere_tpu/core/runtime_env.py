@@ -27,13 +27,8 @@ _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 
 
 def validate(runtime_env: Dict[str, Any]):
-    allowed = {"env_vars", "working_dir", "py_modules", "config"}
+    allowed = {"env_vars", "working_dir", "py_modules", "pip", "config"}
     unknown = set(runtime_env) - allowed
-    if "pip" in unknown:
-        raise NotImplementedError(
-            "runtime_env 'pip' is not supported in this environment (no package "
-            "installs); vendor dependencies via py_modules or working_dir"
-        )
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
     ev = runtime_env.get("env_vars")
@@ -41,6 +36,39 @@ def validate(runtime_env: Dict[str, Any]):
         isinstance(k, str) and isinstance(v, str) for k, v in ev.items()
     ):
         raise ValueError("env_vars must be Dict[str, str]")
+    if "pip" in runtime_env:
+        normalize_pip_spec(runtime_env["pip"])  # raises on malformed specs
+
+
+def normalize_pip_spec(spec: Any) -> Dict[str, Any]:
+    """Accept the reference's pip forms — list of requirements, or
+    {"packages": [...], "find_links": path} — normalized for this
+    environment's OFFLINE install contract: pip always runs --no-index
+    against a local wheel cache (find_links; default $CA_PIP_FIND_LINKS),
+    mirroring _private/runtime_env/pip.py minus the network."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict) or not spec.get("packages"):
+        raise ValueError(
+            'runtime_env "pip" must be a list of requirements or '
+            '{"packages": [...], "find_links": <local wheel dir>}'
+        )
+    pkgs = [str(p) for p in spec["packages"]]
+    find_links = spec.get("find_links") or os.environ.get("CA_PIP_FIND_LINKS")
+    if not find_links:
+        raise ValueError(
+            "offline pip installs need a local wheel cache: pass "
+            '{"pip": {"packages": [...], "find_links": "/path/to/wheels"}} '
+            "or set CA_PIP_FIND_LINKS"
+        )
+    return {"packages": pkgs, "find_links": os.path.abspath(find_links)}
+
+
+def pip_env_hash(norm: Dict[str, Any]) -> str:
+    """URI-cache key (uri_cache.py analogue): the env is content-addressed
+    by its normalized spec, so identical specs share one installed dir."""
+    blob = "\x00".join(sorted(norm["packages"])) + "\x01" + norm["find_links"]
+    return hashlib.sha256(blob.encode()).hexdigest()[:24]
 
 
 def _zip_dir(path: str, excludes: Optional[List[str]] = None) -> bytes:
@@ -94,6 +122,12 @@ def prepare(runtime_env: Optional[Dict[str, Any]], worker) -> Optional[Dict[str,
                 raise ValueError(f"py_modules entry {m!r} is not a directory")
             pkgs.append((os.path.basename(os.path.abspath(m)), _upload_dir(worker, m)))
         wire["py_module_pkgs"] = pkgs
+    if runtime_env.get("pip"):
+        norm = normalize_pip_spec(runtime_env["pip"])
+        if not os.path.isdir(norm["find_links"]):
+            raise ValueError(f"pip find_links {norm['find_links']!r} is not a directory")
+        norm["hash"] = pip_env_hash(norm)
+        wire["pip"] = norm
     return wire or None
 
 
@@ -128,10 +162,62 @@ class RuntimeEnvContext:
             shutil.rmtree(tmp, ignore_errors=True)  # concurrent extract won
         return dest
 
+    def _materialize_pip(self, norm: Dict[str, Any]) -> str:
+        """Install the pip env into a spec-hash-keyed cache dir (once per
+        session per spec) and return it.  Strictly offline: --no-index with
+        the given local wheel cache.  Installs land in a tmp dir renamed
+        atomically, so concurrent workers race safely and a crashed install
+        never half-populates the cache."""
+        import subprocess
+
+        dest = os.path.join(
+            self.worker.session_dir, "runtime_env_cache", "pip_" + norm["hash"]
+        )
+        if os.path.isdir(dest):
+            return dest
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        tmp = dest + f".tmp{os.getpid()}"
+        cmd = [
+            sys.executable, "-m", "pip", "install", "--quiet",
+            "--no-index", "--find-links", norm["find_links"],
+            "--target", tmp, "--no-warn-script-location",
+            *norm["packages"],
+        ]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        except subprocess.TimeoutExpired:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"offline pip install failed ({' '.join(norm['packages'])}): "
+                f"timed out after 300s"
+            )
+        if r.returncode != 0:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"offline pip install failed ({' '.join(norm['packages'])}): "
+                f"{r.stderr.strip()[-500:]}"
+            )
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # concurrent install won
+        return dest
+
     def apply(self):
         for k, v in (self.wire.get("env_vars") or {}).items():
             self._saved_env[k] = os.environ.get(k)
             os.environ[k] = v
+        pip_spec = self.wire.get("pip")
+        if pip_spec:
+            path = self._materialize_pip(pip_spec)
+            sys.path.insert(0, path)
+            self._added_paths.append(path)
         pkg = self.wire.get("working_dir_pkg")
         if pkg:
             path = self._materialize_pkg(pkg)
@@ -172,6 +258,14 @@ class RuntimeEnvContext:
                 sys.path.remove(p)
             except ValueError:
                 pass
+            # pool workers are reused: a module cached in sys.modules would
+            # leak this env's code into later tasks even after the path is
+            # gone, so evict everything imported from under the env dir
+            prefix = p + os.sep
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and (f.startswith(prefix) or f == p):
+                    del sys.modules[name]
         self._added_paths.clear()
 
     def __enter__(self):

@@ -1243,6 +1243,7 @@ class Worker:
                 off += len(data)
         finally:
             mv.release()
+        self.shm_store.seal_done(local_name)
         try:
             self.head.notify("obj_copy", oid=oid_b, node=self.node_id, shm_name=local_name)
         except Exception:
@@ -1514,6 +1515,7 @@ class Worker:
                         )
                         mv[:] = e.packed
                         mv.release()
+                        self.shm_store.seal_done(name)
                         size = len(e.packed)
                 else:
                     with serialization.ref_capture() as sub:

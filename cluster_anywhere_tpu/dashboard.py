@@ -37,18 +37,59 @@ h1 { font-size: 18px; } h2 { font-size: 14px; margin: 18px 0 6px; color: #8ab4f8
 table { border-collapse: collapse; width: 100%; font-size: 12px; }
 th, td { text-align: left; padding: 3px 10px; border-bottom: 1px solid #2a3038; }
 th { color: #9aa5b1; font-weight: 600; }
-.ok { color: #7ee787; } .bad { color: #ff7b72; }
+.ok { color: #7ee787; } .bad { color: #ff7b72; } .warn { color: #e3b341; }
 #res { font-size: 13px; margin: 8px 0; }
+#tl { position: relative; background: #161b22; border: 1px solid #2a3038; margin-top: 4px; }
+.lane-label { position: absolute; left: 4px; font-size: 10px; color: #9aa5b1; }
+.bar { position: absolute; height: 12px; border-radius: 2px; min-width: 2px; }
+.bar.FINISHED { background: #2ea04366; border: 1px solid #7ee787; }
+.bar.FAILED { background: #da363366; border: 1px solid #ff7b72; }
+#tlaxis { font-size: 10px; color: #9aa5b1; }
 </style></head><body>
 <h1>cluster_anywhere_tpu</h1>
 <div id="res"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Workers</h2><table id="workers"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Placement groups</h2><table id="pgs"></table>
+<h2>Task timeline <span id="tlaxis"></span></h2><div id="tl"></div>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <script>
 function row(cells, tag) {
   return "<tr>" + cells.map(c => "<" + (tag||"td") + ">" + c + "</" + (tag||"td") + ">").join("") + "</tr>";
+}
+function esc(s) {
+  return String(s == null ? "" : s).replace(/&/g, "&amp;").replace(/</g, "&lt;");
+}
+function timeline(events) {
+  // chrome-trace-style lanes: one per worker, bars = task spans, newest
+  // window only (the events endpoint already rings)
+  const el = document.getElementById("tl");
+  const done = events.filter(t => t.end && t.start);
+  if (!done.length) { el.style.height = "20px"; el.innerHTML = ""; return; }
+  const t0 = Math.min(...done.map(t => t.start));
+  const t1 = Math.max(...done.map(t => t.end));
+  const span = Math.max(t1 - t0, 1e-6);
+  const lanes = [...new Set(done.map(t => t.worker_id))];
+  const W = el.clientWidth || 900, LH = 16, PAD = 70;
+  el.style.height = (lanes.length * LH + 4) + "px";
+  let html = "";
+  lanes.forEach((w, i) => {
+    html += '<div class="lane-label" style="top:' + (i * LH + 2) + 'px">' + esc(w) + "</div>";
+  });
+  done.forEach(t => {
+    const lane = lanes.indexOf(t.worker_id);
+    const x = PAD + (t.start - t0) / span * (W - PAD - 8);
+    const w = Math.max((t.end - t.start) / span * (W - PAD - 8), 2);
+    const ms = ((t.end - t.start) * 1000).toFixed(1);
+    html += '<div class="bar ' + esc(t.state) + '" style="left:' + x + "px;top:" +
+      (lane * LH + 2) + "px;width:" + w + 'px" title="' + esc(t.name) + " (" +
+      esc(t.type) + ") " + ms + ' ms"></div>';
+  });
+  el.innerHTML = html;
+  document.getElementById("tlaxis").textContent =
+    "window " + (span).toFixed(2) + "s, " + done.length + " spans";
 }
 async function refresh() {
   const s = await (await fetch("/api/summary")).json();
@@ -58,18 +99,32 @@ async function refresh() {
     " &nbsp; actors " + s.stats.n_actors + " &nbsp; objects " + s.stats.n_objects +
     " &nbsp; pending leases " + s.stats.pending_leases;
   const nodes = await (await fetch("/api/nodes")).json();
-  document.getElementById("nodes").innerHTML = row(["node", "alive", "head", "CPU avail/total", "workers"], "th") +
+  document.getElementById("nodes").innerHTML = row(["node", "alive", "head", "CPU avail/total", "workers", "labels"], "th") +
     nodes.map(n => row([n.node_id, n.alive ? "<span class=ok>yes</span>" : "<span class=bad>DEAD</span>",
-      n.is_head_node ? "*" : "", (n.available.CPU||0) + "/" + (n.resources.CPU||0), n.n_workers])).join("");
+      n.is_head_node ? "*" : "", (n.available.CPU||0) + "/" + (n.resources.CPU||0), n.n_workers,
+      esc(Object.entries(n.labels||{}).filter(([k]) => k != "ca.io/node-id")
+        .map(([k, v]) => k.replace("ca.io/", "") + "=" + v).join(" "))])).join("");
   const actors = await (await fetch("/api/actors")).json();
   document.getElementById("actors").innerHTML = row(["actor", "name", "state", "node", "restarts"], "th") +
-    actors.slice(0, 50).map(a => row([a.actor_id.slice(0, 12), a.name||"", a.state, a.node_id||"", a.incarnation])).join("");
+    actors.slice(0, 50).map(a => row([a.actor_id.slice(0, 12), esc(a.name), a.state, a.node_id||"", a.incarnation])).join("");
   const workers = await (await fetch("/api/workers")).json();
   document.getElementById("workers").innerHTML = row(["worker", "pid", "state", "node"], "th") +
     workers.slice(0, 80).map(w => row([w.worker_id, w.pid, w.state, w.node_id])).join("");
-  const tasks = await (await fetch("/api/tasks?limit=30")).json();
+  const jobs = await (await fetch("/api/jobs")).json();
+  const jcls = {RUNNING: "warn", SUCCEEDED: "ok", FAILED: "bad", STOPPED: "bad"};
+  document.getElementById("jobs").innerHTML = row(["job", "status", "entrypoint", "runtime s"], "th") +
+    jobs.slice(0, 30).map(j => row([esc(j.submission_id),
+      '<span class="' + (jcls[j.status]||"") + '">' + esc(j.status) + "</span>",
+      esc((j.entrypoint||"").slice(0, 80)),
+      (j.runtime_s == null ? "" : j.runtime_s.toFixed(1))])).join("");
+  const pgs = await (await fetch("/api/pgs")).json();
+  document.getElementById("pgs").innerHTML = row(["pg", "strategy", "state", "bundle nodes"], "th") +
+    pgs.slice(0, 30).map(p => row([p.pg_id.slice(0, 12), p.strategy, p.state,
+      esc((p.bundle_nodes||[]).join(" "))])).join("");
+  const tasks = await (await fetch("/api/tasks?limit=200")).json();
+  timeline(tasks);
   document.getElementById("tasks").innerHTML = row(["name", "type", "state", "worker", "ms"], "th") +
-    tasks.reverse().map(t => row([t.name, t.type, t.state, t.worker_id,
+    tasks.slice(-30).reverse().map(t => row([esc(t.name), t.type, t.state, t.worker_id,
       ((t.end - t.start) * 1000).toFixed(1)])).join("");
 }
 refresh(); setInterval(refresh, 2000);
@@ -175,6 +230,7 @@ class Dashboard:
                         "resources": n.total,
                         "available": n.avail,
                         "load": n.load,
+                        "labels": n.labels,
                         "n_workers": sum(
                             1
                             for w in h.workers.values()
@@ -209,9 +265,16 @@ class Dashboard:
                 )
             return self._json(out)
         if path == "/api/jobs":
-            return self._json(
-                [json.loads(v) for v in self._job_kv().values()]
-            )
+            # runtime computed with the SERVER clock (start_time is ours; a
+            # skewed browser clock would show negative runtimes otherwise)
+            now = time.time()
+            out = []
+            for v in self._job_kv().values():
+                j = json.loads(v)
+                if j.get("start_time"):
+                    j["runtime_s"] = (j.get("end_time") or now) - j["start_time"]
+                out.append(j)
+            return self._json(out)
         if path.startswith("/api/jobs/"):
             sid = path[len("/api/jobs/"):]
             raw = self._job_kv().get(sid)

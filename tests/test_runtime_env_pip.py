@@ -1,0 +1,117 @@
+"""Offline pip runtime-env plugin (analogue of
+python/ray/_private/runtime_env/pip.py + uri_cache.py): installs from a
+LOCAL wheel cache with --no-index, into a per-session env dir keyed by the
+normalized spec hash (installed once, reused by every task with the same
+spec)."""
+
+import os
+import zipfile
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.core.runtime_env import normalize_pip_spec, pip_env_hash
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    ca.init(num_cpus=2)
+    yield
+    ca.shutdown()
+
+
+def _make_wheel(dirpath, name="capkg_demo", version="1.0", body="VALUE = 41\n"):
+    """Hand-roll a minimal pure-python wheel (avoids depending on a wheel
+    build toolchain in the offline test env)."""
+    dist = f"{name}-{version}.dist-info"
+    whl = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    record = f"{dist}/RECORD"
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}.py", body)
+        z.writestr(
+            f"{dist}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        )
+        z.writestr(f"{dist}/WHEEL", "Wheel-Version: 1.0\nRoot-Is-Purelib: true\n")
+        z.writestr(record, f"{name}.py,,\n{dist}/METADATA,,\n{dist}/WHEEL,,\n{record},,\n")
+    return whl
+
+
+def test_pip_spec_normalization_and_hash(tmp_path):
+    n1 = normalize_pip_spec({"packages": ["b", "a"], "find_links": str(tmp_path)})
+    n2 = normalize_pip_spec({"packages": ["a", "b"], "find_links": str(tmp_path)})
+    assert pip_env_hash(n1) == pip_env_hash(n2)  # order-insensitive cache key
+    n3 = normalize_pip_spec({"packages": ["a"], "find_links": str(tmp_path)})
+    assert pip_env_hash(n3) != pip_env_hash(n1)
+    with pytest.raises(ValueError):
+        normalize_pip_spec([])
+    # bare list requires CA_PIP_FIND_LINKS
+    os.environ.pop("CA_PIP_FIND_LINKS", None)
+    with pytest.raises(ValueError):
+        normalize_pip_spec(["somepkg"])
+    os.environ["CA_PIP_FIND_LINKS"] = str(tmp_path)
+    try:
+        assert normalize_pip_spec(["somepkg"])["find_links"] == str(tmp_path)
+    finally:
+        del os.environ["CA_PIP_FIND_LINKS"]
+
+
+def test_task_installs_wheel_from_local_cache(tmp_path):
+    _make_wheel(str(tmp_path))
+
+    @ca.remote
+    def use_pkg():
+        import capkg_demo
+
+        return capkg_demo.VALUE + 1
+
+    env = {"pip": {"packages": ["capkg-demo"], "find_links": str(tmp_path)}}
+    assert ca.get(use_pkg.options(runtime_env=env).remote(), timeout=120) == 42
+    # the env must not leak into tasks without it
+    @ca.remote
+    def no_pkg():
+        try:
+            import capkg_demo  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    assert ca.get(no_pkg.remote(), timeout=60) == "clean"
+
+
+def test_pip_env_cached_by_spec_hash(tmp_path):
+    _make_wheel(str(tmp_path), name="capkg_cached", body="VALUE = 7\n")
+    env = {"pip": {"packages": ["capkg-cached"], "find_links": str(tmp_path)}}
+
+    @ca.remote
+    def use_pkg():
+        import capkg_cached
+
+        return capkg_cached.VALUE
+
+    assert ca.get(use_pkg.options(runtime_env=env).remote(), timeout=120) == 7
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    norm = normalize_pip_spec(env["pip"])
+    cache = os.path.join(
+        global_worker().session_dir, "runtime_env_cache", "pip_" + pip_env_hash(norm)
+    )
+    assert os.path.isdir(cache)
+    stamp = os.path.getmtime(cache)
+    # second task with the identical spec reuses the installed dir
+    assert ca.get(use_pkg.options(runtime_env=env).remote(), timeout=120) == 7
+    assert os.path.getmtime(cache) == stamp
+
+
+def test_pip_missing_package_errors_cleanly(tmp_path):
+    env = {"pip": {"packages": ["definitely-not-cached"], "find_links": str(tmp_path)}}
+
+    @ca.remote
+    def f():
+        return 1
+
+    with pytest.raises(ca.exceptions.CAError, match="pip install failed"):
+        ca.get(f.options(runtime_env=env).remote(), timeout=120)
