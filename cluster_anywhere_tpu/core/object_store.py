@@ -506,8 +506,11 @@ class ShmObjectStore:
                 name, mv = self._seal_slice(arena, off, size, oid, primary=True)
                 try:
                     self._pack_into(mv, data, raws)
-                finally:
+                except BaseException:
                     mv.release()
+                    self.free_local(name)  # aborted write: reclaim, don't leak
+                    raise
+                mv.release()
                 self.seal_done(name)
                 return name, size
         # dedicated segment path (huge objects, or arena creation failed)
@@ -579,6 +582,7 @@ class ShmObjectStore:
             entry = self._live_slices.pop(shm_name, None)
             if entry is not None:
                 self._live_bytes -= entry[1]
+            self._writing.discard(shm_name)  # free of an aborted write
         if entry is None:
             return  # unknown or already freed
         arena = self._arenas.get(arena_name)

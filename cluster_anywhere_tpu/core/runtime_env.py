@@ -16,6 +16,7 @@ from __future__ import annotations
 import hashlib
 import io
 import os
+import shutil
 import sys
 import zipfile
 from typing import Any, Dict, List, Optional
@@ -157,8 +158,6 @@ class RuntimeEnvContext:
         try:
             os.rename(tmp, dest)
         except OSError:
-            import shutil
-
             shutil.rmtree(tmp, ignore_errors=True)  # concurrent extract won
         return dest
 
@@ -186,16 +185,12 @@ class RuntimeEnvContext:
         try:
             r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         except subprocess.TimeoutExpired:
-            import shutil
-
             shutil.rmtree(tmp, ignore_errors=True)
             raise RuntimeError(
                 f"offline pip install failed ({' '.join(norm['packages'])}): "
                 f"timed out after 300s"
             )
         if r.returncode != 0:
-            import shutil
-
             shutil.rmtree(tmp, ignore_errors=True)
             raise RuntimeError(
                 f"offline pip install failed ({' '.join(norm['packages'])}): "
@@ -204,8 +199,6 @@ class RuntimeEnvContext:
         try:
             os.rename(tmp, dest)
         except OSError:
-            import shutil
-
             shutil.rmtree(tmp, ignore_errors=True)  # concurrent install won
         return dest
 
@@ -260,11 +253,20 @@ class RuntimeEnvContext:
                 pass
             # pool workers are reused: a module cached in sys.modules would
             # leak this env's code into later tasks even after the path is
-            # gone, so evict everything imported from under the env dir
+            # gone, so evict everything imported from under the env dir —
+            # including namespace packages, whose __file__ is None but whose
+            # __path__ points into it
             prefix = p + os.sep
             for name, mod in list(sys.modules.items()):
                 f = getattr(mod, "__file__", None)
                 if f and (f.startswith(prefix) or f == p):
+                    del sys.modules[name]
+                    continue
+                try:
+                    paths = list(getattr(mod, "__path__", None) or [])
+                except Exception:
+                    continue
+                if any(x == p or str(x).startswith(prefix) for x in paths):
                     del sys.modules[name]
         self._added_paths.clear()
 

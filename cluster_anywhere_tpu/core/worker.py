@@ -1241,8 +1241,11 @@ class Worker:
                     )
                 mv[off : off + len(data)] = data
                 off += len(data)
-        finally:
+        except BaseException:
             mv.release()
+            self.shm_store.free_local(local_name)  # aborted pull: reclaim
+            raise
+        mv.release()
         self.shm_store.seal_done(local_name)
         try:
             self.head.notify("obj_copy", oid=oid_b, node=self.node_id, shm_name=local_name)
@@ -1513,7 +1516,12 @@ class Worker:
                         name, mv = self.shm_store.create_for_import(
                             oid, len(e.packed), primary=True
                         )
-                        mv[:] = e.packed
+                        try:
+                            mv[:] = e.packed
+                        except BaseException:
+                            mv.release()
+                            self.shm_store.free_local(name)
+                            raise
                         mv.release()
                         self.shm_store.seal_done(name)
                         size = len(e.packed)

@@ -60,7 +60,9 @@ function row(cells, tag) {
   return "<tr>" + cells.map(c => "<" + (tag||"td") + ">" + c + "</" + (tag||"td") + ">").join("") + "</tr>";
 }
 function esc(s) {
-  return String(s == null ? "" : s).replace(/&/g, "&amp;").replace(/</g, "&lt;");
+  // attribute-safe: esc() output lands inside title="..." too
+  return String(s == null ? "" : s).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+    .replace(/"/g, "&quot;");
 }
 function timeline(events) {
   // chrome-trace-style lanes: one per worker, bars = task spans, newest

@@ -24,7 +24,7 @@ from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .controller import CONTROLLER_NAME, ServeController, get_or_create_controller
 from .multiplex import get_multiplexed_model_id, multiplexed
-from .grpc_proxy import grpc_call
+from .grpc_proxy import grpc_call, grpc_call_typed, grpc_healthz, grpc_list_applications
 from .proxy import ProxyActor, Request
 from .replica import get_request_context
 from .router import DeploymentHandle, DeploymentResponseGenerator, DeploymentResponse
@@ -268,6 +268,9 @@ __all__ = [
     "start",
     "start_grpc_proxy",
     "grpc_call",
+    "grpc_call_typed",
+    "grpc_list_applications",
+    "grpc_healthz",
     "delete",
     "shutdown",
     "status",

@@ -305,6 +305,36 @@ def test_grpc_ingress(ca_cluster_module):
     serve.delete("grpcapp")
 
 
+def test_grpc_typed_service(ca_cluster_module):
+    """Typed proto surface (protos/serve.proto): CAServeUserService/Call with
+    msgpack payloads — the path a non-Python client uses — plus the
+    CAServeAPIService management methods (RayServeAPIService analogue)."""
+    pytest.importorskip("grpc")
+    from cluster_anywhere_tpu import serve
+
+    @serve.deployment
+    class Summer:
+        def __call__(self, xs, bias=0):
+            return sum(xs) + bias
+
+    serve.run(Summer.bind(), name="typedapp", route_prefix="/typedapp")
+    target = serve.start_grpc_proxy()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if "typedapp" in serve.grpc_list_applications(target):
+            break
+        time.sleep(0.2)
+    assert serve.grpc_healthz(target) == "success"
+    assert "typedapp" in serve.grpc_list_applications(target)
+    assert serve.grpc_call_typed(target, "typedapp", [1, 2, 3]) == 6
+    assert serve.grpc_call_typed(target, "typedapp", [1, 2, 3], bias=10) == 16
+    import grpc as _grpc
+
+    with pytest.raises(_grpc.RpcError):
+        serve.grpc_call_typed(target, "missing_app", [1], timeout=10)
+    serve.delete("typedapp")
+
+
 def test_streaming_deployment_handle_and_sse(ca_cluster_module):
     """Generator deployments stream: handle.options(stream=True) yields items
     in order, and the HTTP proxy serves them as SSE events when the client
