@@ -10,12 +10,21 @@
     asc.start()
 """
 
-from .provider import LocalNodeProvider, NodeInfo, NodeProvider, NodeType
+from .provider import (
+    AgentNodeProvider,
+    CommandRunnerNodeProvider,
+    LocalNodeProvider,
+    NodeInfo,
+    NodeProvider,
+    NodeType,
+)
 from .reconciler import Autoscaler, AutoscalerConfig, Reconciler
 
 __all__ = [
     "NodeProvider",
     "LocalNodeProvider",
+    "AgentNodeProvider",
+    "CommandRunnerNodeProvider",
     "NodeType",
     "NodeInfo",
     "Autoscaler",

@@ -24,6 +24,7 @@ class NodeType:
     name: str
     resources: Dict[str, float]
     max_nodes: int = 4
+    labels: Optional[Dict[str, str]] = None  # scheduling labels for launched nodes
 
 
 @dataclass
@@ -120,6 +121,144 @@ class LocalNodeProvider(NodeProvider):
         return [n for n in self.nodes.values() if n.state != "terminated"]
 
 
+class CommandRunnerNodeProvider(NodeProvider):
+    """Launches nodes by executing user-supplied COMMANDS — the seam a real
+    cloud deployment plugs into (reference autoscaler/_private/
+    command_runner.py SSHCommandRunner role).  The provider knows nothing
+    about transport: `launch_cmd` is typically
+    ``ssh {host} 'ca join --head {head_addr} --node-id {node_id}
+    --resources {resources_json}'`` against a pool of machines, but any
+    shell command that ends with the node registering at the head works
+    (tests use a local `ca join`).
+
+    Template variables: {host} {node_id} {head_addr} {resources_json}
+    {labels_json}.  Liveness is judged by the HEAD's node table, not the
+    runner process (an ssh session dying does not mean the node died);
+    terminate falls back to killing the runner when no terminate_cmd is
+    given (fine for local/ssh-with-tty runners)."""
+
+    def __init__(
+        self,
+        hosts: List[str],
+        launch_cmd: str,
+        terminate_cmd: Optional[str] = None,
+        wait_s: float = 60.0,
+    ):
+        from ..core.worker import global_worker
+
+        self.w = global_worker()
+        self.session_dir = self.w.session_dir
+        self.head_tcp = open(os.path.join(self.session_dir, "head.addr")).read().strip()
+        if not self.head_tcp:
+            raise RuntimeError("head has no TCP endpoint; cannot launch remote nodes")
+        self.hosts = list(hosts)
+        self.launch_cmd = launch_cmd
+        self.terminate_cmd = terminate_cmd
+        self.wait_s = wait_s
+        self._host_of: Dict[str, str] = {}  # node_id -> host
+        self.nodes: Dict[str, NodeInfo] = {}
+
+    def _alive_at_head(self, node_id: str) -> bool:
+        for n in self.w.head_call("nodes")["nodes"]:
+            if n["node_id"] == node_id:
+                return n["alive"]
+        return False
+
+    def _fmt(self, template: str, host: str, node_id: str, shape, labels) -> str:
+        import json
+        import shlex
+
+        return template.format(
+            host=host,
+            node_id=node_id,
+            head_addr=self.head_tcp,
+            resources_json=shlex.quote(json.dumps(shape)),
+            labels_json=shlex.quote(json.dumps(labels or {})),
+        )
+
+    def create_node(self, node_type: NodeType) -> NodeInfo:
+        used = set(self._host_of.values())
+        free = [h for h in self.hosts if h not in used]
+        if not free:
+            raise RuntimeError("no free hosts in the provider pool")
+        host = free[0]
+        node_id = f"cr-{uuid.uuid4().hex[:8]}"
+        shape = dict(node_type.resources)
+        shape.setdefault("memory", float(self.w.config.object_store_memory))
+        cmd = self._fmt(self.launch_cmd, host, node_id, shape, node_type.labels)
+        logf = open(os.path.join(self.session_dir, f"runner-{node_id}.log"), "ab")
+        proc = subprocess.Popen(
+            cmd, shell=True, stdout=logf, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        logf.close()
+        deadline = time.monotonic() + self.wait_s
+        while not self._alive_at_head(node_id):
+            if proc.poll() is not None and not self._alive_at_head(node_id):
+                raise RuntimeError(
+                    f"launch command exited rc={proc.returncode} before node "
+                    f"{node_id} registered (see runner-{node_id}.log)"
+                )
+            if time.monotonic() > deadline:
+                # kill the launcher before giving up: a node registering
+                # AFTER the raise would be untracked live capacity on a host
+                # the provider still considers free (double-booking)
+                self._kill_runner(proc)
+                raise RuntimeError(f"node {node_id} did not register within {self.wait_s}s")
+            time.sleep(0.1)
+        self._host_of[node_id] = host
+        info = NodeInfo(
+            node_id=node_id, node_type=node_type.name, resources=shape, handle=proc
+        )
+        self.nodes[node_id] = info
+        return info
+
+    @staticmethod
+    def _kill_runner(proc) -> None:
+        import signal
+
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            proc.wait(timeout=10)
+        except (ProcessLookupError, subprocess.TimeoutExpired, PermissionError):
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def terminate_node(self, node: NodeInfo) -> None:
+        if node.state == "terminated":
+            return
+        node.state = "terminated"
+        host = self._host_of.pop(node.node_id, "")
+        if self.terminate_cmd:
+            try:
+                subprocess.run(
+                    self._fmt(self.terminate_cmd, host, node.node_id, node.resources, None),
+                    shell=True,
+                    timeout=30,
+                )
+            except (subprocess.TimeoutExpired, OSError):
+                pass  # dead host: the runner kill below is the fallback
+        self._kill_runner(node.handle)
+        self.nodes.pop(node.node_id, None)
+
+    def non_terminated_nodes(self) -> List[NodeInfo]:
+        alive = {
+            n["node_id"]: n["alive"] for n in self.w.head_call("nodes")["nodes"]
+        }
+        for n in list(self.nodes.values()):
+            if not alive.get(n.node_id, False):
+                # head declared it dead (crash, network cut): reflect that
+                # so the reconciler relaunches; free its host slot
+                n.state = "terminated"
+                self._host_of.pop(n.node_id, None)
+                self.nodes.pop(n.node_id, None)
+        return [n for n in self.nodes.values() if n.state != "terminated"]
+
+
 class AgentNodeProvider(NodeProvider):
     """Launches REAL node-agent processes against the connected cluster —
     each autoscaled "node" is a full raylet-analogue with its own worker
@@ -152,6 +291,8 @@ class AgentNodeProvider(NodeProvider):
         env["CA_HEAD_ADDR"] = self.head_tcp
         env["CA_NODE_ID"] = node_id
         env["CA_NODE_RESOURCES"] = self._json.dumps(shape)
+        if node_type.labels:
+            env["CA_NODE_LABELS"] = self._json.dumps(node_type.labels)
         env["CA_CONFIG_JSON"] = self.w.config.to_json()
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -40,6 +40,42 @@ def cmd_start(args):
     _api._head_proc = None  # leave the head running
 
 
+def cmd_join(args):
+    """Join THIS host to a running cluster as a node (foreground agent) —
+    the command an SSH/command-runner provider executes on each machine
+    (reference `ray start --address=...` worker-node role).
+
+        ca join --head tcp:headhost:6379 --num-cpus 8 \\
+                --labels '{"zone": "a"}'
+    """
+    import json as _json
+    import uuid as _uuid
+
+    from cluster_anywhere_tpu.core.config import CAConfig
+
+    node_id = args.node_id or f"host-{_uuid.uuid4().hex[:6]}"
+    root = args.session_root or CAConfig().session_dir_root
+    sdir = os.path.join(root, f"joined_{node_id}")
+    os.makedirs(sdir, exist_ok=True)
+    os.environ["CA_SESSION_DIR"] = sdir
+    os.environ["CA_HEAD_ADDR"] = args.head
+    os.environ["CA_NODE_ID"] = node_id
+    shape = {"CPU": float(args.num_cpus)}
+    if args.num_tpus:
+        shape["TPU"] = float(args.num_tpus)
+    if args.resources:
+        shape.update({k: float(v) for k, v in _json.loads(args.resources).items()})
+    shape.setdefault("memory", float(CAConfig().object_store_memory))
+    os.environ["CA_NODE_RESOURCES"] = _json.dumps(shape)
+    if args.labels:
+        os.environ["CA_NODE_LABELS"] = args.labels
+    os.environ.setdefault("CA_CONFIG_JSON", CAConfig().to_json())
+    from cluster_anywhere_tpu.core.nodeagent import main as agent_main
+
+    print(f"joining {args.head} as node {node_id} with {shape}")
+    agent_main()
+
+
 def cmd_up(args):
     """Bring up a cluster from a YAML config (reference `ray up` role, local
     provider semantics: the head plus N agent nodes on this host).
@@ -289,6 +325,16 @@ def main(argv=None):
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("join", help="join this host to a cluster as a node")
+    sp.add_argument("--head", required=True, help="head TCP address (tcp:host:port)")
+    sp.add_argument("--node-id", default=None)
+    sp.add_argument("--num-cpus", type=float, default=4)
+    sp.add_argument("--num-tpus", type=float, default=0)
+    sp.add_argument("--resources", default=None, help="extra resources, JSON")
+    sp.add_argument("--labels", default=None, help="node labels, JSON")
+    sp.add_argument("--session-root", default=None)
+    sp.set_defaults(fn=cmd_join)
 
     sp = sub.add_parser("up", help="bring up a cluster from a YAML config")
     sp.add_argument("config", help="path to the cluster YAML")

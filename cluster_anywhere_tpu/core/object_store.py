@@ -590,6 +590,26 @@ class ShmObjectStore:
             return
         arena.free_slice(entry[0], entry[1])
 
+    def abort_import(self, shm_name: str) -> None:
+        """Reclaim an import allocation whose fill failed (dropped pull,
+        serialization error): arena slices go through free_local; dedicated
+        segments (huge objects — no '@' in the name) unlink their file and
+        drop the writable mapping, which free_local deliberately ignores."""
+        if "@" in shm_name:
+            self.free_local(shm_name)
+            return
+        with self._lock:
+            cached = self._open_maps.pop(shm_name, None)
+        if cached is not None:
+            try:
+                cached[0].close()
+            except (BufferError, OSError):
+                pass  # exported views: the mapping closes when they drop
+        try:
+            os.unlink(os.path.join(SHM_DIR, shm_name))
+        except OSError:
+            pass
+
     def put(self, oid: ObjectID, value: Any) -> Tuple[str, int]:
         data, buffers = serialization.serialize(value)
         return self.create_and_pack(oid, data, [b.raw() for b in buffers])

@@ -1243,7 +1243,7 @@ class Worker:
                 off += len(data)
         except BaseException:
             mv.release()
-            self.shm_store.free_local(local_name)  # aborted pull: reclaim
+            self.shm_store.abort_import(local_name)  # aborted pull: reclaim
             raise
         mv.release()
         self.shm_store.seal_done(local_name)
@@ -1520,7 +1520,7 @@ class Worker:
                             mv[:] = e.packed
                         except BaseException:
                             mv.release()
-                            self.shm_store.free_local(name)
+                            self.shm_store.abort_import(name)
                             raise
                         mv.release()
                         self.shm_store.seal_done(name)

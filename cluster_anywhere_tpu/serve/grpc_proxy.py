@@ -88,7 +88,7 @@ class GrpcProxyActor:
                         try:
                             app, payload = proto_wire.decode_call_request(request_bytes)
                             args, kwargs = msgpack.unpackb(payload, raw=False)
-                        except (ValueError, msgpack.UnpackException) as e:
+                        except (ValueError, TypeError, msgpack.UnpackException) as e:
                             # malformed bytes from a non-Python client must
                             # say so, not surface as UNKNOWN with no detail
                             context.abort(

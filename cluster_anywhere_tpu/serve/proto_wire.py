@@ -71,8 +71,12 @@ def _parse_fields(buf: bytes) -> Dict[int, List[bytes]]:
             _, pos = _read_varint(buf, pos)
         elif wt == 5:  # fixed32
             pos += 4
+            if pos > len(buf):
+                raise ValueError("truncated fixed32 field")
         elif wt == 1:  # fixed64
             pos += 8
+            if pos > len(buf):
+                raise ValueError("truncated fixed64 field")
         else:
             raise ValueError(f"unsupported wire type {wt}")
     return out

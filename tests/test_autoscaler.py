@@ -4,6 +4,7 @@ through synthetic cluster states with a fake provider; the integration test
 runs the LocalNodeProvider against a live head, including the
 shrink-while-busy negative-avail hazard at core/head.py _h_update_resources."""
 
+import os
 import time
 
 import pytest
@@ -290,3 +291,56 @@ def test_agent_provider_scales_real_nodes(ca_cluster):
             break
         time.sleep(0.5)
     assert not alive, "terminated agent node still alive in the node table"
+
+
+def test_command_runner_provider_launches_via_shell(ca_cluster):
+    """CommandRunnerNodeProvider: nodes launch by executing a COMMAND
+    template — the seam an SSH deployment fills with `ssh {host} 'ca join
+    ...'`; here the command is a local `ca join`, which exercises the exact
+    CLI a remote host would run.  Liveness is judged by the head's node
+    table; terminate kills the runner and the head notices the death."""
+    import sys as _sys
+
+    from cluster_anywhere_tpu.autoscaler.provider import (
+        CommandRunnerNodeProvider,
+        NodeType,
+    )
+    from cluster_anywhere_tpu.core.worker import global_worker
+    from cluster_anywhere_tpu.util.state import list_nodes
+
+    scratch = os.path.join(global_worker().session_dir, "joinroot")
+    launch = (
+        f"{_sys.executable} -m cluster_anywhere_tpu.cli join "
+        "--head {head_addr} --node-id {node_id} --num-cpus 2 "
+        "--resources {resources_json} "
+        f"--session-root {scratch}"
+    )
+    provider = CommandRunnerNodeProvider(
+        hosts=["localhost-a", "localhost-b"], launch_cmd=launch
+    )
+    info = provider.create_node(NodeType("cpu2", {"CPU": 2.0}))
+    assert any(
+        n["node_id"] == info.node_id and n["alive"] for n in list_nodes()
+    )
+    # tasks run on the joined node
+    @ca.remote
+    def where():
+        return os.environ.get("CA_NODE_ID", "n0")
+
+    got = ca.get(
+        where.options(
+            scheduling_strategy=ca.NodeAffinitySchedulingStrategy(info.node_id)
+        ).remote(),
+        timeout=60,
+    )
+    assert got == info.node_id
+    # host pool: one host used, one free
+    assert len(provider.non_terminated_nodes()) == 1
+    provider.terminate_node(info)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        rec = [n for n in list_nodes() if n["node_id"] == info.node_id]
+        if not rec or not rec[0]["alive"]:
+            break
+        time.sleep(0.3)
+    assert not rec or not rec[0]["alive"], "head still thinks the node is alive"
