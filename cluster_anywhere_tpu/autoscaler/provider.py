@@ -127,9 +127,10 @@ class CommandRunnerNodeProvider(NodeProvider):
     command_runner.py SSHCommandRunner role).  The provider knows nothing
     about transport: `launch_cmd` is typically
     ``ssh {host} 'ca join --head {head_addr} --node-id {node_id}
-    --resources {resources_json}'`` against a pool of machines, but any
-    shell command that ends with the node registering at the head works
-    (tests use a local `ca join`).
+    --resources {resources_json}'`` with ``quote_levels=2`` (the JSON
+    traverses the local AND remote shell) against a pool of machines, but
+    any shell command that ends with the node registering at the head
+    works (tests use a local `ca join` with the default quote_levels=1).
 
     Template variables: {host} {node_id} {head_addr} {resources_json}
     {labels_json}.  Liveness is judged by the HEAD's node table, not the
@@ -143,7 +144,11 @@ class CommandRunnerNodeProvider(NodeProvider):
         launch_cmd: str,
         terminate_cmd: Optional[str] = None,
         wait_s: float = 60.0,
+        quote_levels: int = 1,
     ):
+        """quote_levels: how many shells the JSON template values traverse —
+        1 for a local command, 2 for `ssh host '...'` (the remote shell
+        word-splits again, so values need one more quoting layer)."""
         from ..core.worker import global_worker
 
         self.w = global_worker()
@@ -155,6 +160,7 @@ class CommandRunnerNodeProvider(NodeProvider):
         self.launch_cmd = launch_cmd
         self.terminate_cmd = terminate_cmd
         self.wait_s = wait_s
+        self.quote_levels = max(1, int(quote_levels))
         self._host_of: Dict[str, str] = {}  # node_id -> host
         self.nodes: Dict[str, NodeInfo] = {}
 
@@ -168,12 +174,17 @@ class CommandRunnerNodeProvider(NodeProvider):
         import json
         import shlex
 
+        def q(s: str) -> str:
+            for _ in range(self.quote_levels):
+                s = shlex.quote(s)
+            return s
+
         return template.format(
             host=host,
             node_id=node_id,
             head_addr=self.head_tcp,
-            resources_json=shlex.quote(json.dumps(shape)),
-            labels_json=shlex.quote(json.dumps(labels or {})),
+            resources_json=q(json.dumps(shape)),
+            labels_json=q(json.dumps(labels or {})),
         )
 
     def create_node(self, node_type: NodeType) -> NodeInfo:
@@ -251,8 +262,10 @@ class CommandRunnerNodeProvider(NodeProvider):
         }
         for n in list(self.nodes.values()):
             if not alive.get(n.node_id, False):
-                # head declared it dead (crash, network cut): reflect that
-                # so the reconciler relaunches; free its host slot
+                # head declared it dead (crash, network cut): kill the
+                # runner BEFORE freeing the host slot, or a lingering agent
+                # would share the host with the reconciler's relaunch
+                self._kill_runner(n.handle)
                 n.state = "terminated"
                 self._host_of.pop(n.node_id, None)
                 self.nodes.pop(n.node_id, None)

@@ -9,6 +9,14 @@ AlgorithmConfig, EnvRunner actors, jax Learners; PPO + DQN + IMPALA).
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .connectors import (
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    Lambda,
+    RescaleActions,
+    RunningObsNormalizer,
+)
 from .buffer import PrioritizedReplayBuffer, ReplayBuffer
 from .env import CartPole, Env, MemoryChain, Pendulum, VectorEnv, make_env, register_env
 from .env_runner import EnvRunner
@@ -39,6 +47,12 @@ from .multi_agent import (
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "Connector",
+    "ConnectorPipeline",
+    "Lambda",
+    "ClipObs",
+    "RunningObsNormalizer",
+    "RescaleActions",
     "Env",
     "CartPole",
     "Pendulum",

@@ -51,6 +51,11 @@ class AlgorithmConfig:
         # recurrent policy (PPO): GRU core instead of the plain MLP
         self.use_lstm = False
         self.lstm_hidden = 64
+        # connector pipelines (rllib/connectors/): factories returning a
+        # ConnectorPipeline (or list of connectors); obs transforms run
+        # before every policy forward, action transforms before env.step
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
         # sac
         self.tau = 0.005
         self.target_entropy = None  # default: -action_dim
@@ -196,7 +201,9 @@ class Algorithm:
                 module_spec,
                 num_envs=config.num_envs_per_runner,
                 seed=config.seed + 100 * i,
-                explore="sample" if kind == "policy" else "epsilon",
+                explore="sample" if kind in ("policy", "recurrent") else "epsilon",
+                env_to_module=config.env_to_module_connector,
+                module_to_env=config.module_to_env_connector,
             )
             for i in range(config.num_env_runners)
         ]
@@ -363,12 +370,34 @@ class Algorithm:
         from ..llm import _params_io
 
         _params_io.save_params({"weights": self.learner.get_weights()}, path)
+        # connector stats (e.g. obs normalizer) are part of the policy: a
+        # restored policy without them sees differently-scaled observations.
+        # Side file (pickle): the state nests lists/None, which the flat
+        # npz params format doesn't model
+        cs = ca.get(self.runners[0].connector_state.remote())
+        if cs is not None:
+            import pickle
+
+            with open(os.path.join(path, "connectors.pkl"), "wb") as f:
+                pickle.dump(cs, f)
         return path
 
     def load(self, path: str):
         from ..llm import _params_io
 
         self.learner.params = _params_io.load_params(path)["weights"]
+        cpath = os.path.join(path, "connectors.pkl")
+        if os.path.exists(cpath):
+            import pickle
+
+            with open(cpath, "rb") as f:
+                cs = pickle.load(f)
+            ca.get(
+                [
+                    r.set_weights.remote(self.learner.get_weights(), None, cs)
+                    for r in self.runners
+                ]
+            )
         self._broadcast()
 
     def stop(self):

@@ -17,11 +17,38 @@ class EnvRunner:
         num_envs: int = 4,
         seed: int = 0,
         explore: str = "sample",  # sample | epsilon
+        env_to_module=None,  # connector factory: obs pipeline (rllib connectors)
+        module_to_env=None,  # connector factory: action pipeline
     ):
         import jax
 
+        from .connectors import ConnectorPipeline
         from .env import VectorEnv
         from .module import DiscretePolicyModule, QModule
+
+        def _mk(factory) -> Optional[ConnectorPipeline]:
+            """Accepts: None | pipeline | Connector | list of connectors |
+            zero-arg FACTORY returning any of those.  A bare function is
+            treated as a factory — wrap batch transforms in rl.Lambda."""
+            from .connectors import Connector
+
+            if factory is None:
+                return None
+            if isinstance(factory, (ConnectorPipeline, Connector, list, tuple)):
+                made = factory
+            elif callable(factory):
+                made = factory()
+            else:
+                made = factory
+            if isinstance(made, ConnectorPipeline):
+                return made
+            return ConnectorPipeline(made if isinstance(made, (list, tuple)) else [made])
+
+        # env->module obs pipeline runs before EVERY policy forward (sample,
+        # bootstrap, evaluate) and rollouts store the TRANSFORMED obs, so
+        # training sees exactly what the policy saw
+        self.obs_pipe = _mk(env_to_module)
+        self.act_pipe = _mk(module_to_env)
 
         self.env_spec = env_spec
         self.vec = VectorEnv(env_spec, num_envs, seed)
@@ -71,11 +98,36 @@ class EnvRunner:
             )
             self._jit_value = jax.jit(self.module.value) if kind == "policy" else None
 
-    def set_weights(self, params, epsilon: Optional[float] = None):
+    def set_weights(self, params, epsilon: Optional[float] = None, connector_state=None):
         self.params = params
         if epsilon is not None:
             self.epsilon = epsilon
+        if connector_state is not None:
+            if self.obs_pipe is not None:
+                self.obs_pipe.set_state(connector_state.get("obs"))
+            if self.act_pipe is not None:
+                self.act_pipe.set_state(connector_state.get("act"))
         return "ok"
+
+    def connector_state(self):
+        """Both pipelines' state (stateful action connectors checkpoint
+        too); None when nothing is stateful."""
+        state = {}
+        if self.obs_pipe is not None:
+            s = self.obs_pipe.get_state()
+            if s is not None:
+                state["obs"] = s
+        if self.act_pipe is not None:
+            s = self.act_pipe.get_state()
+            if s is not None:
+                state["act"] = s
+        return state or None
+
+    def _obs_t(self, obs):
+        return self.obs_pipe(obs) if self.obs_pipe is not None else obs
+
+    def _act_t(self, actions):
+        return self.act_pipe(actions) if self.act_pipe is not None else actions
 
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
         """Collect num_steps per env. Returns flat [T*N, ...] arrays plus
@@ -86,7 +138,7 @@ class EnvRunner:
             return self._sample_recurrent(num_steps)
         obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
         for _ in range(num_steps):
-            obs = self.vec.obs
+            obs = self._obs_t(self.vec.obs)
             if self.kind == "gaussian":
                 import jax
 
@@ -116,7 +168,7 @@ class EnvRunner:
                 actions = np.where(mask, rand, greedy).astype(np.int32)
                 logp = np.zeros(len(actions), np.float32)
                 values = np.zeros(len(actions), np.float32)
-            next_obs, rewards, dones = self.vec.step(actions)
+            next_obs, rewards, dones = self.vec.step(self._act_t(actions))
             obs_l.append(obs)
             act_l.append(actions)
             rew_l.append(rewards)
@@ -128,7 +180,7 @@ class EnvRunner:
             last_values = np.zeros(self.vec.num_envs, np.float32)
         elif self.kind == "policy":
             last_values = np.asarray(
-                self._jit_value(self.params, jnp.asarray(self.vec.obs))
+                self._jit_value(self.params, jnp.asarray(self._obs_t(self.vec.obs)))
             )
         else:
             last_values = np.zeros(self.vec.num_envs, np.float32)
@@ -155,12 +207,12 @@ class EnvRunner:
         state0 = self.state.copy()
         obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
         for _ in range(num_steps):
-            obs = self.vec.obs
+            obs = self._obs_t(self.vec.obs)
             logits, values, new_state = self._jit_step(
                 self.params, jnp.asarray(obs), jnp.asarray(self.state)
             )
             actions, logp = softmax_sample(self.rng, np.asarray(logits))
-            next_obs, rewards, dones = self.vec.step(actions)
+            next_obs, rewards, dones = self.vec.step(self._act_t(actions))
             self.state = np.array(new_state)  # copy: jax buffers are read-only
             self.state[dones.astype(bool)] = 0.0
             obs_l.append(obs)
@@ -170,7 +222,7 @@ class EnvRunner:
             logp_l.append(logp)
             val_l.append(np.asarray(values))
         _, last_values, _ = self._jit_step(
-            self.params, jnp.asarray(self.vec.obs), jnp.asarray(self.state)
+            self.params, jnp.asarray(self._obs_t(self.vec.obs)), jnp.asarray(self.state)
         )
         return {
             "obs": np.stack(obs_l),
@@ -193,26 +245,43 @@ class EnvRunner:
         from .env import make_env
 
         env = make_env(self.env_spec)
-        total = 0.0
-        for ep in range(num_episodes):
-            obs = env.reset(seed=1000 + ep)
-            done, ret = False, 0.0
-            if self.kind == "recurrent":
-                state = self.module.initial_state(1)
-            while not done:
-                if self.kind == "gaussian":
-                    a = np.asarray(self._jit_mean(self.params, jnp.asarray(obs[None])))[0]
-                    obs, r, done, _ = env.step(a)
-                elif self.kind == "recurrent":
-                    logits, _, state = self._jit_step(
-                        self.params, jnp.asarray(obs[None]), jnp.asarray(state)
-                    )
-                    obs, r, done, _ = env.step(int(np.asarray(logits)[0].argmax()))
-                else:
-                    out = np.asarray(
-                        self._jit_logits(self.params, jnp.asarray(obs[None]))
-                    )
-                    obs, r, done, _ = env.step(int(out[0].argmax()))
-                ret += r
-            total += ret
-        return total / num_episodes
+        # freeze stateful obs connectors during eval, restoring each
+        # connector's PRIOR flag after (a user-frozen normalizer must not
+        # be silently re-enabled by an evaluate() call)
+        saved_flags = []
+        if self.obs_pipe is not None:
+            for c in self.obs_pipe.connectors:
+                if hasattr(c, "update"):
+                    saved_flags.append((c, c.update))
+                    c.update = False
+        try:
+            total = 0.0
+            for ep in range(num_episodes):
+                obs = env.reset(seed=1000 + ep)
+                done, ret = False, 0.0
+                if self.kind == "recurrent":
+                    state = self.module.initial_state(1)
+                while not done:
+                    tobs = self._obs_t(obs[None])
+                    if self.kind == "gaussian":
+                        a = np.asarray(self._jit_mean(self.params, jnp.asarray(tobs)))[0]
+                        act = self._act_t(a[None])[0]
+                    elif self.kind == "recurrent":
+                        logits, _, state = self._jit_step(
+                            self.params, jnp.asarray(tobs), jnp.asarray(state)
+                        )
+                        # action connector applies in eval exactly as in
+                        # sampling — same policy, same executed actions
+                        act = int(self._act_t(np.asarray(logits).argmax(-1))[0])
+                    else:
+                        out = np.asarray(
+                            self._jit_logits(self.params, jnp.asarray(tobs))
+                        )
+                        act = int(self._act_t(out.argmax(-1))[0])
+                    obs, r, done, _ = env.step(act)
+                    ret += r
+                total += ret
+            return total / num_episodes
+        finally:
+            for c, flag in saved_flags:
+                c.update = flag
