@@ -196,6 +196,13 @@ class CommandRunnerNodeProvider(NodeProvider):
         node_id = f"cr-{uuid.uuid4().hex[:8]}"
         shape = dict(node_type.resources)
         shape.setdefault("memory", float(self.w.config.object_store_memory))
+        if node_type.labels and "{labels_json}" not in self.launch_cmd:
+            # fail loud: silently launching without the labels would strand
+            # every NodeLabelSchedulingStrategy targeting this node type
+            raise ValueError(
+                f"node type {node_type.name!r} has labels but launch_cmd has no "
+                "{labels_json} placeholder to carry them"
+            )
         cmd = self._fmt(self.launch_cmd, host, node_id, shape, node_type.labels)
         logf = open(os.path.join(self.session_dir, f"runner-{node_id}.log"), "ab")
         proc = subprocess.Popen(

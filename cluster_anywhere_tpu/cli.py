@@ -77,19 +77,29 @@ def cmd_join(args):
 
 
 def cmd_up(args):
-    """Bring up a cluster from a YAML config (reference `ray up` role, local
-    provider semantics: the head plus N agent nodes on this host).
+    """Bring up a cluster from a YAML config (reference `ray up` role: local
+    provider by default, or a command-runner provider for real machines).
 
     Config shape:
         head: {num_cpus: 4, num_tpus: 0}
+        provider:            # optional; omit for local agent nodes
+          type: command      # ssh/command-runner seam
+          hosts: [host-a, host-b]
+          launch_cmd: "ssh {host} 'ca join --head {head_addr} --node-id {node_id} --resources {resources_json} --labels {labels_json}'"
+          terminate_cmd: "..."   # optional
+          quote_levels: 2        # shells the JSON traverses (2 for ssh)
         nodes:
-          - {count: 2, num_cpus: 2}
+          - {count: 2, num_cpus: 2, labels: {zone: a}}
           - {count: 1, num_cpus: 1, resources: {fast_disk: 1}}
     """
     import yaml
 
     import cluster_anywhere_tpu as ca
-    from cluster_anywhere_tpu.autoscaler.provider import AgentNodeProvider, NodeType
+    from cluster_anywhere_tpu.autoscaler.provider import (
+        AgentNodeProvider,
+        CommandRunnerNodeProvider,
+        NodeType,
+    )
 
     with open(args.config) as f:
         cfg = yaml.safe_load(f) or {}
@@ -99,7 +109,17 @@ def cmd_up(args):
         num_cpus=head.get("num_cpus"), num_tpus=head.get("num_tpus")
     )
     print(f"head up at {info['session_dir']}")
-    provider = AgentNodeProvider()
+    pspec = cfg.get("provider") or {}
+    if pspec.get("type") == "command":
+        provider = CommandRunnerNodeProvider(
+            hosts=pspec["hosts"],
+            launch_cmd=pspec["launch_cmd"],
+            terminate_cmd=pspec.get("terminate_cmd"),
+            wait_s=float(pspec.get("wait_s", 60)),
+            quote_levels=int(pspec.get("quote_levels", 1)),
+        )
+    else:
+        provider = AgentNodeProvider()
     n_started = 0
     for spec in cfg.get("nodes") or []:
         shape = {"CPU": float(spec.get("num_cpus", 2))}
@@ -107,7 +127,9 @@ def cmd_up(args):
             shape["TPU"] = float(spec["num_tpus"])
         shape.update({k: float(v) for k, v in (spec.get("resources") or {}).items()})
         for _ in range(int(spec.get("count", 1))):
-            node = provider.create_node(NodeType("yaml", shape))
+            node = provider.create_node(
+                NodeType("yaml", shape, labels=spec.get("labels"))
+            )
             n_started += 1
             print(f"node {node.node_id} up: {shape}")
     from cluster_anywhere_tpu.core.worker import global_worker

@@ -25,9 +25,15 @@ from .learner import (
     IMPALALearner,
     PPOLearner,
     RecurrentPPOLearner,
+    TD3Learner,
     compute_gae,
 )
-from .module import DiscretePolicyModule, QModule, RecurrentPolicyModule
+from .module import (
+    DeterministicPolicyModule,
+    DiscretePolicyModule,
+    QModule,
+    RecurrentPolicyModule,
+)
 from .offline import (
     BCLearner,
     CQLLearner,
@@ -75,11 +81,13 @@ __all__ = [
     "DQNLearner",
     "IMPALALearner",
     "RecurrentPPOLearner",
+    "TD3Learner",
     "compute_gae",
     "ReplayBuffer",
     "PrioritizedReplayBuffer",
     "DiscretePolicyModule",
     "QModule",
     "RecurrentPolicyModule",
+    "DeterministicPolicyModule",
     "MemoryChain",
 ]

@@ -59,6 +59,11 @@ class AlgorithmConfig:
         # sac
         self.tau = 0.005
         self.target_entropy = None  # default: -action_dim
+        # td3
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.noise_clip = 0.5
+        self.exploration_noise = 0.1
 
     def environment(self, env) -> "AlgorithmConfig":
         self.env = env
@@ -87,6 +92,8 @@ class Algorithm:
         obs_dim, num_actions = probe.observation_dim, probe.num_actions
         if config.algo == "SAC":
             kind = "gaussian"
+        elif config.algo == "TD3":
+            kind = "deterministic"
         elif config.algo == "PPO" and config.use_lstm:
             kind = "recurrent"
         elif config.algo in ("PPO", "IMPALA", "APPO"):
@@ -100,9 +107,11 @@ class Algorithm:
             "hidden": config.hidden,
             "lstm_hidden": config.lstm_hidden,
         }
-        if kind == "gaussian":
+        if kind in ("gaussian", "deterministic"):
             module_spec["action_dim"] = probe.action_dim
             module_spec["action_scale"] = getattr(probe, "action_scale", 1.0)
+        if kind == "deterministic":
+            module_spec["explore_noise"] = config.exploration_noise
         if kind == "recurrent":
             from .learner import RecurrentPPOLearner
             from .module import RecurrentPolicyModule
@@ -176,6 +185,30 @@ class Algorithm:
                 gamma=config.gamma,
                 tau=config.tau,
                 target_entropy=config.target_entropy,
+                seed=config.seed,
+            )
+            self.buffer = ReplayBuffer(
+                config.buffer_capacity, obs_dim, config.seed,
+                action_dim=probe.action_dim,
+            )
+        elif config.algo == "TD3":
+            from .buffer import ReplayBuffer
+            from .learner import TD3Learner
+            from .module import DeterministicPolicyModule, TwinQModule
+
+            self.module = DeterministicPolicyModule(
+                obs_dim, probe.action_dim,
+                getattr(probe, "action_scale", 1.0), config.hidden,
+            )
+            self.learner = TD3Learner(
+                self.module,
+                TwinQModule(obs_dim, probe.action_dim, config.hidden),
+                lr=config.lr,
+                gamma=config.gamma,
+                tau=config.tau,
+                policy_delay=config.policy_delay,
+                target_noise=config.target_noise,
+                noise_clip=config.noise_clip,
                 seed=config.seed,
             )
             self.buffer = ReplayBuffer(

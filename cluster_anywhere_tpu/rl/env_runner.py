@@ -74,6 +74,15 @@ class EnvRunner:
                 module_spec.get("action_scale", 1.0),
                 module_spec.get("hidden", (64, 64)),
             )
+        elif kind == "deterministic":
+            from .module import DeterministicPolicyModule
+
+            self.module = DeterministicPolicyModule(
+                module_spec["obs_dim"], module_spec["action_dim"],
+                module_spec.get("action_scale", 1.0),
+                module_spec.get("hidden", (64, 64)),
+            )
+            self.explore_noise = float(module_spec.get("explore_noise", 0.1))
         else:
             self.module = QModule(
                 module_spec["obs_dim"], module_spec["num_actions"],
@@ -87,6 +96,10 @@ class EnvRunner:
         if kind == "gaussian":
             self._sample_key = jax.random.key(seed + 2)
             self._jit_sample = jax.jit(self.module.sample)
+            self._jit_mean = jax.jit(self.module.mean_action)
+            self._jit_logits = None
+            self._jit_value = None
+        elif kind == "deterministic":
             self._jit_mean = jax.jit(self.module.mean_action)
             self._jit_logits = None
             self._jit_value = None
@@ -147,6 +160,15 @@ class EnvRunner:
                 actions = np.asarray(act, np.float32)
                 logp = np.zeros(len(actions), np.float32)
                 values = np.zeros(len(actions), np.float32)
+            elif self.kind == "deterministic":
+                # TD3 exploration: Gaussian noise on the deterministic
+                # action, clipped into the action box
+                mu = np.asarray(self._jit_mean(self.params, jnp.asarray(obs)))
+                scale = self.module.action_scale
+                noise = self.rng.normal(0.0, self.explore_noise * scale, mu.shape)
+                actions = np.clip(mu + noise, -scale, scale).astype(np.float32)
+                logp = np.zeros(len(actions), np.float32)
+                values = np.zeros(len(actions), np.float32)
             elif self.kind == "policy":
                 from .module import softmax_sample
 
@@ -192,7 +214,7 @@ class EnvRunner:
             "logp": np.stack(logp_l),          # [T, N]
             "values": np.stack(val_l),         # [T, N]
             "last_values": last_values,        # [N]
-            "next_obs": self.vec.obs.copy(),   # [N, D]
+            "next_obs": self._obs_t(self.vec.obs).copy(),  # [N, D] (transformed like obs)
             "metrics": self.vec.drain_metrics(),
         }
 
@@ -232,7 +254,7 @@ class EnvRunner:
             "logp": np.stack(logp_l),
             "values": np.stack(val_l),
             "last_values": np.asarray(last_values),
-            "next_obs": self.vec.obs.copy(),
+            "next_obs": self._obs_t(self.vec.obs).copy(),
             "state0": state0,
             "metrics": self.vec.drain_metrics(),
         }
@@ -263,7 +285,7 @@ class EnvRunner:
                     state = self.module.initial_state(1)
                 while not done:
                     tobs = self._obs_t(obs[None])
-                    if self.kind == "gaussian":
+                    if self.kind in ("gaussian", "deterministic"):
                         a = np.asarray(self._jit_mean(self.params, jnp.asarray(tobs)))[0]
                         act = self._act_t(a[None])[0]
                     elif self.kind == "recurrent":

@@ -489,3 +489,136 @@ class SACLearner:
             self.params, self.target, self.opt_state, jb, self._key
         )
         return {k: float(v) for k, v in aux.items()}
+
+
+class TD3Learner:
+    """Twin Delayed DDPG (reference rllib/algorithms/td3): deterministic
+    actor, clipped double-Q critics, target-policy smoothing (clipped
+    Gaussian noise on the target action), and DELAYED actor/target updates
+    (policy_delay critic steps per actor step).  One compiled XLA program
+    per update; the actor branch is gated by lax.cond on the step counter
+    so delay needs no retrace."""
+
+    def __init__(
+        self,
+        policy_module,
+        q_module,
+        *,
+        lr: float = 3e-4,
+        gamma: float = 0.99,
+        tau: float = 0.005,
+        policy_delay: int = 2,
+        target_noise: float = 0.2,
+        noise_clip: float = 0.5,
+        seed: int = 0,
+    ):
+        import optax
+
+        self.policy = policy_module
+        self.qnet = q_module
+        self.gamma = gamma
+        self.tau = tau
+        self.policy_delay = max(1, int(policy_delay))
+        scale = policy_module.action_scale
+        kp, kq = jax.random.split(jax.random.key(seed))
+        self.params = {**policy_module.init(kp), **q_module.init(kq)}
+        self.target = jax.tree.map(lambda x: x, self.params)
+        # SEPARATE optimizers: a shared Adam would keep moving the actor on
+        # critic-only steps via accumulated momentum (zero grad != zero
+        # update), silently defeating the delay
+        self.opt_c = optax.adam(lr)
+        self.opt_a = optax.adam(lr)
+        self.opt_c_state = self.opt_c.init({k: self.params[k] for k in ("q1", "q2")})
+        self.opt_a_state = self.opt_a.init({"mu": self.params["mu"]})
+        self._key = jax.random.key(seed + 1)
+        self.steps = 0
+
+        def critic_loss_fn(params, target, batch, key):
+            # target-policy smoothing: noise on the TARGET action, clipped,
+            # then clipped back into the action box
+            next_mu = policy_module.mean_action(target, batch["next_obs"])
+            noise = jnp.clip(
+                jax.random.normal(key, next_mu.shape) * target_noise * scale,
+                -noise_clip * scale,
+                noise_clip * scale,
+            )
+            next_act = jnp.clip(next_mu + noise, -scale, scale)
+            tq1, tq2 = q_module.q(target, batch["next_obs"], next_act)
+            y = batch["rewards"] + self.gamma * (1.0 - batch["dones"]) * jnp.minimum(
+                tq1, tq2
+            )
+            y = jax.lax.stop_gradient(y)
+            q1, q2 = q_module.q(params, batch["obs"], batch["actions"])
+            return jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+
+        def actor_loss_fn(params, batch):
+            act = policy_module.mean_action(params, batch["obs"])
+            q1, _ = q_module.q(
+                jax.lax.stop_gradient({k: params[k] for k in ("q1", "q2")}),
+                batch["obs"],
+                act,
+            )
+            return -jnp.mean(q1)
+
+        def update_step(params, target, opt_c_state, opt_a_state, batch, key, step):
+            import optax as _optax
+
+            key, kn = jax.random.split(key)
+            closs, cgrads = jax.value_and_grad(critic_loss_fn)(
+                params, target, batch, kn
+            )
+            c_upd, opt_c_state = self.opt_c.update(
+                {k: cgrads[k] for k in ("q1", "q2")}, opt_c_state
+            )
+            new_q = _optax.apply_updates({k: params[k] for k in ("q1", "q2")}, c_upd)
+            do_actor = (step % self.policy_delay) == 0
+
+            def with_actor(operand):
+                mu, a_state = operand
+                aloss, agrads = jax.value_and_grad(actor_loss_fn)(params, batch)
+                a_upd, a_state = self.opt_a.update({"mu": agrads["mu"]}, a_state)
+                return (
+                    aloss,
+                    _optax.apply_updates({"mu": mu}, a_upd)["mu"],
+                    a_state,
+                )
+
+            def critics_only(operand):
+                mu, a_state = operand
+                return jnp.zeros(()), mu, a_state
+
+            aloss, new_mu, opt_a_state = jax.lax.cond(
+                do_actor, with_actor, critics_only, (params["mu"], opt_a_state)
+            )
+            params = {"mu": new_mu, **new_q}
+            # delayed target polyak, same cadence as the actor
+            target = jax.lax.cond(
+                do_actor,
+                lambda _: jax.tree.map(
+                    lambda t, p: (1 - self.tau) * t + self.tau * p, target, params
+                ),
+                lambda _: target,
+                None,
+            )
+            return params, target, opt_c_state, opt_a_state, closs, aloss, key
+
+        self._update = jax.jit(update_step)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+        return "ok"
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        (
+            self.params, self.target, self.opt_c_state, self.opt_a_state,
+            closs, aloss, self._key,
+        ) = self._update(
+            self.params, self.target, self.opt_c_state, self.opt_a_state, jb,
+            self._key, jnp.asarray(self.steps),
+        )
+        self.steps += 1
+        return {"critic_loss": float(closs), "actor_loss": float(aloss)}

@@ -205,6 +205,25 @@ class SquashedGaussianModule:
         return jnp.tanh(mean) * self.action_scale
 
 
+class DeterministicPolicyModule:
+    """Deterministic continuous policy mu(s) = tanh(mlp(s)) * scale (the
+    TD3/DDPG actor; reference rllib/algorithms/td3).  Exploration noise is
+    the runner's job (Gaussian on the action), not the module's."""
+
+    def __init__(self, obs_dim: int, action_dim: int, action_scale: float = 1.0,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_scale = float(action_scale)
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        return {"mu": init_mlp(key, (self.obs_dim, *self.hidden, self.action_dim))}
+
+    def mean_action(self, params, obs):
+        return jnp.tanh(mlp_forward(params["mu"], obs)) * self.action_scale
+
+
 class TwinQModule:
     """Two independent Q(s, a) critics over concatenated obs+action
     (clipped double-Q; reference sac_torch_model.py twin heads)."""
