@@ -18,7 +18,13 @@ from .processor import (
     ProcessorConfig,
     build_llm_processor,
 )
-from .serve_llm import LLMServer, build_llm_deployment
+from .continuous import ContinuousBatcher, Request
+from .serve_llm import (
+    ContinuousLLMServer,
+    LLMServer,
+    build_continuous_llm_deployment,
+    build_llm_deployment,
+)
 
 __all__ = [
     "ProcessorConfig",
@@ -28,4 +34,8 @@ __all__ = [
     "build_llm_processor",
     "LLMServer",
     "build_llm_deployment",
+    "ContinuousBatcher",
+    "Request",
+    "ContinuousLLMServer",
+    "build_continuous_llm_deployment",
 ]

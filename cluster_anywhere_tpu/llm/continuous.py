@@ -1,0 +1,285 @@
+"""Continuous batching for LLM decoding (the iteration-level scheduler the
+reference gets from its vLLM-backed `serve.llm` deployments —
+python/ray/llm's engine does exactly this; redesigned here for the XLA
+compilation model instead of paged CUDA kernels).
+
+The scheduler owns a fixed pool of decode SLOTS over one shared KV cache
+[L, S, T_max, KV, D].  Each slot runs one request; requests at different
+depths decode together in ONE jitted step whose shapes never change — slot
+count and cache length are static, per-row positions are traced — so
+admitting or finishing requests never recompiles anything:
+
+- admit: a queued request prefills (batch-1 program, prompt padded to a
+  bucket length to bound compile count) and its cache rows scatter into its
+  slot between decode steps.
+- decode: every live slot advances one token per step.  Per-row cache
+  positions/pads drive RoPE and masking; finished or empty slots still
+  compute (their lanes are garbage) but write only to their own frozen
+  cache rows, which the next admit fully overwrites.
+- finish: a slot frees the moment its request hits max_new_tokens or eos;
+  the next step() can admit into it immediately — no head-of-line batching
+  barrier, which is the whole point vs static generate() batching.
+
+Reference anchors: models/generate.py (single-position decode this
+generalizes), serve_llm.py (the deployment that drives it).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.generate import (
+    _block_decode_rowpos,
+    _rms_norm,
+    _sample,
+    prefill,
+)
+from ..models.transformer import TransformerConfig
+
+
+@dataclass
+class Request:
+    request_id: int
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: Optional[int] = None
+    # filled as the request runs
+    out_tokens: List[int] = field(default_factory=list)
+    slot: int = -1
+    done: bool = False
+
+
+def _sample_rowwise(logits, rngs, temps, top_ks):
+    """Per-row sampling with TRACED temperature and top-k (requests in one
+    decode batch carry their own knobs; a static top_k would force one value
+    per compiled program).  top_k <= 0 means no truncation; temp <= 0 means
+    greedy."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temps, 1e-6)[:, None]
+    scaled = logits / t
+    v = logits.shape[-1]
+    # traced top-k: k-th largest per row via a descending sort
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    kth_idx = jnp.clip(top_ks - 1, 0, v - 1)[:, None]
+    kth = jnp.take_along_axis(sorted_desc, kth_idx, axis=-1)
+    scaled = jnp.where((top_ks[:, None] > 0) & (scaled < kth), -1e30, scaled)
+    sampled = jax.vmap(lambda rng, row: jax.random.categorical(rng, row))(
+        rngs, scaled
+    ).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _decode_step_rowpos(params, cache, tokens, pos, pads, temps, top_ks, rngs, *, cfg):
+    """One token for every slot with PER-ROW cache positions.
+    tokens/pos/pads/temps/top_ks: [S]; rngs: [S] keys.  Returns
+    (next_tokens [S], cache).  The cache is donated: decode rewrites it in
+    place instead of copying [L,S,Tmax,KV,D] x2 per token."""
+    x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]  # [S,1,E]
+
+    def body(x, inputs):
+        bp, kc, vc = inputs
+        x, (kc, vc) = _block_decode_rowpos(bp, x, (kc, vc), pos, cfg, pads)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["ln_f"])
+    logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    nxt = _sample_rowwise(logits, rngs, temps, top_ks)
+    return nxt, {"k": k_all, "v": v_all}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _install_slot(cache, slot_k, slot_v, slot):
+    """Scatter one request's prefilled rows into its slot (on device)."""
+    return {
+        "k": cache["k"].at[:, slot].set(slot_k),
+        "v": cache["v"].at[:, slot].set(slot_v),
+    }
+
+
+class ContinuousBatcher:
+    """Iteration-level scheduler over a fixed slot pool (see module doc).
+
+    Drive it with submit() + step() (one decode iteration), or pump() until
+    a request finishes.  step() returns per-request newly produced tokens,
+    enabling token streaming per request while others keep decoding."""
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        *,
+        slots: int = 8,
+        t_max: int = 512,
+        prefill_buckets: (tuple) = (64, 128, 256),
+        top_k: int = 0,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.t_max = t_max
+        self.top_k = top_k
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self.cache = {
+            "k": jnp.zeros(
+                (cfg.n_layers, slots, t_max, cfg.n_kv_heads, cfg.d_head), cfg.dtype
+            ),
+            "v": jnp.zeros(
+                (cfg.n_layers, slots, t_max, cfg.n_kv_heads, cfg.d_head), cfg.dtype
+            ),
+        }
+        self._tokens = np.zeros(slots, np.int32)
+        self._pos = np.zeros(slots, np.int32)  # cache slot of the NEXT write
+        self._pads = np.zeros(slots, np.int32)
+        self._temps = np.zeros(slots, np.float32)
+        self._topks = np.zeros(slots, np.int32)
+        self._by_slot: List[Optional[Request]] = [None] * slots
+        self.queue: deque[Request] = deque()
+        # bounded: pump() drains it; step()-driven servers track their own
+        # Requests (an unbounded list would grow for the replica's lifetime)
+        self._completed: deque[Request] = deque(maxlen=4096)
+        self._ids = itertools.count(1)
+        self._rng = jax.random.key(0)
+        self.stats = {"admitted": 0, "finished": 0, "decode_steps": 0}
+
+    # ------------------------------------------------------------- interface
+    def submit(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        eos_id: Optional[int] = None,
+    ) -> Request:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.t_max:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the cache length {self.t_max}"
+            )
+        req = Request(
+            next(self._ids), prompt, int(max_new_tokens), float(temperature),
+            self.top_k if top_k is None else int(top_k), eos_id,
+        )
+        self.queue.append(req)
+        return req
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(r is not None for r in self._by_slot)
+
+    def step(self) -> Dict[int, List[int]]:
+        """Admit into free slots, then decode one token on every live slot.
+        Returns {request_id: [new tokens this step]} — including the
+        prefill-sampled first token of requests admitted this step, so
+        streaming consumers see every token exactly once."""
+        out: Dict[int, List[int]] = {}
+        self._admit(out)
+        live = [s for s, r in enumerate(self._by_slot) if r is not None]
+        if not live:
+            return out
+        self._rng, *keys = jax.random.split(self._rng, self.slots + 1)
+        nxt, self.cache = _decode_step_rowpos(
+            self.params,
+            self.cache,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._pads),
+            jnp.asarray(self._temps),
+            jnp.asarray(self._topks),
+            jnp.stack(keys),
+            cfg=self.cfg,
+        )
+        nxt = np.asarray(nxt)
+        self.stats["decode_steps"] += 1
+        for s in live:
+            req = self._by_slot[s]
+            tok = int(nxt[s])
+            req.out_tokens.append(tok)
+            out.setdefault(req.request_id, []).append(tok)
+            self._tokens[s] = tok
+            self._pos[s] += 1
+            if len(req.out_tokens) >= req.max_new_tokens or (
+                req.eos_id is not None and tok == req.eos_id
+            ):
+                self._finish(s, req)
+        return out
+
+    def pump(self) -> List[Request]:
+        """Run until every submitted request finishes; returns them in
+        completion order (test/batch convenience — servers call step())."""
+        before = list(self._completed)
+        while self.has_work:
+            self.step()
+        seen = {id(r) for r in before}
+        return [r for r in self._completed if id(r) not in seen]
+
+    # ------------------------------------------------------------- internals
+    def _finish(self, slot: int, req: Request) -> None:
+        req.done = True
+        self._by_slot[slot] = None  # slot frees for the next admit
+        self._completed.append(req)
+        self.stats["finished"] += 1
+
+    def _bucket(self, n: int, max_new: int) -> int:
+        """Smallest bucket holding the prompt AND leaving room to decode;
+        falls back to the exact prompt length (one extra compile) when every
+        bucket would overflow the cache."""
+        for b in self.prefill_buckets:
+            if n <= b and b + max_new <= self.t_max:
+                return b
+        return n
+
+    def _admit(self, out: Optional[Dict[int, List[int]]] = None) -> None:
+        while self.queue and None in self._by_slot:
+            req = self.queue.popleft()
+            slot = self._by_slot.index(None)
+            prompt = req.prompt_ids
+            bucket = self._bucket(len(prompt), req.max_new_tokens)
+            padded = np.zeros(bucket, np.int32)
+            pad = bucket - len(prompt)
+            padded[pad:] = prompt  # LEFT pad: generate.py's prefill contract
+            logits, rowcache = prefill(
+                self.params,
+                jnp.asarray(padded[None]),
+                self.cfg,
+                self.t_max,
+                pad=jnp.asarray([pad], np.int32),
+            )
+            self.cache = _install_slot(
+                self.cache, rowcache["k"][:, 0], rowcache["v"][:, 0], slot
+            )
+            self._rng, k = jax.random.split(self._rng)
+            first = int(
+                np.asarray(
+                    _sample(logits, k, jnp.float32(req.temperature), req.top_k)
+                )[0]
+            )
+            req.out_tokens.append(first)
+            if out is not None:
+                out.setdefault(req.request_id, []).append(first)
+            req.slot = slot
+            self._by_slot[slot] = req
+            self._tokens[slot] = first
+            self._pos[slot] = bucket  # next write lands after the prompt
+            self._pads[slot] = pad
+            self._temps[slot] = req.temperature
+            self._topks[slot] = req.top_k
+            self.stats["admitted"] += 1
+            if len(req.out_tokens) >= req.max_new_tokens or (
+                req.eos_id is not None and first == req.eos_id
+            ):
+                self._finish(slot, req)

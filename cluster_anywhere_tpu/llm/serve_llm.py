@@ -10,6 +10,16 @@ from typing import Any, Dict, Optional
 from .processor import ByteTokenizer, ModelSpec, ProcessorConfig, _InferenceWorker
 
 
+def _parse_body(request) -> Dict[str, Any]:
+    """Accept a serve HTTP Request, a dict, or a bare prompt string — the
+    one body parser every LLM deployment method shares."""
+    from ..serve import Request
+
+    if isinstance(request, Request):
+        return request.json() if request.method == "POST" else dict(request.query_params)
+    return request if isinstance(request, dict) else {"prompt": str(request)}
+
+
 class LLMServer:
     """Serve deployment hosting one model; understands dict and HTTP requests:
        {"prompt": "...", "max_new_tokens": 16} -> {"generated_text": "..."}"""
@@ -28,12 +38,7 @@ class LLMServer:
             self.config.temperature = float(cfg["temperature"])
 
     def __call__(self, request) -> Dict[str, Any]:
-        from ..serve import Request
-
-        if isinstance(request, Request):
-            body = request.json() if request.method == "POST" else dict(request.query_params)
-        else:
-            body = request if isinstance(request, dict) else {"prompt": str(request)}
+        body = _parse_body(request)
         prompt = body.get("prompt", "")
         batch = {"prompt": self.np.asarray([prompt], dtype=object)}
         overrides = {}
@@ -56,12 +61,7 @@ class LLMServer:
         as SSE via the proxy's text/event-stream path (serve streaming
         handles end-to-end: replica generator -> streaming actor frames ->
         one SSE event per token)."""
-        from ..serve import Request
-
-        if isinstance(request, Request):
-            body = request.json() if request.method == "POST" else dict(request.query_params)
-        else:
-            body = request if isinstance(request, dict) else {"prompt": str(request)}
+        body = _parse_body(request)
         kwargs = {}
         if "max_new_tokens" in body:
             kwargs["max_new_tokens"] = int(body["max_new_tokens"])
@@ -91,3 +91,162 @@ def build_llm_deployment(
         max_ongoing_requests=4,
     )
     return dep.bind(config)
+
+
+class ContinuousLLMServer:
+    """LLM deployment with ITERATION-LEVEL scheduling (the vLLM-engine role
+    of the reference's serve.llm): concurrent requests share the decode loop
+    through one ContinuousBatcher — a request admits the moment a slot
+    frees, instead of waiting for the current static batch to drain.
+
+    One background pump thread drives decode steps; caller threads (the
+    replica runs methods concurrently up to max_ongoing_requests) submit and
+    wait on per-request events, or consume a token queue when streaming."""
+
+    def __init__(self, config: ProcessorConfig, slots: int = 8):
+        import queue
+        import threading
+
+        import jax
+
+        from ..models.transformer import init_params
+        from .continuous import ContinuousBatcher
+
+        self.config = config
+        self.tok = config.tokenizer or ByteTokenizer()
+        tcfg = config.model.transformer_config(self.tok.vocab_size)
+        if config.model.params_path:
+            from . import _params_io
+
+            params = _params_io.load_params(config.model.params_path)
+        else:
+            params = init_params(jax.random.key(config.model.seed), tcfg)
+        t_max = config.max_prompt_len + config.max_new_tokens
+        self.cb = ContinuousBatcher(
+            params, tcfg, slots=slots, t_max=t_max,
+            prefill_buckets=(config.max_prompt_len,), top_k=config.top_k,
+        )
+        self._lock = threading.Lock()  # batcher is single-threaded inside
+        self._queues: dict = {}  # request_id -> queue of token ids (+ None EOF)
+        self._reqs: dict = {}  # request_id -> Request (done detection)
+        self._queue_cls = queue.Queue
+        self._stop = False
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._pump.start()
+
+    def close(self):
+        """Stop the pump thread (dropping a replica without close() would
+        leave it spinning and pinning params + the KV cache forever)."""
+        self._stop = True
+        if self._pump.is_alive():
+            self._pump.join(timeout=5)
+
+    def __del__(self):  # best-effort; serve teardown also kills the process
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _pump_loop(self):
+        import time as _time
+
+        while not self._stop:
+            with self._lock:
+                work = self.cb.has_work
+                out = self.cb.step() if work else {}
+                delivered = []
+                for rid, toks in out.items():
+                    q = self._queues.get(rid)
+                    req = self._reqs.get(rid)
+                    if q is not None:
+                        for t in toks:
+                            q.put(t)
+                        if req is not None and req.done:
+                            q.put(None)
+                            delivered.append(rid)
+                for rid in delivered:
+                    self._reqs.pop(rid, None)
+            if not work:
+                _time.sleep(0.005)
+
+    def _submit(self, body) -> tuple:
+        prompt = body.get("prompt", "")
+        ids = self.tok.encode(prompt)[: self.config.max_prompt_len]
+        mnt = int(body.get("max_new_tokens", self.config.max_new_tokens))
+        temp = float(body.get("temperature", self.config.temperature))
+        top_k = body.get("top_k")
+        q = self._queue_cls()
+        with self._lock:
+            # queue registered under the same lock as submit: the pump's
+            # next step (admit + decode) finds it before any token flows
+            req = self.cb.submit(
+                ids, max_new_tokens=mnt, temperature=temp,
+                top_k=None if top_k is None else int(top_k),
+            )
+            self._queues[req.request_id] = q
+            self._reqs[req.request_id] = req
+        return prompt, req, q
+
+    def _forget(self, req):
+        with self._lock:
+            self._queues.pop(req.request_id, None)
+            self._reqs.pop(req.request_id, None)
+
+    def __call__(self, request) -> Dict[str, Any]:
+        prompt, req, q = self._submit(_parse_body(request))
+        toks = []
+        try:
+            while True:
+                t = q.get(timeout=120)
+                if t is None:
+                    break
+                toks.append(t)
+        finally:
+            self._forget(req)
+        import numpy as np
+
+        return {
+            "prompt": prompt,
+            "generated_text": self.tok.decode(np.asarray(toks, np.int32)),
+            "num_generated_tokens": len(toks),
+        }
+
+    def stream(self, request):
+        """Per-token streaming while other requests decode in the same loop."""
+        import numpy as np
+
+        prompt, req, q = self._submit(_parse_body(request))
+        try:
+            while True:
+                t = q.get(timeout=120)
+                if t is None:
+                    return
+                yield {
+                    "token_id": int(t),
+                    "text": self.tok.decode(np.asarray([t], np.int32)),
+                }
+        finally:
+            self._forget(req)
+
+
+def build_continuous_llm_deployment(
+    config: Optional[ProcessorConfig] = None,
+    *,
+    slots: int = 8,
+    num_replicas: int = 1,
+    num_tpus: float = 0.0,
+    name: str = "ContinuousLLMServer",
+):
+    """Continuous-batching twin of build_llm_deployment: up to `slots`
+    requests share every decode iteration on each replica."""
+    from .. import serve
+
+    config = config or ProcessorConfig()
+    dep = serve.deployment(
+        ContinuousLLMServer,
+        name=name,
+        num_replicas=num_replicas,
+        num_tpus=num_tpus,
+        max_ongoing_requests=slots,  # callers block in __call__; pump is a thread
+    )
+    return dep.bind(config, slots)

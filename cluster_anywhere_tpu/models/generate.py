@@ -92,6 +92,33 @@ def _block_decode(bp, x, layer_cache, pos, cfg: TransformerConfig, pad=None):
     return _mlp(bp, x, cfg), (k_cache, v_cache)
 
 
+def _block_decode_rowpos(bp, x, layer_cache, pos, cfg: TransformerConfig, pads):
+    """One block, one token, PER-ROW cache positions (continuous batching:
+    every slot decodes at its own depth).  x: [B, 1, E]; pos/pads: [B];
+    layer_cache: (k, v) [B, Tmax, KV, D].  Row b writes its k/v at slot
+    pos[b], takes RoPE position pos[b] - pads[b], and attends to cache
+    slots [pads[b], pos[b]]."""
+    k_cache, v_cache = layer_cache
+    y = _rms_norm(x, bp["ln1"])
+    q, k, v = _project_qkv(bp, y, cfg)
+    positions = (pos - pads)[:, None]  # [B, 1]
+    q, k = _rope(q, k, positions, cfg)
+    b = x.shape[0]
+    rows = jnp.arange(b)
+    k_cache = k_cache.at[rows, pos].set(k[:, 0])
+    v_cache = v_cache.at[rows, pos].set(v[:, 0])
+    attn = _masked_attention(
+        q,
+        _gqa_repeat(k_cache, cfg),
+        _gqa_repeat(v_cache, cfg),
+        (pos + 1)[:, None, None, None],  # per-row valid length
+        cfg,
+        pads,
+    )
+    x = x + attn.reshape(b, 1, -1) @ bp["wo"].astype(x.dtype)
+    return _mlp(bp, x, cfg), (k_cache, v_cache)
+
+
 def _prefill_block(bp, x, pad, cfg: TransformerConfig, t_max: int):
     """One block over the whole prompt; returns padded caches [B,Tmax,KV,D].
     pad: [B] per-row left-pad counts or None. Real tokens sit at columns

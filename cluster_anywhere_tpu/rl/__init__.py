@@ -1,6 +1,7 @@
 """cluster_anywhere_tpu.rl: reinforcement learning on the actor runtime
 (compact analogue of the reference's RLlib, rllib/ — Algorithm/
-AlgorithmConfig, EnvRunner actors, jax Learners; PPO + DQN + IMPALA).
+AlgorithmConfig, EnvRunner actors, jax Learners; PPO/recurrent-PPO, DQN+PER,
+IMPALA/APPO, SAC, TD3, connectors, multi-agent, offline BC/CQL).
 
     from cluster_anywhere_tpu import rl
     algo = rl.AlgorithmConfig("PPO").environment("CartPole-v1").env_runners(2).build()

@@ -139,6 +139,24 @@ class EnvRunner:
     def _obs_t(self, obs):
         return self.obs_pipe(obs) if self.obs_pipe is not None else obs
 
+    def _obs_t_frozen(self, obs):
+        """Transform WITHOUT updating stateful connector stats — for rollout
+        boundaries (bootstrap value, next_obs), whose observations are
+        transformed again (with updates) as the first obs of the next
+        sample(); updating here would double-count them."""
+        if self.obs_pipe is None:
+            return obs
+        saved = [
+            (c, c.update) for c in self.obs_pipe.connectors if hasattr(c, "update")
+        ]
+        for c, _ in saved:
+            c.update = False
+        try:
+            return self.obs_pipe(obs)
+        finally:
+            for c, flag in saved:
+                c.update = flag
+
     def _act_t(self, actions):
         return self.act_pipe(actions) if self.act_pipe is not None else actions
 
@@ -197,12 +215,12 @@ class EnvRunner:
             done_l.append(dones)
             logp_l.append(logp)
             val_l.append(values)
-        # bootstrap value of the final obs (PPO/GAE)
-        if self.kind == "gaussian":
-            last_values = np.zeros(self.vec.num_envs, np.float32)
-        elif self.kind == "policy":
+        # bootstrap value of the final obs (PPO/GAE); transformed ONCE with
+        # frozen stats and reused for next_obs so the stored pair agrees
+        tail_obs = self._obs_t_frozen(self.vec.obs)
+        if self.kind == "policy":
             last_values = np.asarray(
-                self._jit_value(self.params, jnp.asarray(self._obs_t(self.vec.obs)))
+                self._jit_value(self.params, jnp.asarray(tail_obs))
             )
         else:
             last_values = np.zeros(self.vec.num_envs, np.float32)
@@ -214,7 +232,7 @@ class EnvRunner:
             "logp": np.stack(logp_l),          # [T, N]
             "values": np.stack(val_l),         # [T, N]
             "last_values": last_values,        # [N]
-            "next_obs": self._obs_t(self.vec.obs).copy(),  # [N, D] (transformed like obs)
+            "next_obs": np.asarray(tail_obs).copy(),  # [N, D] (transformed like obs)
             "metrics": self.vec.drain_metrics(),
         }
 
@@ -243,8 +261,9 @@ class EnvRunner:
             done_l.append(dones)
             logp_l.append(logp)
             val_l.append(np.asarray(values))
+        tail_obs = self._obs_t_frozen(self.vec.obs)
         _, last_values, _ = self._jit_step(
-            self.params, jnp.asarray(self._obs_t(self.vec.obs)), jnp.asarray(self.state)
+            self.params, jnp.asarray(tail_obs), jnp.asarray(self.state)
         )
         return {
             "obs": np.stack(obs_l),
@@ -254,7 +273,7 @@ class EnvRunner:
             "logp": np.stack(logp_l),
             "values": np.stack(val_l),
             "last_values": np.asarray(last_values),
-            "next_obs": self._obs_t(self.vec.obs).copy(),
+            "next_obs": np.asarray(tail_obs).copy(),
             "state0": state0,
             "metrics": self.vec.drain_metrics(),
         }

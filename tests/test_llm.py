@@ -144,3 +144,122 @@ def test_llm_serve_deployment():
     assert all("token_id" in t and "text" in t for t in toks)
     serve.delete("llm_test")
     serve.shutdown()
+
+
+def test_continuous_batching_matches_sequential_greedy():
+    """The gold contract of the iteration-level scheduler: a request decoded
+    CONCURRENTLY with others (shared cache pool, per-row positions, slot
+    churn) produces exactly the tokens it would get alone through the
+    static generate() path (greedy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_anywhere_tpu.llm import ContinuousBatcher
+    from cluster_anywhere_tpu.models.generate import generate
+    from cluster_anywhere_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_head=8, d_ff=64
+    )
+    params = init_params(jax.random.key(0), cfg)
+    prompts = [[1, 5, 9], [2, 3], [7, 8, 9, 10, 11]]
+    want = [
+        np.asarray(
+            generate(
+                params, jnp.asarray([p], jnp.int32), jax.random.key(9),
+                cfg=cfg, max_new_tokens=6,
+            )
+        )[0].tolist()
+        for p in prompts
+    ]
+    # slots=2 forces the third request to WAIT for a slot, exercising
+    # admission mid-flight next to live decodes
+    cb = ContinuousBatcher(params, cfg, slots=2, t_max=64, prefill_buckets=(8, 16))
+    reqs = [cb.submit(p, max_new_tokens=6) for p in prompts]
+    done = cb.pump()
+    assert len(done) == 3 and all(r.done for r in reqs)
+    for r, w in zip(reqs, want):
+        assert r.out_tokens == w, (r.request_id, r.out_tokens, w)
+    assert cb.stats["admitted"] == 3
+    # concurrency actually happened: the three 6-token requests cannot have
+    # taken 3 x 5 decode iterations (the first two share every step)
+    assert cb.stats["decode_steps"] < 15, cb.stats
+
+
+def test_continuous_batching_slot_churn_and_streaming():
+    """Slots free the moment a request finishes and are re-admitted next
+    step; step() yields per-request tokens incrementally (token streaming
+    while other requests keep decoding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_anywhere_tpu.llm import ContinuousBatcher
+    from cluster_anywhere_tpu.models.generate import generate
+    from cluster_anywhere_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_head=8, d_ff=64
+    )
+    params = init_params(jax.random.key(0), cfg)
+    cb = ContinuousBatcher(params, cfg, slots=2, t_max=64, prefill_buckets=(8,))
+    short = cb.submit([1, 2], max_new_tokens=2)
+    long = cb.submit([3, 4], max_new_tokens=10)
+    late = cb.submit([5, 6], max_new_tokens=3)  # waits for short's slot
+    seen: dict = {}
+    step_members: list = []
+    while cb.has_work:
+        out = cb.step()
+        step_members.append(set(out))
+        for rid, toks in out.items():
+            seen.setdefault(rid, []).append(list(toks))
+    assert short.done and long.done and late.done
+    # streaming: the long request produced tokens over many separate steps
+    assert len(seen[long.request_id]) >= 8
+    # churn: late genuinely ran WHILE long was still decoding (both ids
+    # appear in at least one step's output)
+    assert any(
+        {late.request_id, long.request_id} <= members for members in step_members
+    ), step_members
+    # every token reaches step()'s output exactly once, incl. the prefill one
+    assert sum(len(t) for t in seen[long.request_id]) == 10
+
+
+def test_continuous_llm_server_concurrent_requests():
+    """ContinuousLLMServer: concurrent callers share decode iterations (the
+    serve-facing wrapper over ContinuousBatcher) and each gets exactly the
+    text the plain static path would produce (greedy)."""
+    import threading
+
+    from cluster_anywhere_tpu.llm import ContinuousLLMServer, ModelSpec, ProcessorConfig
+
+    cfg = ProcessorConfig(
+        model=ModelSpec(preset="tiny"), max_prompt_len=16, max_new_tokens=8,
+        temperature=0.0,
+    )
+    srv = ContinuousLLMServer(cfg, slots=4)
+    prompts = ["hi", "hello there", "abc"]
+    results = {}
+
+    def call(p):
+        results[p] = srv({"prompt": p})
+
+    threads = [threading.Thread(target=call, args=(p,)) for p in prompts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert set(results) == set(prompts)
+    for p in prompts:
+        assert results[p]["num_generated_tokens"] == 8, results[p]
+    # the batcher really interleaved: 3 requests x 8 tokens but far fewer
+    # decode iterations than 3 x 7 (they share steps)
+    assert srv.cb.stats["admitted"] == 3
+    assert srv.cb.stats["decode_steps"] < 21, srv.cb.stats
+    # equivalence with the static path for one of them
+    from cluster_anywhere_tpu.llm.processor import _InferenceWorker
+    import numpy as np
+
+    w = _InferenceWorker(cfg)
+    static = w({"prompt": np.asarray(["hello there"], dtype=object)})
+    assert results["hello there"]["generated_text"] == str(static["generated_text"][0])
+    srv.close()  # replica lifecycle: the pump thread must stop
