@@ -152,6 +152,13 @@ def _prefill_block(bp, x, pad, cfg: TransformerConfig, t_max: int):
 def prefill(params, ids, cfg: TransformerConfig, t_max: int, pad=None):
     """ids: [B, T_prompt] -> (last-token logits [B, V], cache).
     pad: optional [B] left-pad counts (see _prefill_block)."""
+    if cfg.n_experts:
+        # the decode blocks hardcode the dense FFN params; failing here beats
+        # a KeyError('w_gate') deep inside a scanned block
+        raise NotImplementedError(
+            "MoE inference (prefill/decode) is not wired yet — n_experts "
+            "configs train only"
+        )
     x = params["embed"].astype(cfg.dtype)[ids]
 
     def body(x, bp):

@@ -55,6 +55,13 @@ class TransformerConfig:
     # while-loop can't), worth ~12% a step on v5e; compile time grows with
     # depth, so deep stacks can turn it off
     unroll_layers: bool = True
+    # mixture-of-experts FFN (parallel/moe.py switch-style top-1): every
+    # layer's dense FFN becomes n_experts experts sharded over the 'ep' mesh
+    # axis, tokens routed via all_to_all.  0 = dense.
+    n_experts: int = 0
+    ep: int = 1
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def layers_per_stage(self) -> int:
@@ -78,17 +85,27 @@ def _init_block(key, cfg: TransformerConfig):
     ks = jax.random.split(key, 7)
     s = lambda fan_in: fan_in ** -0.5
     pd = cfg.param_dtype
-    return {
+    out = {
         "ln1": jnp.ones((e,), pd),
         "wq": jax.random.normal(ks[0], (e, h * d), pd) * s(e),
         "wk": jax.random.normal(ks[1], (e, kv * d), pd) * s(e),
         "wv": jax.random.normal(ks[2], (e, kv * d), pd) * s(e),
         "wo": jax.random.normal(ks[3], (h * d, e), pd) * s(h * d),
         "ln2": jnp.ones((e,), pd),
-        "w_gate": jax.random.normal(ks[4], (e, f), pd) * s(e),
-        "w_up": jax.random.normal(ks[5], (e, f), pd) * s(e),
-        "w_down": jax.random.normal(ks[6], (f, e), pd) * s(f),
     }
+    if cfg.n_experts:
+        from ..parallel.moe import init_moe_params
+
+        out.update(init_moe_params(ks[4], e, f, cfg.n_experts, pd))
+    else:
+        out.update(
+            {
+                "w_gate": jax.random.normal(ks[4], (e, f), pd) * s(e),
+                "w_up": jax.random.normal(ks[5], (e, f), pd) * s(e),
+                "w_down": jax.random.normal(ks[6], (f, e), pd) * s(f),
+            }
+        )
+    return out
 
 
 def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -118,19 +135,34 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     def blk(*spec):
         return P(*lead, *spec)
 
+    blocks: Dict[str, Any] = {
+        "ln1": blk(None),
+        "wq": blk("fsdp", "tp"),
+        "wk": blk("fsdp", "tp"),
+        "wv": blk("fsdp", "tp"),
+        "wo": blk("tp", "fsdp"),
+        "ln2": blk(None),
+    }
+    if cfg.n_experts:
+        blocks.update(
+            {
+                # experts sharded over 'ep'; each expert's matmuls tp-sharded
+                "router": blk(None, None),
+                "w_in": blk("ep", "fsdp", "tp"),
+                "w_out": blk("ep", "tp", "fsdp"),
+            }
+        )
+    else:
+        blocks.update(
+            {
+                "w_gate": blk("fsdp", "tp"),
+                "w_up": blk("fsdp", "tp"),
+                "w_down": blk("tp", "fsdp"),
+            }
+        )
     return {
         "embed": P("fsdp", "tp"),
-        "blocks": {
-            "ln1": blk(None),
-            "wq": blk("fsdp", "tp"),
-            "wk": blk("fsdp", "tp"),
-            "wv": blk("fsdp", "tp"),
-            "wo": blk("tp", "fsdp"),
-            "ln2": blk(None),
-            "w_gate": blk("fsdp", "tp"),
-            "w_up": blk("fsdp", "tp"),
-            "w_down": blk("tp", "fsdp"),
-        },
+        "blocks": blocks,
         "ln_f": P(None),
         "lm_head": P("fsdp", "tp"),
     }
@@ -229,49 +261,93 @@ def _block_forward(bp, x, cfg: TransformerConfig, sp_manual: bool):
     x = x + attn @ bp["wo"].astype(dt)
 
     y = _rms_norm(x, bp["ln2"])
+    if cfg.n_experts:
+        # MoE FFN: tokens flatten, route to experts over 'ep', come back
+        # (only traced under shard_map manual over 'ep' — see forward())
+        from ..parallel.moe import moe_ffn
+
+        r = moe_ffn(
+            y.reshape(b * t, e),
+            bp["router"].astype(dt),
+            bp["w_in"].astype(dt),
+            bp["w_out"].astype(dt),
+            axis_name="ep",
+            capacity_factor=cfg.capacity_factor,
+        )
+        x = x + r.out.reshape(b, t, e)
+        return x, r.aux_loss.astype(jnp.float32)
     gated = jax.nn.silu(y @ bp["w_gate"].astype(dt)) * (y @ bp["w_up"].astype(dt))
     x = x + gated @ bp["w_down"].astype(dt)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def _stage_forward(stage_blocks, x, cfg: TransformerConfig, sp_manual: bool):
-    """Scan over this stage's layers. stage_blocks leaves: [L_stage, ...]."""
+    """Scan over this stage's layers. stage_blocks leaves: [L_stage, ...].
+    Returns (x, aux) — aux is the summed MoE load-balance loss (0 dense)."""
     block = functools.partial(_block_forward, cfg=cfg, sp_manual=sp_manual)
     if cfg.remat:
         block = jax.checkpoint(block)
 
-    def body(x, bp):
-        return block(bp, x), None
+    def body(carry, bp):
+        x, aux = carry
+        x, a = block(bp, x)
+        return (x, aux + a), None
 
-    x, _ = lax.scan(body, x, stage_blocks, unroll=True if cfg.unroll_layers else 1)
-    return x
+    (x, aux), _ = lax.scan(
+        body,
+        (x, jnp.zeros((), jnp.float32)),
+        stage_blocks,
+        unroll=True if cfg.unroll_layers else 1,
+    )
+    return x, aux
 
 
-def forward(params, ids, cfg: TransformerConfig, mesh=None) -> jax.Array:
-    """ids: [B, T] int32 -> logits [B, T, V]."""
+def forward(params, ids, cfg: TransformerConfig, mesh=None, return_aux: bool = False):
+    """ids: [B, T] int32 -> logits [B, T, V] (with the MoE load-balance aux
+    loss when return_aux; 0 for dense configs)."""
     x = params["embed"].astype(cfg.dtype)[ids]  # [B, T, E]
     manual_axes = set()
     if cfg.pp > 1:
         manual_axes.add("pp")
     if cfg.sp > 1 and cfg.resolved_attn() in ("ring", "ulysses"):
         manual_axes.add("sp")
+    if cfg.n_experts:
+        if cfg.pp > 1:
+            raise NotImplementedError("MoE + pipeline parallelism not wired yet")
+        manual_axes.add("ep")
 
     if manual_axes:
         if mesh is None:
-            raise ValueError("mesh required for pp/sp execution")
-        x = _apply_blocks_manual(params["blocks"], x, cfg, mesh, frozenset(manual_axes))
+            raise ValueError("mesh required for pp/sp/ep execution")
+        if cfg.n_experts:
+            mesh_ep = mesh.shape["ep"]
+            if cfg.ep > 1 and cfg.ep != mesh_ep:
+                raise ValueError(
+                    f"cfg.ep={cfg.ep} disagrees with the mesh's ep axis ({mesh_ep})"
+                )
+            if cfg.n_experts % mesh_ep != 0:
+                raise ValueError(
+                    f"n_experts={cfg.n_experts} not divisible by the mesh's "
+                    f"ep axis ({mesh_ep})"
+                )
+        x, aux = _apply_blocks_manual(
+            params["blocks"], x, cfg, mesh, frozenset(manual_axes)
+        )
     else:
-        x = _stage_forward(params["blocks"], x, cfg, sp_manual=False)
+        x, aux = _stage_forward(params["blocks"], x, cfg, sp_manual=False)
 
     x = _rms_norm(x, params["ln_f"])
-    return x @ params["lm_head"].astype(cfg.dtype)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
+    return (logits, aux) if return_aux else logits
 
 
 def _apply_blocks_manual(blocks, x, cfg: TransformerConfig, mesh, manual_axes):
-    """Run the block stack under shard_map, manual over {'pp','sp'} (subset),
-    GSPMD-auto over dp/fsdp/tp."""
+    """Run the block stack under shard_map, manual over {'pp','sp','ep'}
+    (subset), GSPMD-auto over dp/fsdp/tp.  With 'ep' manual, the batch dim
+    shards over experts' owner devices (tokens all_to_all inside moe_ffn)."""
     sp_manual = "sp" in manual_axes
     pp_manual = "pp" in manual_axes
+    ep_manual = "ep" in manual_axes
 
     def inner(blocks_local, x_local):
         if pp_manual:
@@ -279,27 +355,45 @@ def _apply_blocks_manual(blocks, x, cfg: TransformerConfig, mesh, manual_axes):
             stage = functools.partial(
                 _stage_forward, cfg=cfg, sp_manual=sp_manual
             )
-            return pipeline_apply(
-                lambda bp, a: stage(bp, a),
+            out = pipeline_apply(
+                lambda bp, a: stage(bp, a)[0],
                 my_blocks,
                 x_local,
                 axis_name="pp",
                 num_microbatches=cfg.num_microbatches,
             )
-        return _stage_forward(blocks_local, x_local, cfg=cfg, sp_manual=sp_manual)
+            return out, jnp.zeros((), jnp.float32)
+        x_out, aux = _stage_forward(blocks_local, x_local, cfg=cfg, sp_manual=sp_manual)
+        # the P() out-spec claims aux is replicated across EVERY manual axis;
+        # each shard computed it over its own tokens, so reduce over all
+        if ep_manual:
+            aux = lax.pmean(aux, "ep")
+        if sp_manual:
+            aux = lax.pmean(aux, "sp")
+        return x_out, aux
 
-    block_specs = jax.tree_util.tree_map(
-        lambda _: P("pp") if pp_manual else P(), blocks
-    )
-    x_spec = P(None, "sp", None) if sp_manual else P()
-    return jax.shard_map(
+    def leaf_spec(path, _leaf):
+        # expert tensors carry their 'ep' shard inside the manual region;
+        # the leading stacked-layer axis (and pp stage axis) comes first
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        lead = ("pp",) if pp_manual else ()
+        if ep_manual and name in ("w_in", "w_out"):
+            return P(*lead, None, "ep")  # [.., L, n_experts, ...]
+        return P(*lead) if lead else P()
+
+    block_specs = jax.tree_util.tree_map_with_path(leaf_spec, blocks)
+    batch_axis = "ep" if ep_manual else None
+    x_spec = P(batch_axis, "sp" if sp_manual else None, None)
+    aux_spec = P()
+    out, aux = jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(block_specs, x_spec),
-        out_specs=x_spec,
+        out_specs=(x_spec, aux_spec),
         axis_names=frozenset(manual_axes),
         check_vma=False,
     )(blocks, x)
+    return out, aux
 
 
 # ---------------------------------------------------------------------------
@@ -320,8 +414,11 @@ def cross_entropy_loss(logits, targets, mask=None):
 def make_loss_fn(cfg: TransformerConfig, mesh=None):
     def loss_fn(params, batch):
         ids = batch["ids"]  # [B, T+1]
-        logits = forward(params, ids[:, :-1], cfg, mesh)
-        return cross_entropy_loss(logits, ids[:, 1:])
+        logits, aux = forward(params, ids[:, :-1], cfg, mesh, return_aux=True)
+        loss = cross_entropy_loss(logits, ids[:, 1:])
+        if cfg.n_experts:
+            loss = loss + cfg.moe_aux_weight * aux
+        return loss
 
     return loss_fn
 

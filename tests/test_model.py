@@ -117,3 +117,39 @@ def test_full_4d_train_step():
             l0 = float(loss)
     assert np.isfinite(float(loss))
     assert float(loss) < l0
+
+
+def test_moe_transformer_trains_on_ep_mesh():
+    """MoE flagship variant: every layer's FFN becomes n_experts switch
+    experts sharded over 'ep' (parallel/moe.py all-to-all routing inside the
+    shard_map manual region).  Loss decreases and the router receives
+    gradients — i.e. the load-balance aux term and the expert path both
+    differentiate through the token exchange."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_anywhere_tpu.models import TransformerConfig, make_train_step
+    from cluster_anywhere_tpu.parallel import MeshSpec, make_mesh
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_head=8, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        n_experts=4, ep=2, attn_impl="dense",
+    )
+    mesh = make_mesh(MeshSpec(dp=4, ep=2))
+    step, init_state = make_train_step(cfg, mesh)
+    params, opt = init_state(jax.random.PRNGKey(0))
+    batch = {
+        "ids": jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (8, 33)), jnp.int32
+        )
+    }
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    router_before = np.asarray(jax.device_get(params["blocks"]["router"]))
+    losses = []
+    for _ in range(8):
+        params, opt, loss = jstep(params, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    router_after = np.asarray(jax.device_get(params["blocks"]["router"]))
+    assert not np.allclose(router_before, router_after), "router got no gradient"
