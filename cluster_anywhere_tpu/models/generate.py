@@ -41,8 +41,47 @@ def _gqa_repeat(x, cfg: TransformerConfig):
 def _mlp(bp, x, cfg):
     dt = x.dtype
     y = _rms_norm(x, bp["ln2"])
+    if cfg.n_experts:
+        return x + _moe_infer(bp, y, cfg)
     gated = jax.nn.silu(y @ bp["w_gate"].astype(dt)) * (y @ bp["w_up"].astype(dt))
     return x + gated @ bp["w_down"].astype(dt)
+
+
+_MOE_CHUNK = 64  # prefill tokens per all-experts pass (bounds [B,c,X,F])
+
+
+def _moe_infer(bp, y, cfg: TransformerConfig):
+    """MoE inference FFN delta: compute every expert and mask by the top-1
+    route.  Single-host decode has no 'ep' axis to all_to_all over; the
+    all-experts einsum stays MXU-shaped and drops nothing (capacity is a
+    train-time constraint).  The FLOP cost is n_experts x the routed path —
+    fine for the modest expert counts this serves; prefill is CHUNKED over
+    the prompt so the [B, chunk, X, F] intermediate stays bounded instead
+    of materializing [B, T, X, F] for long prompts.  (A capacity-dispatch
+    prefill like parallel/moe.py would cut the FLOPs too; do that if MoE
+    serving ever needs big expert counts.)"""
+    dt = y.dtype
+
+    def dense_pass(y_c):  # [B, c, E] -> [B, c, E]
+        logits = (y_c @ bp["router"].astype(dt)).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        gate = jnp.take_along_axis(probs, idx[..., None], axis=-1)[..., 0]
+        h = jax.nn.silu(jnp.einsum("bte,xef->btxf", y_c, bp["w_in"].astype(dt)))
+        out_x = jnp.einsum("btxf,xfe->btxe", h, bp["w_out"].astype(dt))
+        pick = jax.nn.one_hot(idx, out_x.shape[2], dtype=dt) * gate[..., None].astype(dt)
+        return jnp.einsum("btxe,btx->bte", out_x, pick)
+
+    b, t, e = y.shape
+    if t <= _MOE_CHUNK:
+        return dense_pass(y)
+    pad = (-t) % _MOE_CHUNK
+    yp = jnp.pad(y, ((0, 0), (0, pad), (0, 0)))
+    nc = (t + pad) // _MOE_CHUNK
+    chunks = yp.reshape(b, nc, _MOE_CHUNK, e).transpose(1, 0, 2, 3)
+    out = lax.map(dense_pass, chunks)  # [nc, B, c, E]
+    out = out.transpose(1, 0, 2, 3).reshape(b, t + pad, e)
+    return out[:, :t]
 
 
 def _masked_attention(q, k_cache, v_cache, valid_len, cfg: TransformerConfig, pad=None):
@@ -152,13 +191,6 @@ def _prefill_block(bp, x, pad, cfg: TransformerConfig, t_max: int):
 def prefill(params, ids, cfg: TransformerConfig, t_max: int, pad=None):
     """ids: [B, T_prompt] -> (last-token logits [B, V], cache).
     pad: optional [B] left-pad counts (see _prefill_block)."""
-    if cfg.n_experts:
-        # the decode blocks hardcode the dense FFN params; failing here beats
-        # a KeyError('w_gate') deep inside a scanned block
-        raise NotImplementedError(
-            "MoE inference (prefill/decode) is not wired yet — n_experts "
-            "configs train only"
-        )
     x = params["embed"].astype(cfg.dtype)[ids]
 
     def body(x, bp):
