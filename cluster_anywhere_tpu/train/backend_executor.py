@@ -50,6 +50,7 @@ class BackendExecutor:
             num_workers=n,
             bundle=self.scaling_config.bundle(),
             placement_strategy=self.scaling_config.placement_strategy,
+            label_selector=self.scaling_config.label_selector,
         )
         self.backend.on_start(self.worker_group, self.backend_config)
 

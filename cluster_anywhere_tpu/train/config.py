@@ -32,6 +32,12 @@ class ScalingConfig:
     cpus_per_worker: float = 1.0
     resources_per_worker: Dict[str, float] = field(default_factory=dict)
     placement_strategy: str = "PACK"
+    # Pin the whole gang to label-matching nodes (every bundle gets this
+    # hard selector) — on TPU clusters the auto-populated topology labels
+    # make this the slice-targeting knob, e.g.
+    # {"ca.io/tpu-slice-name": In("pod-a")} or
+    # {"ca.io/tpu-generation": In("v5e")}.
+    label_selector: Optional[Dict[str, Any]] = None
     # Elastic bounds (Train-v2 style); None disables elasticity.
     min_workers: Optional[int] = None
     max_workers: Optional[int] = None

@@ -108,10 +108,16 @@ class WorkerGroup:
         bundle: Dict[str, float],
         placement_strategy: str = "PACK",
         max_restarts: int = 0,
+        label_selector=None,
     ):
         self.num_workers = num_workers
         self._pg = ca.placement_group(
-            [dict(bundle) for _ in range(num_workers)], strategy=placement_strategy
+            [dict(bundle) for _ in range(num_workers)],
+            strategy=placement_strategy,
+            # slice targeting: every bundle carries the gang's hard selector
+            bundle_label_selectors=(
+                [label_selector] * num_workers if label_selector else None
+            ),
         )
         self._pg.wait(timeout_seconds=60)
         cls = ca.remote(TrainWorker)

@@ -232,3 +232,32 @@ def test_pg_infeasible_label_selector(label_cluster):
             [{"CPU": 1}],
             bundle_label_selectors=[{"ca.io/tpu-generation": In("v99")}],
         )
+
+
+def test_train_gang_pinned_to_slice_by_label(label_cluster):
+    """Train's ScalingConfig.label_selector pins the whole worker gang onto
+    label-matching nodes — the TPU slice-targeting knob (every PG bundle
+    carries the hard selector through BackendExecutor -> WorkerGroup)."""
+    from cluster_anywhere_tpu.train.backend_executor import BackendExecutor
+    from cluster_anywhere_tpu.train.config import (
+        BackendConfig,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    ex = BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(
+            num_workers=2,
+            cpus_per_worker=1.0,
+            label_selector={"ca.io/tpu-slice-name": In("slice-a")},
+        ),
+        RunConfig(),
+        "gang-label-test",
+    )
+    ex.start()
+    try:
+        infos = ex.worker_group.node_infos
+        assert all(i["node_id"] == "tpunode" for i in infos), infos
+    finally:
+        ex.shutdown()
