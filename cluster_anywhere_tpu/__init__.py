@@ -17,6 +17,7 @@ from .core.actor import ActorHandle, exit_actor, get_actor, kill, method
 from .core.api import (
     available_resources,
     timeline,
+    cancel,
     cluster_resources,
     cluster_stats,
     get,
@@ -65,6 +66,7 @@ __all__ = [
     "put",
     "get",
     "wait",
+    "cancel",
     "remote",
     "ObjectRef",
     "DeviceRef",

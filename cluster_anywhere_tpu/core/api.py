@@ -285,6 +285,15 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     return global_worker().get(refs, timeout=timeout)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = False) -> None:
+    """Cancel the task producing `ref` (ray.cancel analogue).  Queued tasks
+    drop immediately; running ones get TaskCancelledError raised at their
+    next bytecode boundary; force=True kills the executing worker process
+    (for C-level blocking calls).  get(ref) then raises TaskCancelledError;
+    cancelled tasks are never retried.  No-op on finished tasks."""
+    global_worker().cancel(ref, force=force, recursive=recursive)
+
+
 def wait(
     refs: Sequence[ObjectRef],
     *,

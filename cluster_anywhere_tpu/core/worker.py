@@ -30,6 +30,7 @@ from .errors import (
     GetTimeoutError,
     ObjectLostError,
     StaleObjectError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -299,6 +300,12 @@ class LeasePool:
                 self._dial_then_drain(lease)
                 return
             item = self.backlog.popleft()
+            if item[0].binary() in self.worker._cancelled_tasks:
+                self.inflight_total -= 1
+                self.worker._store_error(
+                    item[3], TaskCancelledError("task was cancelled")
+                )
+                continue
             if not self.worker._push_fast(self, lease, *item):
                 # call_cb raised: _push_fast marked the lease dead; retry the
                 # item on whatever _pick finds next round
@@ -439,6 +446,10 @@ class Worker:
         self._spilled_pinned: set = set()
         # in-flight streaming generators (ObjectRefGenerator consumers)
         self._streams: Dict[bytes, Any] = {}
+        # cancellation (task_manager.h CancelTask role): task ids the owner
+        # cancelled, and where each in-flight push currently executes
+        self._cancelled_tasks: set = set()
+        self._inflight_tasks: Dict[bytes, str] = {}  # task_id -> worker addr
         # lineage: task specs of submitted normal tasks, so a lost object can
         # be recomputed by re-executing its creating task (object_recovery_
         # manager.h).  Holding the original arg ObjectRefs here pins the
@@ -1706,6 +1717,9 @@ class Worker:
         saturated, the task joins the pool's backlog (still no coroutine;
         release callbacks drain it).  Anything needing awaiting (arg
         resolution, function export) returns the slow coroutine instead."""
+        if task_id.binary() in self._cancelled_tasks:
+            self._store_error(oids, TaskCancelledError("task was cancelled"))
+            return None
         if blob is not None or args or kwargs or opts.get("runtime_env"):
             return self._submit_task(task_id, fn_id, blob, args, kwargs, opts, oids)
         pool = self._lease_pool(opts)
@@ -1736,10 +1750,17 @@ class Worker:
         if conn is None or conn.closed:
             return False
         lease.inflight += 1
+        self._inflight_tasks[task_id.binary()] = addr
 
         def on_reply(msg):
+            self._inflight_tasks.pop(task_id.binary(), None)
             pool.release(lease, dead=msg is None)
             if msg is None:
+                if task_id.binary() in self._cancelled_tasks:
+                    # force-cancel killed the worker mid-task: cancelled, not
+                    # crashed, and never retried
+                    self._store_error(oids, TaskCancelledError("task was cancelled"))
+                    return
                 # worker died with the push in flight: retry on a fresh lease
                 # only within the task's retry budget (at-most-once otherwise)
                 retries = opts.get("max_retries", self.config.default_max_retries)
@@ -1772,6 +1793,7 @@ class Worker:
                 num_returns=opts.get("num_returns", 1),
             )
         except ConnectionError:
+            self._inflight_tasks.pop(task_id.binary(), None)
             lease.inflight -= 1
             lease.dead = True
             return False
@@ -1817,7 +1839,15 @@ class Worker:
             except BaseException as e:
                 self._store_error(oids, e)
                 return
+            if task_id.binary() in self._cancelled_tasks:
+                # cancelled while waiting for a lease: never push
+                pool.release(lease)
+                self._store_error(oids, TaskCancelledError("task was cancelled"))
+                return
             dead = False
+            self._inflight_tasks[task_id.binary()] = self._normalize_peer_addr(
+                lease.addr
+            )
             try:
                 conn = await self.conn_to(lease.addr)
                 # no RPC timeout here: the reply arrives only after the task
@@ -1836,6 +1866,9 @@ class Worker:
                 )
             except ConnectionError as e:
                 dead = True
+                if task_id.binary() in self._cancelled_tasks:
+                    self._store_error(oids, TaskCancelledError("task was cancelled"))
+                    return
                 if retries > 0:
                     retries -= 1
                     continue
@@ -1844,16 +1877,28 @@ class Worker:
                 )
                 return
             finally:
+                self._inflight_tasks.pop(task_id.binary(), None)
                 pool.release(lease, dead=dead)
             self._store_results(oids, reply["results"], lease.addr)
             return
 
     def _store_error(self, oids: List[ObjectID], e: BaseException):
         err = e if isinstance(e, CAError) else TaskError(repr(e))
+        if oids:
+            self._cancelled_tasks.discard(oids[0].task_id().binary())
         for oid in oids:
             self.memory_store.put_error(oid, err)
 
     def _store_results(self, oids: List[ObjectID], results: List[dict], exec_addr: str):
+        if oids:
+            tid = oids[0].task_id().binary()
+            if tid in self._cancelled_tasks:
+                # the task outran its cancellation (value arrived anyway):
+                # the caller asked for cancel semantics, and an earlier
+                # get() may already have raised — stay consistent
+                self._store_error(oids, TaskCancelledError("task was cancelled"))
+                return
+            self._cancelled_tasks.discard(tid)
         for oid, res in zip(oids, results):
             if (
                 self.memory_store.get_entry(oid) is None
@@ -2000,9 +2045,16 @@ class Worker:
         if conn is None or conn.closed:
             return self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
         addr = cached[0]
+        self._inflight_tasks[task_id.binary()] = addr
 
         def on_reply(msg):
+            self._inflight_tasks.pop(task_id.binary(), None)
             if msg is None:
+                if task_id.binary() in self._cancelled_tasks:
+                    # force-cancel killed the actor process mid-call: the
+                    # cancelled call must NOT re-execute on a restart
+                    self._store_error(oids, TaskCancelledError("task was cancelled"))
+                    return
                 # connection died mid-call: slow path refreshes the actor
                 # address (restart transparency) and retries
                 t = spawn_bg(
@@ -2047,20 +2099,27 @@ class Worker:
             try:
                 addr = await self._actor_addr(aid, refresh=refresh)
                 conn = await self.conn_to(addr)
-                reply = await conn.call(
-                    "actor_call",
-                    actor_id=aid,
-                    method=method,
-                    task_id=task_id.binary(),
-                    owner=self.client_id,
-                    args=specs,
-                    kwargs=kwspecs,
-                    num_returns=opts.get("num_returns", 1),
-                    timeout=None,
-                )
+                self._inflight_tasks[task_id.binary()] = self._normalize_peer_addr(addr)
+                try:
+                    reply = await conn.call(
+                        "actor_call",
+                        actor_id=aid,
+                        method=method,
+                        task_id=task_id.binary(),
+                        owner=self.client_id,
+                        args=specs,
+                        kwargs=kwspecs,
+                        num_returns=opts.get("num_returns", 1),
+                        timeout=None,
+                    )
+                finally:
+                    self._inflight_tasks.pop(task_id.binary(), None)
                 self._store_results(oids, reply["results"], addr)
                 return
             except (ConnectionError, asyncio.TimeoutError) as e:
+                if task_id.binary() in self._cancelled_tasks:
+                    self._store_error(oids, TaskCancelledError("task was cancelled"))
+                    return
                 last_err = ActorDiedError(
                     f"actor {aid} died during call to {method!r}: {e}"
                 )
@@ -2069,7 +2128,59 @@ class Worker:
             except ActorDiedError as e:
                 last_err = e
                 break
+        if task_id.binary() in self._cancelled_tasks:
+            last_err = TaskCancelledError("task was cancelled")
         self._store_error(oids, last_err or ActorDiedError("actor call failed"))
+
+    def cancel(self, ref, force: bool = False, recursive: bool = False):
+        """Cancel the task that produces `ref` (ray.cancel semantics,
+        task_manager.h CancelTask role): a task still queued owner-side is
+        dropped immediately; a running one gets TaskCancelledError raised in
+        its executing thread (best-effort — lands at a bytecode boundary);
+        force=True hard-kills the executing worker process instead (the only
+        way out of C-level blocking calls).  Either way the ref's get()
+        raises TaskCancelledError and the task is never retried.  A task
+        that already finished is untouched (no-op).  `recursive` is accepted
+        for API parity; child tasks cancel when their own refs are
+        cancelled."""
+        oid = ref.id
+        task_id = oid.task_id().binary()
+
+        def _do():
+            if self.memory_store.get_entry(oid) is not None and (
+                self.memory_store.get_entry(oid).state != "pending"
+            ):
+                return  # already finished: no-op
+            self._cancelled_tasks.add(task_id)
+            # queued in a backlog: drop it right now
+            for pool in self._lease_pools.values():
+                for item in list(pool.backlog):
+                    if item[0].binary() == task_id:
+                        pool.backlog.remove(item)
+                        pool.inflight_total -= 1
+                        self._store_error(
+                            item[3], TaskCancelledError("task was cancelled")
+                        )
+                        return
+            addr = self._inflight_tasks.get(task_id)
+            if addr is not None:
+                conn = self._conns.get(addr)
+                if conn is not None and not conn.closed:
+                    try:
+                        conn.notify("cancel", task_id=task_id, force=force)
+                    except ConnectionError:
+                        pass  # worker already gone; death path settles the ref
+            else:
+                # not pushed yet (awaiting a lease / resolving args): settle
+                # THIS ref immediately — a cancelled task must not stay
+                # pending until cluster capacity frees — and leave the
+                # cancelled mark so the submit path releases its lease and
+                # settles any sibling return oids when it wakes
+                self.memory_store.put_error(
+                    oid, TaskCancelledError("task was cancelled")
+                )
+
+        self.loop.call_soon_threadsafe(_do)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.head_call("kill_actor", actor_id=actor_id.hex(), no_restart=no_restart)

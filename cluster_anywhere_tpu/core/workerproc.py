@@ -19,6 +19,7 @@ import os
 import sys
 import threading
 import traceback
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from . import serialization
@@ -71,6 +72,18 @@ class WorkerProcess:
         # producer-side backpressure state per streaming task:
         # task_id -> {"acked": int, "event": threading.Event}
         self._streams: Dict[bytes, dict] = {}
+        # task_id -> executing thread id (cancellation target)
+        self._running_tasks: Dict[bytes, int] = {}
+        # cancels that arrived BEFORE their task started executing (the push
+        # may still be resolving args / fetching the function definition):
+        # checked at _exec_sync entry.  FIFO-capped — a stale entry for a
+        # task that already finished elsewhere must not pin memory forever.
+        self._precancelled: "deque[bytes]" = deque(maxlen=1024)
+        # every task id a cancel was ever requested for on this worker: lets
+        # the execution wrapper distinguish a LEGITIMATE TaskCancelledError
+        # from one that was async-delivered into the wrong task (the target
+        # finished and the pool thread moved on in the race window)
+        self._cancel_requested: set = set()
         # task events buffered here, flushed to the head by the heartbeat loop
         # (analogue of core_worker/task_event_buffer.h -> GcsTaskManager)
         self._task_events: List[dict] = []
@@ -185,16 +198,39 @@ class WorkerProcess:
 
     # --------------------------------------------------------------- execute
     def _exec_sync(self, fn, msg, task_id: bytes, actor_id: Optional[str]) -> List[dict]:
-        """Arg resolution + user code + result packaging in ONE executor job:
-        per-caller actor-call ordering is preserved end-to-end (frames are
-        submitted to the executor in arrival order) and the hot path pays a
-        single thread hop."""
+        """Arg resolution + user code + result packaging in ONE executor job
+        (per-caller actor-call ordering preserved end-to-end, one thread
+        hop).  TaskCancelledError delivered here when the task was never
+        actually cancel-requested means the async exception landed in the
+        wrong task (cancel raced the pool thread finishing its target and
+        starting us): re-run once — same at-least-once semantics as a
+        worker-death retry."""
+        try:
+            return self._exec_sync_inner(fn, msg, task_id, actor_id)
+        except TaskCancelledError:
+            if task_id in self._cancel_requested:
+                self._cancel_requested.discard(task_id)
+                raise
+            return self._exec_sync_inner(fn, msg, task_id, actor_id)
+
+    def _exec_sync_inner(self, fn, msg, task_id: bytes, actor_id: Optional[str]) -> List[dict]:
         args, kwargs = self._resolve_args(msg["args"], msg.get("kwargs"))
         w = self.worker
         w.current_task_id = TaskID(task_id)
         if actor_id:
             w.current_actor_id = ActorID.from_hex(actor_id)
         ctx = None
+        # cancellation point: ca.cancel() raises TaskCancelledError in this
+        # thread via PyThreadState_SetAsyncExc (task_canceller.h role); a
+        # cancel that raced ahead of execution start fires here instead
+        if task_id in self._precancelled:
+            try:
+                self._precancelled.remove(task_id)
+            except ValueError:
+                pass
+            w.current_task_id = None
+            raise TaskCancelledError("task was cancelled")
+        self._running_tasks[task_id] = threading.get_ident()
         try:
             if msg.get("runtime_env"):
                 from .runtime_env import RuntimeEnvContext
@@ -203,11 +239,43 @@ class WorkerProcess:
                 ctx.apply()  # inside try: a partial apply must still restore
             value = fn(*args, **kwargs)
         finally:
+            self._running_tasks.pop(task_id, None)
             w.current_task_id = None
             if ctx is not None:
                 ctx.restore()  # pool workers are reused
         return self._package_results(
             task_id, msg.get("num_returns", 1), value, msg.get("owner", "")
+        )
+
+    def _h_cancel_task(self, msg):
+        """Owner-requested cancellation of a task running HERE.  Non-force:
+        raise TaskCancelledError inside the executing thread (CPython async
+        exception — lands at the next bytecode boundary, so C-level blocking
+        calls are not interruptible; that is what force is for).  Force:
+        hard-exit the process; the owner maps the resulting worker death to
+        TaskCancelledError instead of a retry."""
+        task_id = msg["task_id"]
+        if len(self._cancel_requested) > 1024:  # rare-leak bound (see wrapper)
+            self._cancel_requested.clear()
+        self._cancel_requested.add(task_id)
+        if msg.get("force"):
+            if task_id in self._running_tasks:
+                os._exit(1)
+            # not running yet: the pre-cancel check at _exec_sync entry stops
+            # it before user code, which force semantics subsume
+            self._precancelled.append(task_id)
+            return
+        tid = self._running_tasks.get(task_id)
+        if tid is None:
+            # the push may still be resolving args / fetching the function:
+            # remember the cancel so execution start aborts (finished tasks
+            # leave a harmless FIFO-capped entry; the owner no-ops those)
+            self._precancelled.append(task_id)
+            return
+        import ctypes
+
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError)
         )
 
     def _record_event(self, task_id: bytes, name: str, kind: str, t0: float, ok: bool):
@@ -506,6 +574,7 @@ class WorkerProcess:
             reply()
             await self._graceful_exit()
         elif m == "cancel":
+            self._h_cancel_task(msg)
             reply()
         else:
             reply_err(ValueError(f"unknown worker method {m}"))
