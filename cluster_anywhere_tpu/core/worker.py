@@ -1791,6 +1791,7 @@ class Worker:
                 args=[],
                 kwargs={},
                 num_returns=opts.get("num_returns", 1),
+                retriable=opts.get("max_retries", self.config.default_max_retries) > 0,
             )
         except ConnectionError:
             self._inflight_tasks.pop(task_id.binary(), None)
@@ -1862,6 +1863,7 @@ class Worker:
                     kwargs=kwspecs,
                     num_returns=opts.get("num_returns", 1),
                     runtime_env=opts.get("runtime_env"),
+                    retriable=retries > 0,
                     timeout=None,
                 )
             except ConnectionError as e:
@@ -2080,6 +2082,7 @@ class Worker:
                 args=[],
                 kwargs={},
                 num_returns=opts.get("num_returns", 1),
+                retriable=opts.get("max_task_retries", 0) > 0,
             )
         except ConnectionError:
             return self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
@@ -2110,6 +2113,7 @@ class Worker:
                         args=specs,
                         kwargs=kwspecs,
                         num_returns=opts.get("num_returns", 1),
+                        retriable=opts.get("max_task_retries", 0) > 0,
                         timeout=None,
                     )
                 finally:

@@ -82,8 +82,13 @@ class WorkerProcess:
         # every task id a cancel was ever requested for on this worker: lets
         # the execution wrapper distinguish a LEGITIMATE TaskCancelledError
         # from one that was async-delivered into the wrong task (the target
-        # finished and the pool thread moved on in the race window)
-        self._cancel_requested: set = set()
+        # finished and the pool thread moved on in the race window).
+        # FIFO-capped: eviction drops OLDEST marks first (a clear() could
+        # wipe a mark whose async exception is still in flight)
+        self._cancel_requested: "deque[bytes]" = deque(maxlen=1024)
+        # async actor-method tasks in flight: task_id -> asyncio.Task
+        # (cancellation for coroutines is task.cancel(), not async exc)
+        self._async_running: Dict[bytes, Any] = {}
         # task events buffered here, flushed to the head by the heartbeat loop
         # (analogue of core_worker/task_event_buffer.h -> GcsTaskManager)
         self._task_events: List[dict] = []
@@ -209,9 +214,27 @@ class WorkerProcess:
             return self._exec_sync_inner(fn, msg, task_id, actor_id)
         except TaskCancelledError:
             if task_id in self._cancel_requested:
-                self._cancel_requested.discard(task_id)
+                try:
+                    self._cancel_requested.remove(task_id)
+                except ValueError:
+                    pass
                 raise
-            return self._exec_sync_inner(fn, msg, task_id, actor_id)
+            if msg.get("retriable", True):
+                return self._exec_sync_inner(fn, msg, task_id, actor_id)
+            raise TaskError(
+                "task interrupted by a cancellation aimed at another task "
+                "and declared non-retriable (max_retries=0)"
+            )
+        finally:
+            if self._cancel_requested or self._precancelled:
+                # backstop for the delivery race: retract any async
+                # exception still pending on THIS thread before it returns
+                # to the pool (an escape there kills the executor thread)
+                import ctypes
+
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(threading.get_ident()), None
+                )
 
     def _exec_sync_inner(self, fn, msg, task_id: bytes, actor_id: Optional[str]) -> List[dict]:
         args, kwargs = self._resolve_args(msg["args"], msg.get("kwargs"))
@@ -255,9 +278,14 @@ class WorkerProcess:
         hard-exit the process; the owner maps the resulting worker death to
         TaskCancelledError instead of a retry."""
         task_id = msg["task_id"]
-        if len(self._cancel_requested) > 1024:  # rare-leak bound (see wrapper)
-            self._cancel_requested.clear()
-        self._cancel_requested.add(task_id)
+        self._cancel_requested.append(task_id)
+        atask = self._async_running.get(task_id)
+        if atask is not None:
+            # coroutine actor method: asyncio cancellation is exact (no
+            # async-exc race) and covers force too — the method unwinds at
+            # its next await
+            atask.cancel()
+            return
         if msg.get("force"):
             if task_id in self._running_tasks:
                 os._exit(1)
@@ -277,6 +305,12 @@ class WorkerProcess:
         ctypes.pythonapi.PyThreadState_SetAsyncExc(
             ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError)
         )
+        if self._running_tasks.get(task_id) != tid:
+            # the target finished between lookup and delivery: try to
+            # retract before the pending exception fires in whatever that
+            # thread runs next (best-effort; the _exec_sync wrapper's
+            # trailing clear is the backstop)
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
 
     def _record_event(self, task_id: bytes, name: str, kind: str, t0: float, ok: bool):
         import time as _time
@@ -322,7 +356,15 @@ class WorkerProcess:
                     )
                     sem = self._semaphore_for(method)
                     async with sem if sem is not None else contextlib.nullcontext():
-                        value = await method(*args, **kwargs)
+                        # tracked so ca.cancel() can asyncio-cancel it
+                        coro_task = asyncio.ensure_future(method(*args, **kwargs))
+                        self._async_running[task_id] = coro_task
+                        try:
+                            value = await coro_task
+                        except asyncio.CancelledError:
+                            raise TaskCancelledError("task was cancelled")
+                        finally:
+                            self._async_running.pop(task_id, None)
                     out = await self.loop.run_in_executor(
                         None,
                         self._package_results,
@@ -381,6 +423,9 @@ class WorkerProcess:
         limit = self.config.streaming_backpressure
         stream = {"acked": 0, "event": threading.Event()}
         self._streams[task_id] = stream
+        # generator tasks are cancellable too (async exc lands between
+        # yields; force kills the process like any running task)
+        self._running_tasks[task_id] = threading.get_ident()
         t0 = _time.time()
         idx = 0
         try:
@@ -421,6 +466,7 @@ class WorkerProcess:
             return {"results": [], "stream_end": True, "count": idx, "stream_error": err}
         finally:
             self._streams.pop(task_id, None)
+            self._running_tasks.pop(task_id, None)
 
     def _h_stream_ack(self, msg):
         stream = self._streams.get(msg["task_id"])

@@ -100,3 +100,38 @@ def test_actor_restart_under_kill(fresh_cluster):
         except Exception:
             time.sleep(0.2)
     assert pid2 is not None and pid2 != pid1
+
+
+def test_rpc_chaos_cancel_notify_dropped(fresh_cluster):
+    """A dropped cancel notify (dead connection injected) must not crash the
+    owner or hang the caller: the running task completes normally (cancel is
+    best-effort by contract when its delivery fails) and later cancels on a
+    recovered path still work."""
+    import time
+
+    reset_rpc_chaos("cancel=1")
+
+    @ca.remote
+    def brief():
+        for _ in range(20):
+            time.sleep(0.05)
+        return "done"
+
+    ref = brief.remote()
+    time.sleep(0.3)
+    ca.cancel(ref)  # the notify send fails (chaos) -> best-effort no-op
+    # owner survives; the ref settles (value or cancelled, depending on
+    # whether the connection-failure path settled it) without hanging
+    try:
+        out = ca.get(ref, timeout=30)
+        assert out == "done"
+    except ca.exceptions.TaskCancelledError:
+        pass
+    reset_rpc_chaos("")
+    ref2 = brief.remote()
+    time.sleep(0.3)
+    ca.cancel(ref2)
+    import pytest as _pytest
+
+    with _pytest.raises(ca.exceptions.TaskCancelledError):
+        ca.get(ref2, timeout=30)
