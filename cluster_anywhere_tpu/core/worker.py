@@ -768,6 +768,9 @@ class Worker:
                 addr = await self._actor_addr(actor_hex)
             st.addr = addr
             conn = await self.conn_to(addr)
+            # cancellable like any pushed task: ca.cancel() needs the
+            # executing worker's address to deliver the interrupt
+            self._inflight_tasks[task_id.binary()] = self._normalize_peer_addr(addr)
             fields = dict(
                 task_id=task_id.binary(),
                 owner=self.client_id,
@@ -794,6 +797,7 @@ class Worker:
         except BaseException as e:
             st.on_end(e if isinstance(e, CAError) else TaskError(repr(e)))
         finally:
+            self._inflight_tasks.pop(task_id.binary(), None)
             if lease is not None:
                 pool.release(lease, dead=False)
             self._streams.pop(task_id.binary(), None)
@@ -2151,10 +2155,14 @@ class Worker:
         task_id = oid.task_id().binary()
 
         def _do():
-            if self.memory_store.get_entry(oid) is not None and (
-                self.memory_store.get_entry(oid).state != "pending"
-            ):
-                return  # already finished: no-op
+            # task-level liveness first: a STREAM item's value arriving does
+            # not mean the generator finished, and an in-flight push may
+            # have already satisfied this particular return
+            active = task_id in self._inflight_tasks or task_id in self._streams
+            if not active:
+                e = self.memory_store.get_entry(oid)
+                if e is not None and e.state != "pending":
+                    return  # already finished: no-op
             self._cancelled_tasks.add(task_id)
             # queued in a backlog: drop it right now
             for pool in self._lease_pools.values():

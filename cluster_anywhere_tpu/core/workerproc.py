@@ -282,9 +282,16 @@ class WorkerProcess:
         atask = self._async_running.get(task_id)
         if atask is not None:
             # coroutine actor method: asyncio cancellation is exact (no
-            # async-exc race) and covers force too — the method unwinds at
-            # its next await
+            # async-exc race).  force cannot rely on cooperation (the method
+            # may suppress CancelledError): hard-exit if it is still running
+            # after a grace period
             atask.cancel()
+            if msg.get("force"):
+                def _enforce():
+                    if task_id in self._async_running:
+                        os._exit(1)
+
+                self.loop.call_later(1.0, _enforce)
             return
         if msg.get("force"):
             if task_id in self._running_tasks:
@@ -359,6 +366,14 @@ class WorkerProcess:
                         # tracked so ca.cancel() can asyncio-cancel it
                         coro_task = asyncio.ensure_future(method(*args, **kwargs))
                         self._async_running[task_id] = coro_task
+                        if task_id in self._precancelled:
+                            # cancel landed while args resolved / semaphore
+                            # queued: apply it now instead of dropping it
+                            try:
+                                self._precancelled.remove(task_id)
+                            except ValueError:
+                                pass
+                            coro_task.cancel()
                         try:
                             value = await coro_task
                         except asyncio.CancelledError:
@@ -460,6 +475,22 @@ class WorkerProcess:
                 w.current_task_id = None
             self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, True)
             return {"results": [], "stream_end": True, "count": idx}
+        except TaskCancelledError as e:
+            self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, False)
+            if task_id not in self._cancel_requested:
+                # stray delivery (cancel aimed at a task this thread just
+                # finished): a stream cannot re-run mid-way, so surface an
+                # explicit error rather than a false "cancelled"
+                e = TaskError(
+                    "stream interrupted by a cancellation aimed at another task"
+                )
+            else:
+                try:
+                    self._cancel_requested.remove(task_id)
+                except ValueError:
+                    pass
+            err = self._error_results(1, e)[0]["e"]
+            return {"results": [], "stream_end": True, "count": idx, "stream_error": err}
         except BaseException as e:
             self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, False)
             err = self._error_results(1, e)[0]["e"]
@@ -467,6 +498,14 @@ class WorkerProcess:
         finally:
             self._streams.pop(task_id, None)
             self._running_tasks.pop(task_id, None)
+            if self._cancel_requested or self._precancelled:
+                # same backstop as _exec_sync: retract a pending async
+                # exception before this pool thread is reused
+                import ctypes
+
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(threading.get_ident()), None
+                )
 
     def _h_stream_ack(self, msg):
         stream = self._streams.get(msg["task_id"])

@@ -107,8 +107,6 @@ def test_rpc_chaos_cancel_notify_dropped(fresh_cluster):
     owner or hang the caller: the running task completes normally (cancel is
     best-effort by contract when its delivery fails) and later cancels on a
     recovered path still work."""
-    import time
-
     reset_rpc_chaos("cancel=1")
 
     @ca.remote
@@ -131,7 +129,5 @@ def test_rpc_chaos_cancel_notify_dropped(fresh_cluster):
     ref2 = brief.remote()
     time.sleep(0.3)
     ca.cancel(ref2)
-    import pytest as _pytest
-
-    with _pytest.raises(ca.exceptions.TaskCancelledError):
+    with pytest.raises(ca.exceptions.TaskCancelledError):
         ca.get(ref2, timeout=30)
