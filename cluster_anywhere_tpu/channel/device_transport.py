@@ -35,6 +35,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -53,12 +54,18 @@ _stats = {
     "leaves_packed": 0,
     "dlpack_views": 0,  # zero-copy device-buffer borrows
     "asarray_views": 0,  # numpy fallback (bf16 etc. — dlpack dtype gap)
+    "asarray_bytes": 0,  # bytes through that fallback (the copy cost probe)
     "leaves_landed": 0,
     "sharded_landings": 0,  # landed under a reconstructed NamedSharding
     "host_assembles": 0,  # full-host fallback (strict mode forbids)
 }
+# Both registries are bounded: a long-lived worker registering per-step
+# meshes (or landing envelopes from many distinct mesh shapes) must not
+# grow them without limit — same bug class as the r3 collectives-KV leak.
+_MESH_REGISTRY_CAP = 8
+_BUILT_MESHES_CAP = 32
 _mesh_registry: List[Any] = []
-_built_meshes: Dict[Tuple[Tuple[int, ...], Tuple[str, ...]], Any] = {}
+_built_meshes: "OrderedDict[Tuple, Any]" = OrderedDict()
 
 
 def stats() -> Dict[str, int]:
@@ -83,11 +90,14 @@ def _strict() -> bool:
 
 def set_transfer_mesh(mesh) -> None:
     """Register the mesh incoming sharded arrays should land on.  Without a
-    registration, an equivalent mesh (same shape + axis names) is built over
-    jax.devices() — correct whenever both processes enumerate their local
-    devices the same way, which is the single-host case by construction."""
+    registration, the envelope's device coordinates (process_index, device
+    id) rebuild the producer's exact mesh when the consumer can see those
+    devices; otherwise an equivalent mesh (same shape + axis names) is built
+    over jax.devices().  Newest registration wins; the registry keeps only
+    the last _MESH_REGISTRY_CAP meshes (a per-step registrant must not leak)."""
     with _lock:
         _mesh_registry.append(mesh)
+        del _mesh_registry[:-_MESH_REGISTRY_CAP]
 
 
 class _LeafMarker:
@@ -173,6 +183,7 @@ def _shard_view(arr) -> np.ndarray:
     except Exception:
         v = np.asarray(arr)
         _bump("asarray_views")
+        _bump("asarray_bytes", v.nbytes)
     return v
 
 
@@ -196,6 +207,14 @@ def _sharding_desc(x) -> Dict[str, Any]:
             "mesh_shape": tuple(mesh.devices.shape),
             "axis_names": tuple(mesh.axis_names),
             "spec": tuple(s.spec),
+            # device coordinates per flattened mesh position: the consumer
+            # rebuilds the producer's EXACT device arrangement when it can
+            # resolve them (same jax.distributed runtime, or same-host
+            # processes whose local enumerations agree), instead of assuming
+            # jax.devices()[:n] row-major order
+            "mesh_coords": tuple(
+                (int(d.process_index), int(d.id)) for d in mesh.devices.flat
+            ),
         }
     if len(getattr(s, "device_set", [None])) <= 1:
         return {"kind": "single"}
@@ -267,9 +286,14 @@ def pack_device_value(value: Any) -> DeviceEnvelope:
 # ------------------------------------------------------------------- unpack
 
 
-def _landing_mesh(mesh_shape: Tuple[int, ...], axis_names: Tuple[str, ...]):
+def _landing_mesh(
+    mesh_shape: Tuple[int, ...],
+    axis_names: Tuple[str, ...],
+    mesh_coords: Optional[Tuple[Tuple[int, int], ...]] = None,
+):
     import jax
 
+    key = (mesh_shape, axis_names, mesh_coords)
     with _lock:
         for m in reversed(_mesh_registry):
             if (
@@ -277,18 +301,39 @@ def _landing_mesh(mesh_shape: Tuple[int, ...], axis_names: Tuple[str, ...]):
                 and tuple(m.devices.shape) == mesh_shape
             ):
                 return m
-        key = (mesh_shape, axis_names)
         if key in _built_meshes:
+            _built_meshes.move_to_end(key)
             return _built_meshes[key]
     n = 1
     for d in mesh_shape:
         n *= d
     devs = jax.devices()
-    if n > len(devs):
-        return None
-    mesh = jax.sharding.Mesh(np.array(devs[:n]).reshape(mesh_shape), axis_names)
+    mesh = None
+    if mesh_coords is not None and len(mesh_coords) == n:
+        # exact reconstruction: map each mesh position to the consumer's
+        # device with the same (process_index, id).  Resolves whenever both
+        # processes are in one jax.distributed runtime, or are same-host
+        # processes whose local enumerations agree (then process_index and
+        # ids coincide position-for-position).
+        by_coord = {(int(d.process_index), int(d.id)): d for d in devs}
+        try:
+            arranged = [by_coord[c] for c in mesh_coords]
+        except KeyError:
+            arranged = None  # foreign runtime: fall through to equivalent mesh
+        if arranged is not None:
+            mesh = jax.sharding.Mesh(
+                np.array(arranged).reshape(mesh_shape), axis_names
+            )
+    if mesh is None:
+        if n > len(devs):
+            return None
+        mesh = jax.sharding.Mesh(
+            np.array(devs[:n]).reshape(mesh_shape), axis_names
+        )
     with _lock:
         _built_meshes[key] = mesh
+        while len(_built_meshes) > _BUILT_MESHES_CAP:
+            _built_meshes.popitem(last=False)
     return mesh
 
 
@@ -337,7 +382,9 @@ def _land_leaf(leaf: _LeafPack):
     _bump("leaves_landed")
     desc = leaf.desc
     if desc["kind"] == "named":
-        mesh = _landing_mesh(desc["mesh_shape"], desc["axis_names"])
+        mesh = _landing_mesh(
+            desc["mesh_shape"], desc["axis_names"], desc.get("mesh_coords")
+        )
         if mesh is not None:
             sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(*desc["spec"])

@@ -312,8 +312,8 @@ class ShmObjectStore:
         self._arena_seq = 0
         self._grow_lock = threading.Lock()  # one arena creation at a time
         # live slices this process sealed, insertion-ordered (spill picks the
-        # oldest): name -> (alloc_offset, alloc_size, oid_bytes)
-        self._live_slices: Dict[str, Tuple[int, int, bytes]] = {}
+        # oldest): name -> (alloc_offset, alloc_size, oid_bytes, seal_seq)
+        self._live_slices: Dict[str, Tuple[int, int, bytes, int]] = {}
         # slices whose payload is still being written (packed locally or
         # filled from the network): NOT spill candidates — the background
         # spiller would persist torn bytes and recycle memory under the
@@ -321,6 +321,11 @@ class ShmObjectStore:
         self._writing: set = set()
         self._slice_seq = 0
         self._live_bytes = 0  # sum of live-slice allocations (watermark input)
+        # dedicated segments this process sealed (objects > _ARENA_MAX_OBJ or
+        # arena-exhausted puts): name -> (size, oid_bytes, seq).  Counted in
+        # _live_bytes and offered as spill candidates — a huge-object
+        # workload must trip the watermark too, not just the inline wall.
+        self._live_segments: Dict[str, Tuple[int, bytes, int]] = {}
         self.budget_bytes = budget_bytes  # 0 = uncapped
         self.spill_cb = None  # set by the Worker; fn(bytes_needed) -> None
         # proactive spill (local_object_manager.h IO-worker analogue): when
@@ -341,13 +346,20 @@ class ShmObjectStore:
     def live_slices_oldest_first(self) -> List[Tuple[str, int, bytes]]:
         """Spill-candidate view: (shm_name, payload_size, oid) oldest first.
         Only primary slices qualify — pulled copies are droppable, not
-        spillable, and carry an empty oid tag."""
+        spillable, and carry an empty oid tag.  Dedicated segments are
+        candidates too, interleaved by seal sequence."""
         with self._lock:
-            return [
-                (name, alloc - _SLICE_HDR, oid)
-                for name, (off, alloc, oid) in self._live_slices.items()
+            out = [
+                (name, alloc - _SLICE_HDR, oid, seq)
+                for name, (off, alloc, oid, seq) in self._live_slices.items()
                 if oid and name not in self._writing
             ]
+            out += [
+                (name, size, oid, seq)
+                for name, (size, oid, seq) in self._live_segments.items()
+            ]
+        out.sort(key=lambda t: t[3])
+        return [(name, size, oid) for name, size, oid, _seq in out]
 
     # -- native acceleration ------------------------------------------------
     def _native_lib(self):
@@ -469,7 +481,9 @@ class ShmObjectStore:
         name = f"{arena.name}@{off}+{payload_size}#{seq}"
         alloc = _align_up(payload_size + _SLICE_HDR)
         with self._lock:
-            self._live_slices[name] = (off, alloc, oid.binary() if primary else b"")
+            self._live_slices[name] = (
+                off, alloc, oid.binary() if primary else b"", seq
+            )
             self._live_bytes += alloc
             self._writing.add(name)
         return name, memoryview(arena.mm)[off + _SLICE_HDR : off + _SLICE_HDR + payload_size]
@@ -513,7 +527,19 @@ class ShmObjectStore:
                 mv.release()
                 self.seal_done(name)
                 return name, size
-        # dedicated segment path (huge objects, or arena creation failed)
+        # dedicated segment path (huge objects, or arena creation failed).
+        # Same inline spill wall as _arena_alloc: a burst of huge puts over
+        # budget must try to free room before asking /dev/shm for more —
+        # the async watermark kick alone may not land in time.
+        if (
+            self.budget_bytes
+            and self.spill_cb is not None
+            and self.live_bytes() + size > self.budget_bytes
+        ):
+            try:
+                self.spill_cb(size)
+            except Exception:
+                pass
         name = self.name_for(oid)
         path = os.path.join(SHM_DIR, name)
         tmp = path + ".tmp"
@@ -533,6 +559,11 @@ class ShmObjectStore:
             raise ObjectStoreFullError(str(e)) from e
         os.close(fd)
         os.rename(tmp, path)  # atomic seal
+        with self._lock:
+            self._slice_seq += 1
+            self._live_segments[name] = (size, oid.binary(), self._slice_seq)
+            self._live_bytes += size
+        self.seal_done(name)  # watermark check (never in _writing: no-op discard)
         return name, size
 
     def create_for_import(self, oid: ObjectID, size: int, primary: bool = False) -> Tuple[str, memoryview]:
@@ -571,8 +602,21 @@ class ShmObjectStore:
         object); no-op for names this process doesn't own.  Idempotent: a
         slice already freed (e.g. spilled synchronously, then the head's
         reclaim broadcast arrives) is skipped — double-free would corrupt the
-        coalescing free list."""
+        coalescing free list.  Dedicated segments this process sealed are
+        reclaimed too (unlink + accounting); import segments stay the
+        province of abort_import (they hold writable mappings)."""
         if "@" not in shm_name:
+            with self._lock:
+                seg = self._live_segments.pop(shm_name, None)
+                if seg is not None:
+                    self._live_bytes -= seg[0]
+            if seg is None:
+                return  # import/unknown segment, or already freed
+            self.release(shm_name)
+            try:
+                os.unlink(os.path.join(SHM_DIR, shm_name))
+            except OSError:
+                pass
             return
         try:
             arena_name, off, size, _seq = self.parse_slice(shm_name)
@@ -694,6 +738,11 @@ class ShmObjectStore:
     def unlink(self, shm_name: str):
         if "@" in shm_name:
             self.free_local(shm_name)
+            return
+        with self._lock:
+            tracked = shm_name in self._live_segments
+        if tracked:
+            self.free_local(shm_name)  # keeps _live_bytes accounting right
             return
         self.release(shm_name)
         try:

@@ -18,6 +18,7 @@ import io
 import os
 import shutil
 import sys
+import uuid
 import zipfile
 from typing import Any, Dict, List, Optional
 
@@ -152,7 +153,10 @@ class RuntimeEnvContext:
         if data is None:
             raise RuntimeError(f"runtime_env package {digest} missing from cluster KV")
         os.makedirs(cache_root, exist_ok=True)
-        tmp = dest + f".tmp{os.getpid()}"
+        # pid alone is not unique: two executor threads in one worker (actor
+        # max_concurrency / concurrency groups) applying the same spec would
+        # interleave into a shared tmp and poison the cache for the session
+        tmp = dest + f".tmp{os.getpid()}.{uuid.uuid4().hex[:8]}"
         with zipfile.ZipFile(io.BytesIO(data)) as z:
             z.extractall(tmp)
         try:
@@ -175,7 +179,7 @@ class RuntimeEnvContext:
         if os.path.isdir(dest):
             return dest
         os.makedirs(os.path.dirname(dest), exist_ok=True)
-        tmp = dest + f".tmp{os.getpid()}"
+        tmp = dest + f".tmp{os.getpid()}.{uuid.uuid4().hex[:8]}"
         cmd = [
             sys.executable, "-m", "pip", "install", "--quiet",
             "--no-index", "--find-links", norm["find_links"],

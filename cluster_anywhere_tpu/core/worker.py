@@ -1891,7 +1891,16 @@ class Worker:
     def _store_error(self, oids: List[ObjectID], e: BaseException):
         err = e if isinstance(e, CAError) else TaskError(repr(e))
         if oids:
-            self._cancelled_tasks.discard(oids[0].task_id().binary())
+            tid = oids[0].task_id().binary()
+            if tid in self._cancelled_tasks and not isinstance(
+                e, TaskCancelledError
+            ):
+                # the caller cancelled this task; whatever error the push
+                # path surfaced afterwards (arg-resolution failure, backlog
+                # drain) must not outrank the cancellation — a sibling ref's
+                # get() may already have raised TaskCancelledError
+                err = TaskCancelledError("task was cancelled")
+            self._cancelled_tasks.discard(tid)
         for oid in oids:
             self.memory_store.put_error(oid, err)
 

@@ -131,8 +131,16 @@ class ContinuousLLMServer:
         self._reqs: dict = {}  # request_id -> Request (done detection)
         self._queue_cls = queue.Queue
         self._stop = False
+        self._engine_error: Optional[BaseException] = None
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump.start()
+
+    def check_health(self):
+        """Serve controller hook: a dead pump means every request on this
+        replica would hang to queue timeout — report it so the controller
+        replaces the replica instead."""
+        if self._engine_error is not None:
+            raise RuntimeError(f"LLM engine pump died: {self._engine_error!r}")
 
     def close(self):
         """Stop the pump thread (dropping a replica without close() would
@@ -151,21 +159,34 @@ class ContinuousLLMServer:
         import time as _time
 
         while not self._stop:
-            with self._lock:
-                work = self.cb.has_work
-                out = self.cb.step() if work else {}
-                delivered = []
-                for rid, toks in out.items():
-                    q = self._queues.get(rid)
-                    req = self._reqs.get(rid)
-                    if q is not None:
-                        for t in toks:
-                            q.put(t)
-                        if req is not None and req.done:
-                            q.put(None)
-                            delivered.append(rid)
-                for rid in delivered:
-                    self._reqs.pop(rid, None)
+            try:
+                with self._lock:
+                    work = self.cb.has_work
+                    out = self.cb.step() if work else {}
+                    delivered = []
+                    for rid, toks in out.items():
+                        q = self._queues.get(rid)
+                        req = self._reqs.get(rid)
+                        if q is not None:
+                            for t in toks:
+                                q.put(t)
+                            if req is not None and req.done:
+                                q.put(None)
+                                delivered.append(rid)
+                    for rid in delivered:
+                        self._reqs.pop(rid, None)
+            except BaseException as e:
+                # engine failure (device OOM, shape bug): without this the
+                # pump dies silently and every request blocks to the queue
+                # timeout.  Fail fast: error every in-flight queue, mark the
+                # replica unhealthy, stop pumping.
+                with self._lock:
+                    self._engine_error = e
+                    for q in self._queues.values():
+                        q.put(e)
+                    self._queues.clear()
+                    self._reqs.clear()
+                return
             if not work:
                 _time.sleep(0.005)
 
@@ -177,6 +198,10 @@ class ContinuousLLMServer:
         top_k = body.get("top_k")
         q = self._queue_cls()
         with self._lock:
+            if self._engine_error is not None:
+                raise RuntimeError(
+                    f"LLM engine pump died: {self._engine_error!r}"
+                ) from self._engine_error
             # queue registered under the same lock as submit: the pump's
             # next step (admit + decode) finds it before any token flows
             req = self.cb.submit(
@@ -200,6 +225,8 @@ class ContinuousLLMServer:
                 t = q.get(timeout=120)
                 if t is None:
                     break
+                if isinstance(t, BaseException):
+                    raise RuntimeError(f"LLM engine pump died: {t!r}") from t
                 toks.append(t)
         finally:
             self._forget(req)
@@ -221,6 +248,8 @@ class ContinuousLLMServer:
                 t = q.get(timeout=120)
                 if t is None:
                     return
+                if isinstance(t, BaseException):
+                    raise RuntimeError(f"LLM engine pump died: {t!r}") from t
                 yield {
                     "token_id": int(t),
                     "text": self.tok.decode(np.asarray([t], np.int32)),

@@ -290,3 +290,53 @@ def test_moe_generate_and_continuous_batching():
     req = cb.submit([1, 5, 9], max_new_tokens=6)
     cb.pump()
     assert req.done and req.out_tokens == np.asarray(a)[0].tolist()
+
+
+def test_continuous_llm_server_pump_death_fails_fast():
+    """An engine failure inside the pump loop (device OOM, shape bug) must
+    not strand callers until the 120s queue timeout: in-flight requests get
+    the error immediately, check_health reports the replica dead (so the
+    serve controller replaces it), and new submits are refused."""
+    import threading
+
+    import pytest
+
+    from cluster_anywhere_tpu.llm import ContinuousLLMServer, ModelSpec, ProcessorConfig
+
+    cfg = ProcessorConfig(
+        model=ModelSpec(preset="tiny"), max_prompt_len=16, max_new_tokens=8,
+        temperature=0.0,
+    )
+    srv = ContinuousLLMServer(cfg, slots=4)
+    try:
+        boom = RuntimeError("simulated device OOM")
+        orig_step = srv.cb.step
+        calls = {"n": 0}
+
+        def dying_step():
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise boom
+            return orig_step()
+
+        srv.cb.step = dying_step
+        errs = {}
+
+        def call():
+            try:
+                srv({"prompt": "hello"})
+                errs["v"] = None
+            except RuntimeError as e:
+                errs["v"] = e
+
+        t = threading.Thread(target=call)
+        t.start()
+        t.join(timeout=30)  # far below the 120s queue timeout
+        assert not t.is_alive(), "caller stranded after pump death"
+        assert errs["v"] is not None and "pump died" in str(errs["v"])
+        with pytest.raises(RuntimeError, match="pump died"):
+            srv.check_health()
+        with pytest.raises(RuntimeError, match="pump died"):
+            srv({"prompt": "after death"})
+    finally:
+        srv.close()
