@@ -357,6 +357,7 @@ class ShmObjectStore:
             out += [
                 (name, size, oid, seq)
                 for name, (size, oid, seq) in self._live_segments.items()
+                if oid and name not in self._writing
             ]
         out.sort(key=lambda t: t[3])
         return [(name, size, oid) for name, size, oid, _seq in out]
@@ -557,6 +558,16 @@ class ShmObjectStore:
             os.close(fd)
             os.unlink(tmp)
             raise ObjectStoreFullError(str(e)) from e
+        except BaseException:
+            # non-OSError pack failure: the O_EXCL tmp has a FIXED name, so
+            # leaking it would brick every retry of this oid with
+            # "already being written"
+            os.close(fd)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         os.close(fd)
         os.rename(tmp, path)  # atomic seal
         with self._lock:
@@ -586,6 +597,15 @@ class ShmObjectStore:
             os.close(fd)
         with self._lock:
             self._open_maps[name] = (m, size)
+            # dedicated import segments join the watermark accounting like
+            # their arena-sized siblings (_seal_slice); _writing keeps them
+            # out of the spill-candidate list until the fill seals
+            self._slice_seq += 1
+            self._live_segments[name] = (
+                size, oid.binary() if primary else b"", self._slice_seq
+            )
+            self._live_bytes += size
+            self._writing.add(name)
         return name, memoryview(m)
 
     @staticmethod
@@ -610,8 +630,9 @@ class ShmObjectStore:
                 seg = self._live_segments.pop(shm_name, None)
                 if seg is not None:
                     self._live_bytes -= seg[0]
+                self._writing.discard(shm_name)
             if seg is None:
-                return  # import/unknown segment, or already freed
+                return  # untracked segment, or already freed
             self.release(shm_name)
             try:
                 os.unlink(os.path.join(SHM_DIR, shm_name))
@@ -644,6 +665,10 @@ class ShmObjectStore:
             return
         with self._lock:
             cached = self._open_maps.pop(shm_name, None)
+            seg = self._live_segments.pop(shm_name, None)
+            if seg is not None:
+                self._live_bytes -= seg[0]
+            self._writing.discard(shm_name)
         if cached is not None:
             try:
                 cached[0].close()

@@ -312,8 +312,6 @@ def forward(params, ids, cfg: TransformerConfig, mesh=None, return_aux: bool = F
     if cfg.sp > 1 and cfg.resolved_attn() in ("ring", "ulysses"):
         manual_axes.add("sp")
     if cfg.n_experts:
-        if cfg.pp > 1:
-            raise NotImplementedError("MoE + pipeline parallelism not wired yet")
         manual_axes.add("ep")
 
     if manual_axes:
@@ -355,17 +353,35 @@ def _apply_blocks_manual(blocks, x, cfg: TransformerConfig, mesh, manual_axes):
             stage = functools.partial(
                 _stage_forward, cfg=cfg, sp_manual=sp_manual
             )
-            out = pipeline_apply(
-                lambda bp, a: stage(bp, a)[0],
-                my_blocks,
-                x_local,
-                axis_name="pp",
-                num_microbatches=cfg.num_microbatches,
+            if cfg.n_experts:
+                # MoE through the pipeline: each stage's MoE layers
+                # all_to_all over 'ep' inside their pipeline step; the
+                # load-balance aux threads through the schedule (bubble
+                # steps masked) and comes back psum'd over stages
+                x_out, aux = pipeline_apply(
+                    stage,
+                    my_blocks,
+                    x_local,
+                    axis_name="pp",
+                    num_microbatches=cfg.num_microbatches,
+                    with_aux=True,
+                )
+            else:
+                x_out = pipeline_apply(
+                    lambda bp, a: stage(bp, a)[0],
+                    my_blocks,
+                    x_local,
+                    axis_name="pp",
+                    num_microbatches=cfg.num_microbatches,
+                )
+                aux = jnp.zeros((), jnp.float32)
+        else:
+            x_out, aux = _stage_forward(
+                blocks_local, x_local, cfg=cfg, sp_manual=sp_manual
             )
-            return out, jnp.zeros((), jnp.float32)
-        x_out, aux = _stage_forward(blocks_local, x_local, cfg=cfg, sp_manual=sp_manual)
         # the P() out-spec claims aux is replicated across EVERY manual axis;
         # each shard computed it over its own tokens, so reduce over all
+        # (pp already reduced inside pipeline_apply)
         if ep_manual:
             aux = lax.pmean(aux, "ep")
         if sp_manual:

@@ -248,3 +248,87 @@ def test_driver_get_of_device_ref_lands_sharded(ca_cluster_module):
         np.asarray(y), np.arange(32, dtype=np.float32).reshape(8, 4)
     )
     ca.kill(p)
+
+
+# --------------------------------------------------------------------------
+# cross-process: exact mesh reconstruction + cross-node landings
+# --------------------------------------------------------------------------
+
+
+def test_permuted_mesh_lands_exact_device_order(monkeypatch):
+    """The envelope's (process_index, id) coordinates must reproduce the
+    producer's EXACT device arrangement — not jax.devices()[:n] row-major
+    order.  A permuted mesh round-trips with device ids in the producer's
+    order (r4 weak #1: landing assumed enumeration order)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    monkeypatch.setenv("CA_DEVICE_TRANSPORT_STRICT", "1")
+    dt.reset_stats()
+    devs = jax.devices()
+    perm = [devs[i] for i in (3, 1, 7, 5, 0, 2, 4, 6)]
+    mesh = jax.sharding.Mesh(np.array(perm).reshape(2, 4), ("a", "b"))
+    x = jax.device_put(
+        jax.numpy.arange(64.0).reshape(8, 8), NamedSharding(mesh, P("a", "b"))
+    )
+    blob = serialization.pack(dt.pack_device_value(x))
+    y = dt.unpack_device_value(serialization.unpack(blob))
+    np.testing.assert_array_equal(np.asarray(y), np.arange(64.0).reshape(8, 8))
+    got = [d.id for d in y.sharding.mesh.devices.flat]
+    assert got == [d.id for d in mesh.devices.flat], got
+    assert dt.stats()["host_assembles"] == 0
+
+
+def test_transport_registries_bounded():
+    """Per-step mesh registrations and landing-mesh builds must not leak
+    (r4 weak #6 — same class as the r3 collectives-KV finding)."""
+    import jax
+
+    devs = jax.devices()
+    for i in range(3 * dt._MESH_REGISTRY_CAP):
+        dt.set_transfer_mesh(
+            jax.sharding.Mesh(np.array(devs[:4]), (f"reg{i}",))
+        )
+    assert len(dt._mesh_registry) <= dt._MESH_REGISTRY_CAP
+    for i in range(3 * dt._BUILT_MESHES_CAP):
+        dt._landing_mesh((2,), (f"bld{i}",), None)
+    assert len(dt._built_meshes) <= dt._BUILT_MESHES_CAP
+
+
+def test_cross_node_device_envelope_strict():
+    """A device envelope crosses an agent-NODE boundary (producer worker on
+    the head node, consumer worker on a second agent node) in strict mode:
+    the consumer receives a NamedSharding-ed jax.Array with zero host
+    assemblies.  This is the r5 'cross-node strict-mode transport' gate:
+    the landing mesh comes from the envelope's device coordinates, not a
+    same-process assumption."""
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+    from cluster_anywhere_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    if ca.is_initialized():  # the module-scoped cluster can't host 2 nodes
+        ca.shutdown()
+    c = Cluster(head_resources={"CPU": 2})
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes(2)
+    try:
+        head_nid = [n["node_id"] for n in ca.nodes() if n["node_id"] != nid][0]
+        p = _ShardProducer.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(head_nid)
+        ).remote()
+        cons = _ShardConsumer.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid)
+        ).remote()
+        ref = p.make.remote(5.0)
+        res = ca.get(cons.check.remote(ref), timeout=120)
+        assert res["is_device"] and res["named"]
+        assert res["n_devices"] == 8
+        assert res["sum"] == float(np.arange(32).sum() * 5.0)
+        assert res["host_assembles"] == 0
+        assert res["sharded_landings"] >= 1
+        ca.kill(p)
+        ca.kill(cons)
+    finally:
+        c.shutdown()
