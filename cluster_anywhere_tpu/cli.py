@@ -327,11 +327,19 @@ def cmd_microbenchmark(args):
 
     import cluster_anywhere_tpu as ca
 
-    from .microbenchmark import run_microbenchmarks
+    from . import microbenchmark as mb
+
+    runner = mb.run_microbenchmarks
+    if getattr(args, "multi", False):
+        runner = mb.run_multiclient
+    elif getattr(args, "scalability", False):
+        runner = mb.run_scalability
+    elif getattr(args, "collective", False):
+        runner = mb.run_collective_bw
 
     ca.init(num_cpus=args.num_cpus)
     try:
-        run_microbenchmarks(quick=getattr(args, "quick", False))
+        runner(quick=getattr(args, "quick", False))
     finally:
         ca.shutdown()
 
@@ -433,6 +441,18 @@ def main(argv=None):
     sp.add_argument(
         "--saturation", action="store_true",
         help="head-saturation sweep: control-plane ops/s vs clients and nodes",
+    )
+    sp.add_argument(
+        "--multi", action="store_true",
+        help="multi-client aggregate rows (client actors drive concurrently)",
+    )
+    sp.add_argument(
+        "--scalability", action="store_true",
+        help="envelope probes: many-args/returns/gets + queued-task flood",
+    )
+    sp.add_argument(
+        "--collective", action="store_true",
+        help="p2p host allreduce bandwidth + head-traffic proof",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

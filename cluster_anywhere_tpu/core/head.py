@@ -216,6 +216,8 @@ class Head:
         self._lease_shapes: Dict[str, Dict[str, float]] = {}
         self._lease_pg: Dict[str, tuple] = {}  # lease_id -> (pg_id, bundle_index)
         self._lease_node: Dict[str, str] = {}  # lease_id -> node_id
+        self._lease_client: Dict[str, str] = {}  # lease_id -> holder client_id
+        self._last_reclaim_nudge = 0.0  # debounce for lease_reclaim pushes
         self._spawn_count = 0
         # -- conns --
         self._worker_conns: Dict[str, Connection] = {}
@@ -250,6 +252,17 @@ class Head:
             "objects_transferred": 0,
             "oom_kills": 0,
         }
+        # per-method RPC counters (saturation diagnostics: the owner-based
+        # directory and p2p collectives exist to keep hot-path traffic OFF
+        # this loop — these counters are how tests/benchmarks prove it)
+        from collections import defaultdict
+
+        self.rpc_counts: Dict[str, int] = defaultdict(int)
+        # p2p directory: client_id -> {addr, addr_tcp, node} for every
+        # registered client that serves RPCs (workers AND drivers).  Lets a
+        # borrower dial an object's owner directly (owner_locate) instead of
+        # polling this loop.
+        self.client_addrs: Dict[str, Dict[str, str]] = {}
         # node memory monitor (memory_monitor.h:52): the head watches its own
         # node; agents report pressure in heartbeats and the head picks the
         # victim (worker_killing_policy.h) since only it knows worker state
@@ -462,9 +475,15 @@ class Head:
         self.stats.update(state["stats"])
 
     async def _persist_loop(self):
-        """Debounced snapshot writer: at most one disk write per interval."""
+        """Debounced snapshot writer: at most one disk write per interval.
+        Doubles as the lease-contention re-nudge tick: while requests are
+        still queued, keep hinting holders to shed idle leases (the arrival-
+        time nudge alone misses holders whose leases go idle later)."""
         while not self._shutdown.is_set():
             await asyncio.sleep(0.25)
+            if self.pending_leases:
+                self._last_reclaim_nudge = 0.0  # bypass the debounce
+                self._nudge_lease_holders(requester="")
             if self._dirty:
                 self._dirty = False
                 try:
@@ -683,6 +702,7 @@ class Head:
             self.leases[lease_id] = wid
             self._lease_shapes[lease_id] = dict(req.shape)
             self._lease_node[lease_id] = node.node_id
+            self._lease_client[lease_id] = req.client
             if req.pg_id:
                 self._lease_pg[lease_id] = (req.pg_id, req.bundle_index)
             self.stats["leases_granted"] += 1
@@ -785,6 +805,7 @@ class Head:
         shape = self._lease_shapes.pop(lease_id, None)
         pg = self._lease_pg.pop(lease_id, None)
         nid = self._lease_node.pop(lease_id, None)
+        self._lease_client.pop(lease_id, None)
         if shape is not None:
             if pg is not None:
                 pgrec = self.pgs.get(pg[0])
@@ -1109,6 +1130,7 @@ class Head:
         {
             "heartbeat", "node_heartbeat", "kv_get", "kv_keys", "get_function",
             "obj_locate", "pull_chunk", "nodes", "cluster_resources", "stats",
+            "client_addr",
             "list_actors", "list_workers", "list_task_events", "list_objects",
             "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
             "get_actor", "subscribe", "publish", "task_events", "metrics_report",
@@ -1121,6 +1143,7 @@ class Head:
         if h is None:
             reply_err(ValueError(f"unknown head method {m}"))
             return
+        self.rpc_counts[m] += 1
         if m not in self._READONLY_METHODS:
             self._dirty = True  # persisted by the debounced snapshot loop
         await h(state, msg, reply, reply_err)
@@ -1144,6 +1167,12 @@ class Head:
         self.subscribers.setdefault(f"shm_free:{client_id}", []).append(state["writer"])
         if role == "driver":
             self._driver_clients.add(client_id)
+        if msg.get("addr") or msg.get("addr_tcp"):
+            self.client_addrs[client_id] = {
+                "addr": msg.get("addr") or "",
+                "addr_tcp": msg.get("addr_tcp") or "",
+                "node": state["node_id"],
+            }
         if role == "worker":
             rec = self.workers.get(client_id)
             if rec is not None and rec.state == "dead":
@@ -1269,6 +1298,47 @@ class Head:
         if not self._try_grant(req):
             self.pending_leases.append(req)
             self._ensure_pool()
+            self._nudge_lease_holders(req.client)
+
+    def _nudge_lease_holders(self, requester: str):
+        """A lease request just queued while other clients hold leases:
+        push a reclaim hint so holders return their IDLE leases now instead
+        of after the 1s idle timeout.  Without this, concurrent client
+        batches serialize with ~1s gaps (each waits out the previous
+        holder's idle-return) — the multi-client aggregate collapse.
+        Debounced: a queued burst nudges once per 100ms."""
+        now = time.monotonic()
+        if now - self._last_reclaim_nudge < 0.1:
+            return
+        self._last_reclaim_nudge = now
+        holders = set(self._lease_client.values())
+        parties = holders | {r.client for r in self.pending_leases}
+        if requester:
+            parties.add(requester)
+        if len(parties) <= 1:
+            # a single client contending with itself (e.g. SPREAD growth
+            # waiting on cold nodes' workers to spawn) is not a fairness
+            # problem — capping it would defeat the growth it is waiting for
+            return
+        n_workers = sum(
+            1
+            for w in self.workers.values()
+            if w.purpose == "pool" and w.state in ("starting", "idle", "leased")
+        )
+        cap = max(1, n_workers // max(1, len(parties)))
+        for cid in holders:
+            if cid == requester:
+                continue  # its own pools keep leases they still need
+            state = self._clients.get(cid)
+            if state is None:
+                continue
+            try:
+                write_frame(
+                    state["writer"],
+                    {"m": "pub", "ch": "lease_reclaim", "data": {"cap": cap}},
+                )
+            except Exception:
+                pass
 
     async def _h_return_lease(self, state, msg, reply, reply_err):
         for lid in msg["lease_ids"]:
@@ -1652,6 +1722,61 @@ class Head:
         # prefer a copy on the caller's node
         reply(**self._locate_fields(rec, state.get("node_id", LOCAL_NODE)))
 
+    def _routable_tcp(self, addr_tcp: str, node_id: str) -> str:
+        """Worker/driver TCP listeners bind loopback or wildcard; a dial
+        from ANOTHER host needs the node's reachable address.  Substitute
+        the host this head (or the node's agent) registered for that node —
+        the one component that knows the cluster topology."""
+        if not addr_tcp:
+            return addr_tcp
+        proto, _, rest = addr_tcp.partition(":")
+        host, _, port = rest.rpartition(":")
+        if host not in ("127.0.0.1", "0.0.0.0", "localhost", "::", "::1"):
+            return addr_tcp
+        if node_id == LOCAL_NODE:
+            reach = self.tcp_addr
+        else:
+            node = self.nodes.get(node_id)
+            reach = node.addr if node is not None else None
+        if not reach:
+            return addr_tcp
+        reach_host = reach.partition(":")[2].rpartition(":")[0]
+        return f"{proto}:{reach_host}:{port}" if reach_host else addr_tcp
+
+    async def _h_client_addr(self, state, msg, reply, reply_err):
+        """p2p directory lookup: where does client_id serve RPCs?  One call
+        per OWNER (cached by the consumer), after which location resolution
+        for every object that owner creates goes worker-to-worker
+        (owner_locate) — the ownership-based object directory's read path
+        (ownership_based_object_directory.h role).  The head remains the
+        arbiter for pins/spill/GC and the fallback when an owner dies."""
+        cid = msg["client_id"]
+        info = self.client_addrs.get(cid)
+        if info is None:
+            rec = self.workers.get(cid)
+            if rec is None or rec.state == "dead":
+                reply(found=False)
+                return
+            info = {
+                "addr": rec.addr or "",
+                "addr_tcp": rec.addr_tcp or "",
+                "node": rec.node_id,
+            }
+        addr_tcp = self._routable_tcp(info.get("addr_tcp") or "", info["node"])
+        if state.get("remote"):
+            # TCP-only callers can't dial unix sockets
+            if not addr_tcp:
+                reply(found=False)
+                return
+            reply(found=True, addr=addr_tcp, node=info["node"])
+            return
+        reply(
+            found=True,
+            addr=info.get("addr") or addr_tcp,
+            addr_tcp=addr_tcp,
+            node=info["node"],
+        )
+
     async def _h_obj_spilled(self, state, msg, reply, reply_err):
         """Producer moved an object's bytes to disk under memory pressure
         (local_object_manager.h spill).  The old shm slice is reclaimed
@@ -1932,6 +2057,7 @@ class Head:
 
     async def _h_stats(self, state, msg, reply, reply_err):
         reply(
+            rpc_counts=dict(self.rpc_counts),
             stats=dict(
                 self.stats,
                 pending_leases=len(self.pending_leases),
@@ -2086,6 +2212,7 @@ class Head:
         if cid is None:
             return
         self._clients.pop(cid, None)
+        self.client_addrs.pop(cid, None)  # p2p dials now fall back to head
         if state.get("role") == "agent":
             node = self.nodes.get(state.get("node_id"))
             if node is not None:

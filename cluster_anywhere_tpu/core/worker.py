@@ -143,6 +143,12 @@ class LeasePool:
         cfg = worker.config
         self.max_leases = cfg.max_leases_per_shape
         self.max_inflight = cfg.max_inflight_per_lease
+        # contended-cluster fair share: while other clients' lease requests
+        # are queued at the head, the head pushes a per-client lease cap;
+        # this pool sheds down to it as pipelines drain and stops growing
+        # past it.  Expires when the head stops re-nudging (contention over).
+        self.contended_cap: Optional[int] = None
+        self.contended_until = 0.0
 
     def _pick(self) -> Optional[_Lease]:
         best = None
@@ -189,7 +195,11 @@ class LeasePool:
         if self.requests_outstanding >= self._MAX_OUTSTANDING:
             return False
         live = sum(1 for l in self.leases if not l.dead)
-        return live + self.requests_outstanding < min(self.max_leases, self.inflight_total)
+        limit = min(self.max_leases, self.inflight_total)
+        cap = self._fair_cap()
+        if cap is not None:
+            limit = min(limit, cap)
+        return live + self.requests_outstanding < limit
 
     def _pipeline_ok(self) -> bool:
         return self._pipeline_ok_for(self.inflight_total)
@@ -345,8 +355,36 @@ class LeasePool:
             lease.dead = True
         if lease.inflight == 0:
             lease.last_idle = time.monotonic()
+            self._maybe_shed(lease)
         self._drain_backlog()
         self._wake()
+
+    def _fair_cap(self) -> Optional[int]:
+        if (
+            self.contended_cap is not None
+            and time.monotonic() <= self.contended_until
+        ):
+            return self.contended_cap
+        return None
+
+    def _maybe_shed(self, lease: _Lease):
+        """A pipelined lease just drained while the cluster is contended:
+        give it back if this pool holds more than its fair share, so other
+        clients' batches run CONCURRENTLY with ours instead of after it."""
+        cap = self._fair_cap()
+        if cap is None or lease.dead or lease.inflight:
+            return
+        live = sum(1 for l in self.leases if not l.dead)
+        if live <= cap:
+            return
+        lease.dead = True
+        self.leases = [l for l in self.leases if not l.dead]
+        w = self.worker
+        if w.head is not None and not w.head.closed:
+            try:
+                w.head.notify("return_lease", lease_ids=[lease.lease_id])
+            except Exception:
+                pass
 
     def reap_idle(self, now: float, timeout: float) -> List[str]:
         """Return lease_ids to give back to the head."""
@@ -366,6 +404,32 @@ class LeasePool:
             else:
                 keep.append(l)
         self.leases = [l for l in self.leases if not l.dead]
+        return out
+
+    def reap_contended(self) -> List[str]:
+        """Another client's lease request is pending at the head: give back
+        every idle lease this pool does not need for its own current demand
+        (contended-cluster fairness; the 1s reap_idle horizon is for the
+        UNcontended case, where keeping warm leases is pure latency win).
+        Idle leases are kept only while live pipelining capacity cannot
+        cover in-flight demand — and never beyond the fair-share cap."""
+        out = []
+        cap = self._fair_cap()
+        live = sum(1 for l in self.leases if not l.dead)
+        cover = sum(l.inflight for l in self.leases if not l.dead)
+        demand = self.inflight_total
+        for l in self.leases:
+            if l.dead or l.inflight > 0:
+                continue
+            over_cap = cap is not None and live > cap
+            if cover < demand and not over_cap:
+                cover += self.max_inflight  # kept: about to absorb backlog
+                continue
+            l.dead = True
+            live -= 1
+            out.append(l.lease_id)
+        if out:
+            self.leases = [l for l in self.leases if not l.dead]
         return out
 
 
@@ -460,6 +524,18 @@ class Worker:
         self._recon_events: Dict[bytes, threading.Event] = {}
         # device object table: oid-bytes -> live device value (owner side)
         self.device_objects: Dict[bytes, Any] = {}
+        # --- p2p planes (ownership directory + direct collectives) --------
+        # collective mailbox: (group, key, src_rank) -> (data, shape, dtype)
+        # deliveries land on the IO loop (coll_push RPC); rank threads block
+        # in coll_wait.  Bounded by op lockstep + cleared on group close.
+        self._coll_cond = threading.Condition()
+        self._coll_mail: Dict[Tuple[str, str, int], tuple] = {}
+        # owner-addr cache for p2p location resolution: client_id ->
+        # Connection-able addr (None = owner unreachable/non-serving; the
+        # head fallback handles it).  One head lookup per OWNER, not per
+        # object.
+        self._owner_addr_cache: Dict[str, Optional[str]] = {}
+        self._p2p_server = None  # driver-mode mini server (workers use theirs)
         self.current_task_id: Optional[TaskID] = None
         self.current_actor_id: Optional[ActorID] = None
         # submission pump: user threads enqueue coroutine factories here; one
@@ -544,6 +620,13 @@ class Worker:
         self.run_coro(self.connect_async(), timeout=30)
 
     async def connect_async(self):
+        if self.mode == "driver" and not self.client_mode and self.serve_addr is None:
+            # the driver serves the p2p planes too (owner_locate for objects
+            # it owns, coll_push for collective ranks) — in the reference
+            # every worker INCLUDING the driver runs a core-worker gRPC
+            # server (core_worker.h); without one, every driver-owned ref
+            # resolution would fall back to polling the head
+            await self._start_p2p_server()
         self.head = await connect_addr(self.head_sock)
         self.head.set_push_handler(self._on_push)
         reply = await self.head.call(
@@ -551,8 +634,8 @@ class Worker:
             role=self.mode,
             client_id=self.client_id,
             pid=os.getpid(),
-            addr=self.serve_addr or "",
-            addr_tcp=self.serve_addr_tcp or "",
+            addr=self.serve_addr or self._p2p_addr() or "",
+            addr_tcp=self.serve_addr_tcp or self._p2p_addr_tcp() or "",
             node_id=self.node_id,
             remote=self.client_mode,
         )
@@ -575,6 +658,23 @@ class Worker:
             name = data.get("shm_name")
             if name:
                 self.shm_store.free_local(name)
+        elif ch == "lease_reclaim":
+            # another client's lease request is queued: return surplus idle
+            # leases NOW instead of after the idle timeout, and shed down to
+            # the head's fair-share cap as pipelines drain (multi-client
+            # fairness — without this, client batches serialize on ~1s gaps)
+            cap = (msg.get("data") or {}).get("cap")
+            to_return = []
+            for pool in self._lease_pools.values():
+                if cap is not None:
+                    pool.contended_cap = int(cap)
+                    pool.contended_until = time.monotonic() + 1.0
+                to_return.extend(pool.reap_contended())
+            if to_return and self.head is not None and not self.head.closed:
+                try:
+                    self.head.notify("return_lease", lease_ids=to_return)
+                except Exception:
+                    pass
 
     async def _housekeeping(self):
         period = 0.25
@@ -661,6 +761,167 @@ class Worker:
             port = addr.rpartition(":")[2]
             return f"tcp:{head_host}:{port}"
         return addr
+
+    # ---------------------------------------------------- p2p serving plane
+    def _p2p_addr(self) -> Optional[str]:
+        if self._p2p_server is not None:
+            return next(
+                (a for a in self._p2p_server.bound_addrs if a.startswith("unix:")),
+                None,
+            )
+        return None
+
+    def _p2p_addr_tcp(self) -> Optional[str]:
+        if self._p2p_server is not None:
+            return next(
+                (a for a in self._p2p_server.bound_addrs if a.startswith("tcp:")),
+                None,
+            )
+        return None
+
+    async def _start_p2p_server(self):
+        """Driver-mode RPC listener for the p2p planes.  Worker processes
+        already serve these methods on their task server (workerproc._handle
+        delegates here); the driver needs its own socket because it owns
+        puts and task returns — the objects borrowers resolve most."""
+        if self._p2p_server is not None:
+            return  # connect_async re-entry must not stack listeners
+        from .protocol import Server
+
+        sock = os.path.join(self.session_dir, f"drv_{self.client_id}.sock")
+
+        async def handle(state, msg, reply, reply_err):
+            m = msg["m"]
+            if m == "owner_locate":
+                reply(**self.owner_locate_local(msg["oid"]))
+            elif m == "coll_push":
+                self.coll_deliver(
+                    msg["group"], msg["key"], msg["src"],
+                    msg["data"], msg["shape"], msg["dtype"],
+                )
+                reply()
+            elif m == "ping":
+                reply(worker_id=self.client_id)
+            else:
+                reply_err(ValueError(f"unknown p2p method {m}"))
+
+        self._p2p_server = Server([sock, "tcp:0.0.0.0:0"], handle)
+        await self._p2p_server.start()
+
+    def owner_locate_local(self, oid_b: bytes) -> dict:
+        """Answer a borrower's location query from THIS process's authority
+        over objects it owns (ownership_based_object_directory.h read path).
+
+        shm-backed objects return their location; INLINE results (small task
+        returns / puts, which never register at the head at all) are served
+        by value — the owner is their only copy, and before this path
+        existed a borrowed ref to a pending-then-inline result could only
+        resolve if something promoted it.  Pending / device / spilled states
+        report not-found: the borrower keeps waiting or falls back to the
+        head (the arbiter for spill relocation and GC)."""
+        e = self.memory_store.get_entry(ObjectID(oid_b))
+        if e is None:
+            return {"found": False}
+        if e.state in ("shm", "value", "packed") and e.shm_name:
+            if e.shm_name.startswith("spill:"):
+                # relocated to disk: the head arbitrates spill reads
+                return {"found": False}
+            return {
+                "found": True,
+                "shm_name": e.shm_name,
+                "size": e.size,
+                "node": self.node_id,
+            }
+        if e.state == "packed":
+            return {"found": True, "v": e.packed}
+        if e.state == "value":
+            try:
+                return {"found": True, "v": serialization.pack(e.value)}
+            except Exception:
+                return {"found": False}
+        return {"found": False}
+
+    def coll_deliver(self, group: str, key: str, src: int, data, shape, dtype):
+        """Landing half of the p2p collective transport: a peer rank pushed
+        a tensor chunk; wake any coll_wait blocked on it."""
+        with self._coll_cond:
+            self._coll_mail[(group, key, int(src))] = (data, tuple(shape), dtype)
+            self._coll_cond.notify_all()
+
+    def coll_wait(self, group: str, key: str, src: int, timeout: float):
+        """Block (rank thread) until the (group, key, src) chunk arrives."""
+        import numpy as _np
+
+        deadline = time.monotonic() + timeout
+        k = (group, key, int(src))
+        with self._coll_cond:
+            while k not in self._coll_mail:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"collective recv timed out waiting for {k}"
+                    )
+                self._coll_cond.wait(min(remaining, 1.0))
+            data, shape, dtype = self._coll_mail.pop(k)
+        return _np.frombuffer(data, dtype=dtype).reshape(shape)
+
+    def coll_clear(self, group: str):
+        with self._coll_cond:
+            for k in [k for k in self._coll_mail if k[0] == group]:
+                del self._coll_mail[k]
+
+    def coll_push_to(
+        self, addr: str, group: str, key: str, src: int, arr, timeout: float
+    ):
+        """Sending half: push one tensor chunk directly into a peer rank's
+        mailbox over the worker TCP/unix dual — no head, no object store."""
+        import numpy as np
+
+        arr = np.ascontiguousarray(arr)
+
+        async def _send():
+            conn = await self.conn_to(addr)
+            await conn.call(
+                "coll_push",
+                group=group,
+                key=key,
+                src=int(src),
+                data=arr.tobytes(),
+                shape=list(arr.shape),
+                dtype=str(arr.dtype),
+                timeout=timeout,
+            )
+
+        self.run_coro(_send(), timeout=timeout)
+
+    async def _owner_addr_async(self, owner: Optional[str]) -> Optional[str]:
+        """Resolve (and cache) the serving address of an object owner.  One
+        head lookup per owner process for the session; None = owner can't be
+        dialed (dead, remote client, or unknown) — callers fall back to the
+        head."""
+        if not owner or owner == self.client_id:
+            return None
+        if owner in self._owner_addr_cache:
+            return self._owner_addr_cache[owner]
+        addr: Optional[str] = None
+        try:
+            reply = await self.head.call("client_addr", client_id=owner)
+            if reply.get("found"):
+                if reply.get("node") == self.node_id:
+                    addr = reply.get("addr") or reply.get("addr_tcp") or None
+                else:  # cross-node: unix sockets don't travel
+                    addr = reply.get("addr_tcp") or reply.get("addr") or None
+        except Exception:
+            addr = None
+        self._owner_addr_cache[owner] = addr
+        return addr
+
+    def _owner_addr(self, owner: Optional[str]) -> Optional[str]:
+        if not owner or owner == self.client_id:
+            return None
+        if owner in self._owner_addr_cache:
+            return self._owner_addr_cache[owner]
+        return self.run_coro(self._owner_addr_async(owner), timeout=30)
 
     async def conn_to(self, addr: str) -> Connection:
         """One connection per peer.  Concurrent first-callers share a single
@@ -936,8 +1197,8 @@ class Worker:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
         oids = [r.id for r in ref_list]
-        for oid in oids:
-            self._seed_borrowed(oid)
+        for r in ref_list:
+            self._seed_borrowed(r.id, owner=r.owner)
         notified = False
         if self.mode == "worker" and not all(self.memory_store.contains(o) for o in oids):
             self._notify_blocked(True)
@@ -968,13 +1229,19 @@ class Worker:
         except RuntimeError:
             pass
 
-    def _seed_borrowed(self, oid: ObjectID):
+    def _seed_borrowed(self, oid: ObjectID, owner: Optional[str] = None):
         """A borrowed handle (deserialized from another process) has no local
-        entry: seed one from the cluster object directory so get()/wait() can
-        resolve it.  Objects not yet created (ref to an unfinished task's
-        return, forwarded ahead of completion) are polled until they appear —
-        the centralized-ownership stand-in for asking the owner
-        (future_resolver.h)."""
+        entry: seed one from the object directory so get()/wait() can resolve
+        it.  Objects not yet created (ref to an unfinished task's return,
+        forwarded ahead of completion) are polled until they appear.
+
+        Ownership-based read path (future_resolver.h /
+        ownership_based_object_directory.h): the poll goes to the OWNER
+        process over a direct connection — its answer is authoritative for
+        objects it created — so N borrowers polling M pending objects land
+        on the owners, not on the head's single loop.  The head is consulted
+        as a periodic fallback (owner dead, object spilled/relocated, owner
+        not dialable)."""
         if self.memory_store.get_entry(oid) is not None:
             return
         self.memory_store.mark_pending(oid)
@@ -986,19 +1253,57 @@ class Worker:
             # governs.  The poll ends when the entry fills, or when the local
             # handle is dropped (eviction deletes the entry).
             interval = 0.02
+            owner_addr = await self._owner_addr_async(owner)
+            owner_conn = None
+            attempt = 0
             while True:
                 e = self.memory_store.get_entry(oid)
                 if e is None or e.state != "pending":
                     return  # filled or dropped meanwhile
-                try:
-                    reply = await self.head.call("obj_locate", oid=oid_b)
-                except Exception:
-                    reply = {}
+                reply = {}
+                asked_head = False
+                if owner_addr is not None:
+                    try:
+                        if owner_conn is None or owner_conn.closed:
+                            owner_conn = await self.conn_to(owner_addr)
+                        reply = await owner_conn.call(
+                            "owner_locate", oid=oid_b, timeout=10
+                        )
+                    except Exception:
+                        owner_addr = None  # owner died: head takes over
+                        owner_conn = None
+                # every 8th attempt (and always without an owner), check the
+                # head too — it alone knows spill relocations and survives
+                # owner death
+                if not reply.get("found") and (
+                    owner_addr is None or attempt % 8 == 7
+                ):
+                    asked_head = True
+                    try:
+                        reply = await self.head.call("obj_locate", oid=oid_b)
+                    except Exception:
+                        reply = {}
                 if reply.get("found"):
-                    self.memory_store.put_shm(oid, reply["shm_name"], reply["size"])
-                    return
+                    if reply.get("v") is not None:
+                        # inline payload served straight from the owner
+                        try:
+                            value = serialization.unpack(reply["v"])
+                        except Exception:
+                            reply = {}  # corrupt/unreadable: keep polling
+                        else:
+                            self.memory_store.put_value(oid, value)
+                            return
+                    else:
+                        self.memory_store.put_shm(
+                            oid, reply["shm_name"], reply["size"]
+                        )
+                        return
+                attempt += 1
                 await asyncio.sleep(interval)
-                interval = min(interval * 2, 1.0)
+                # owner polls stay snappy (direct, distributed); head-only
+                # polls back off like before to protect the shared loop
+                if owner_addr is None or asked_head:
+                    interval = min(interval * 2, 1.0)
 
         try:
             self.loop.call_soon_threadsafe(lambda: spawn_bg(_poll()))
@@ -1361,7 +1666,7 @@ class Worker:
         if num_returns > len(ref_list):
             raise ValueError("num_returns exceeds number of refs")
         for r in ref_list:
-            self._seed_borrowed(r.id)
+            self._seed_borrowed(r.id, owner=r.owner)
         ready_ids, rest_ids = self.memory_store.wait_ready(
             [r.id for r in ref_list], num_returns, timeout
         )
@@ -2253,6 +2558,16 @@ class Worker:
                 await self.head.close()
             for c in self._conns.values():
                 await c.close()
+            if self._p2p_server is not None:
+                for srv in self._p2p_server._servers:
+                    srv.close()
+                for a in self._p2p_server.bound_addrs:
+                    if a.startswith("unix:"):
+                        try:
+                            os.unlink(a[5:])
+                        except OSError:
+                            pass
+                self._p2p_server = None
 
         try:
             self.run_coro(_close_all(), timeout=5)

@@ -653,6 +653,17 @@ class WorkerProcess:
                 reply(packed=await self._fetch_object(msg["oid"]))
             except BaseException as e:
                 reply_err(e)
+        elif m == "owner_locate":
+            # ownership-based object directory read path: this process is
+            # authoritative for objects it owns (see Worker.owner_locate_local)
+            reply(**self.worker.owner_locate_local(msg["oid"]))
+        elif m == "coll_push":
+            # p2p collective transport: land the chunk in the rank mailbox
+            self.worker.coll_deliver(
+                msg["group"], msg["key"], msg["src"],
+                msg["data"], msg["shape"], msg["dtype"],
+            )
+            reply()
         elif m == "ping":
             reply(worker_id=self.worker_id, actor=self.actor.actor_id if self.actor else None)
         elif m == "actor_shutdown":

@@ -1,15 +1,22 @@
 """Collective communication API, mirroring the surface of the reference's
 ray.util.collective (SURVEY.md §2.3: init_collective_group / allreduce /
-allgather / reducescatter / broadcast / send-recv / barrier) with two
+allgather / reducescatter / broadcast / send-recv / barrier) with
 TPU-native backends:
 
 - "xla": in-graph collectives for device tensors — thin wrappers over
   lax.psum/all_gather/psum_scatter/ppermute for use inside jit/shard_map.
   On TPU these compile to ICI transfers; this is the fast tensor plane and
   replaces the reference's NCCL backend.
-- "host": out-of-graph collectives for host (numpy) data between actors —
-  rendezvous through the head's KV store, the Gloo-equivalent control-plane
-  backend.  Used for coordination data, not bulk tensors.
+- "host" (default out-of-graph): PEER-TO-PEER collectives for host (numpy)
+  data between processes — the Gloo-role backend
+  (gloo_collective_group.py:184).  The head's KV carries ONE rendezvous
+  record per rank (its serving address, at group init); after that every
+  tensor byte moves worker-to-worker over direct connections: ring
+  allreduce/allgather, direct-push broadcast and send/recv.  Nothing per-op
+  lands on the head's loop (the r4 'data plane through head KV' weakness).
+- "kv": the previous KV-rendezvous transport (refs through head KV, payload
+  via the object store) — kept for remote clients, which cannot serve
+  direct connections.
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-_groups: Dict[str, "HostCollectiveGroup"] = {}
+_groups: Dict[str, Any] = {}
 
 
 # ---------------------------------------------------------------------------
@@ -225,19 +232,246 @@ class HostCollectiveGroup:
 
 
 # ---------------------------------------------------------------------------
+# p2p backend (Gloo role): direct worker-to-worker tensor movement
+# ---------------------------------------------------------------------------
+
+
+class P2PCollectiveGroup:
+    """Host collectives whose tensor bytes move directly between the member
+    processes (ring allreduce/allgather; direct-push broadcast/send/recv).
+
+    The head KV holds exactly one record per rank — the rank's serving
+    address, written once at init and deleted at close.  Every subsequent
+    op is rank-to-rank RPC into a peer's collective mailbox
+    (Worker.coll_deliver / coll_wait): zero per-op head traffic, unlike the
+    KV transport this replaces (r4 weak #2).  Reference role:
+    gloo_collective_group.py:184 (direct transport), redesigned over this
+    runtime's existing worker duals instead of a separate Gloo context."""
+
+    _TIMEOUT = 60.0
+
+    def __init__(self, world_size: int, rank: int, group_name: str = "default"):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._seq = 0
+        self._p2p_send_seq: Dict[int, int] = {}
+        self._p2p_recv_seq: Dict[int, int] = {}
+        self._peer_addrs: Dict[int, str] = {}
+        w = self._worker()
+        if not (w.serve_addr or w.serve_addr_tcp or w._p2p_addr()):
+            raise RuntimeError(
+                "p2p collectives need a serving process (worker/actor/driver); "
+                "remote clients should use backend='kv'"
+            )
+        # rendezvous record = this rank's CLIENT ID only; peers resolve it to
+        # a dialable address through the head's p2p directory (client_addr),
+        # which rewrites loopback/wildcard hosts per node — publishing raw
+        # bound addresses here would hand cross-host peers 127.0.0.1
+        self._members_ns = f"__collective__/{group_name}/members"
+        w.head_call(
+            "kv_put",
+            ns=self._members_ns,
+            key=str(rank),
+            value=pickle.dumps({"client": w.client_id}),
+        )
+
+    def _worker(self):
+        from ..core.worker import global_worker
+
+        return global_worker()
+
+    def _peer(self, rank: int) -> str:
+        """Resolve (once) where a peer rank serves: poll the rendezvous KV
+        for its client id, then the head's p2p directory for a dialable
+        address (unix same-node, rewritten TCP cross-node)."""
+        addr = self._peer_addrs.get(rank)
+        if addr is not None:
+            return addr
+        w = self._worker()
+        deadline = time.monotonic() + self._TIMEOUT
+        while True:
+            v = w.head_call("kv_get", ns=self._members_ns, key=str(rank))["value"]
+            if v is not None:
+                client = pickle.loads(v)["client"]
+                addr = w._owner_addr(client)
+                if addr is None:
+                    raise RuntimeError(
+                        f"rank {rank} (client {client}) of group "
+                        f"{self.group_name!r} has no dialable p2p address — "
+                        "every member of a 'host' group must be a serving "
+                        "process; use backend='kv' for remote-client members"
+                    )
+                self._peer_addrs[rank] = addr
+                return addr
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rank {rank} never joined group {self.group_name!r}"
+                )
+            time.sleep(0.005)
+
+    # ------------------------------------------------------------- transport
+    def _push(self, dst: int, key: str, arr: np.ndarray):
+        self._worker().coll_push_to(
+            self._peer(dst), self.group_name, key, self.rank, arr, self._TIMEOUT
+        )
+
+    def _wait(self, key: str, src: int) -> np.ndarray:
+        return self._worker().coll_wait(
+            self.group_name, key, src, self._TIMEOUT
+        )
+
+    # ------------------------------------------------------------ collectives
+    @staticmethod
+    def _acc_dtype(dtype: np.dtype, op: str):
+        if op == "mean":
+            return np.result_type(dtype, np.float64)
+        if np.issubdtype(dtype, np.integer):
+            return np.int64  # match np.sum/stack-reduce accumulator dtype
+        return dtype
+
+    @staticmethod
+    def _combine(acc: np.ndarray, incoming: np.ndarray, op: str):
+        if op in ("sum", "mean"):
+            np.add(acc, incoming, out=acc)
+        elif op == "max":
+            np.maximum(acc, incoming, out=acc)
+        elif op == "min":
+            np.minimum(acc, incoming, out=acc)
+        else:
+            raise ValueError(f"unsupported op {op}")
+
+    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        arr = np.asarray(tensor)
+        n = self.world_size
+        self._seq += 1
+        acc_dt = self._acc_dtype(arr.dtype, op)
+        if n == 1:
+            out = arr.astype(acc_dt, copy=True)
+            return out if op != "mean" else out  # mean of one = itself
+        seq = self._seq
+        left, right = (self.rank - 1) % n, (self.rank + 1) % n
+        flat = arr.astype(acc_dt).reshape(-1)
+        chunks = [c.copy() for c in np.array_split(flat, n)]
+        # ring reduce-scatter: after n-1 steps this rank holds the fully
+        # reduced chunk (rank+1) % n
+        for s in range(n - 1):
+            send_idx = (self.rank - s) % n
+            recv_idx = (self.rank - s - 1) % n
+            self._push(right, f"{seq}/rs{s}", chunks[send_idx])
+            incoming = self._wait(f"{seq}/rs{s}", src=left)
+            self._combine(chunks[recv_idx], incoming.reshape(chunks[recv_idx].shape), op)
+        # ring allgather of the reduced chunks
+        for s in range(n - 1):
+            send_idx = (self.rank + 1 - s) % n
+            recv_idx = (self.rank - s) % n
+            self._push(right, f"{seq}/ag{s}", chunks[send_idx])
+            chunks[recv_idx] = self._wait(f"{seq}/ag{s}", src=left).reshape(
+                chunks[recv_idx].shape
+            ).copy()
+        out = np.concatenate([c.reshape(-1) for c in chunks]).reshape(arr.shape)
+        if op == "mean":
+            out = out / n
+        return out
+
+    def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
+        arr = np.ascontiguousarray(tensor)
+        n = self.world_size
+        self._seq += 1
+        if n == 1:
+            return [arr.copy()]
+        seq = self._seq
+        left, right = (self.rank - 1) % n, (self.rank + 1) % n
+        got: Dict[int, np.ndarray] = {self.rank: arr}
+        carry = arr
+        for s in range(n - 1):  # ring pass-along (shapes may differ per rank)
+            self._push(right, f"{seq}/ag{s}", carry)
+            carry = self._wait(f"{seq}/ag{s}", src=left).copy()
+            got[(self.rank - 1 - s) % n] = carry
+        return [got[r] for r in range(n)]
+
+    def reducescatter(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        full = self.allreduce(tensor, op)
+        return np.array_split(full, self.world_size)[self.rank]
+
+    def broadcast(self, tensor: Optional[np.ndarray], src_rank: int = 0) -> np.ndarray:
+        self._seq += 1
+        seq = self._seq
+        if self.rank == src_rank:
+            arr = np.ascontiguousarray(tensor)
+            for r in range(self.world_size):
+                if r != self.rank:
+                    self._push(r, f"{seq}/bc", arr)
+            return np.asarray(tensor)
+        return self._wait(f"{seq}/bc", src=src_rank).copy()
+
+    def barrier(self):
+        self.allreduce(np.zeros(1))
+
+    def send(self, tensor: np.ndarray, dst_rank: int):
+        k = self._p2p_send_seq.get(dst_rank, 0)
+        self._p2p_send_seq[dst_rank] = k + 1
+        self._push(dst_rank, f"p2p/{k}", np.asarray(tensor))
+
+    def recv(self, src_rank: int, timeout: float = 60.0) -> np.ndarray:
+        k = self._p2p_recv_seq.get(src_rank, 0)
+        self._p2p_recv_seq[src_rank] = k + 1
+        return self._worker().coll_wait(
+            self.group_name, f"p2p/{k}", src_rank, timeout
+        ).copy()
+
+    def close(self):
+        """Drop this rank's rendezvous record and any unconsumed mailbox
+        entries.  Safe after ca.shutdown (any teardown order)."""
+        from ..core.worker import try_global_worker
+
+        w = try_global_worker()
+        if w is None:
+            return
+        w.coll_clear(self.group_name)
+        try:
+            w.head_call("kv_del", ns=self._members_ns, key=str(self.rank))
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # public API (reference-parity surface)
 # ---------------------------------------------------------------------------
 
 
 def init_collective_group(
     world_size: int, rank: int, backend: str = "host", group_name: str = "default"
-) -> HostCollectiveGroup:
-    if backend not in ("host", "gloo"):
+):
+    """backend='host'/'gloo': p2p transport (direct worker-to-worker bytes).
+    backend='kv': the KV-rendezvous transport (required when ANY member is a
+    remote client, which cannot serve direct connections).  The backend is
+    per-GROUP, never per-rank: a silent per-rank fallback would build a
+    mixed-transport group whose halves share no rendezvous and deadlock."""
+    if backend not in ("host", "gloo", "kv"):
         raise ValueError(
-            "out-of-graph groups support the 'host' backend; device tensors "
-            "use in-graph xla collectives (cluster_anywhere_tpu.parallel.collectives.xla)"
+            "out-of-graph groups support the 'host' (p2p) and 'kv' backends; "
+            "device tensors use in-graph xla collectives "
+            "(cluster_anywhere_tpu.parallel.collectives.xla)"
         )
-    g = HostCollectiveGroup(world_size, rank, group_name)
+    if backend == "kv":
+        g: Any = HostCollectiveGroup(world_size, rank, group_name)
+    else:
+        from ..core.worker import global_worker
+
+        w = global_worker()
+        if w.client_mode or not (
+            w.serve_addr or w.serve_addr_tcp or w._p2p_addr()
+        ):
+            raise RuntimeError(
+                "this rank cannot serve the p2p 'host' transport (remote "
+                "client / no listener); create the WHOLE group with "
+                "backend='kv' instead — transports cannot be mixed within "
+                "a group"
+            )
+        g = P2PCollectiveGroup(world_size, rank, group_name)
     _groups[group_name] = g
     return g
 
@@ -276,8 +510,26 @@ class CollectiveActorMixin:
         init_collective_group(world_size, rank, backend=backend, group_name=group_name)
         return rank
 
+    def collective_close(self, group_name="default"):
+        """Teardown hook: kv_del this rank's rendezvous record + drop its
+        mailbox entries.  Call it before killing the actor — ca.kill alone
+        leaks the member record into the head KV (and its snapshots), and a
+        later group reusing the name could resolve a dead rank's address."""
+        destroy_collective_group(group_name)
+        return True
 
-def get_group(group_name: str = "default") -> HostCollectiveGroup:
+
+def destroy_group_on(actors, group_name: str = "default"):
+    """Close `group_name` on every member actor (the teardown twin of
+    create_collective_group)."""
+    from ..core import api as ca
+
+    ca.get(
+        [a.collective_close.remote(group_name) for a in actors], timeout=30
+    )
+
+
+def get_group(group_name: str = "default"):
     if group_name not in _groups:
         raise ValueError(f"collective group {group_name!r} not initialized")
     return _groups[group_name]

