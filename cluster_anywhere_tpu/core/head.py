@@ -30,7 +30,7 @@ import sys
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import scheduling
 from .config import CAConfig
@@ -285,6 +285,12 @@ class Head:
         # (the two travel on different sockets): tombstones cancel the late
         # pin instead of leaking a permanent holder
         self._spent_transit: Dict[str, float] = {}
+        # live transit pins: token -> (created_at, pinned oids).  Normally
+        # released by the receiver's transit_done; the TTL sweep reclaims
+        # pins whose reply was lost in flight (e.g. the borrower's RPC timed
+        # out after the owner had already pinned and replied) — without it
+        # such a pin would hold the objects for the owner's whole lifetime
+        self._transit_pins: Dict[str, Tuple[float, List[bytes]]] = {}
         # fault tolerance (gcs_server.h StorageType analogue, file-backed):
         # debounced snapshots of the cluster tables; a restarted head loads
         # them and re-adopts live workers/agents/drivers
@@ -1649,11 +1655,17 @@ class Head:
         late pin is cancelled instead of leaking a permanent holder."""
         cid = state.get("client_id", "?")
         token = msg["token"]
+        # register=False: the receiver could NOT consume the payload
+        # (corrupt/unreadable) — drop the pin without recording the caller
+        # as a holder it isn't
+        register = msg.get("register", True)
+        self._transit_pins.pop(token, None)
         seen = False
         for oid in msg.get("oids") or []:
             rec = self.objects.get(oid)
             if rec is not None:
-                rec.holders.add(cid)
+                if register:
+                    rec.holders.add(cid)
                 if token in rec.holders:
                     seen = True
                     rec.holders.discard(token)
@@ -1661,11 +1673,12 @@ class Head:
             else:
                 early = self._early_refs.get(oid)
                 if early is not None:
-                    early.add(cid)
+                    if register:
+                        early.add(cid)
                     if token in early:
                         seen = True
                         early.discard(token)
-                else:
+                elif register:
                     self._early_refs.setdefault(oid, set()).add(cid)
         if not seen:
             self._spent_transit[token] = time.monotonic()
@@ -1833,7 +1846,11 @@ class Head:
             # the receiver already acked this transit: the pin is moot
             del self._spent_transit[cid]
         else:
-            for oid in msg.get("inc", []):
+            inc = msg.get("inc", [])
+            if inc and cid.startswith("t:"):
+                # track for the TTL sweep (lost-reply reclamation)
+                self._transit_pins[cid] = (time.monotonic(), list(inc))
+            for oid in inc:
                 rec = self.objects.get(oid)
                 if rec is not None:
                     rec.holders.add(cid)
@@ -2245,6 +2262,8 @@ class Head:
             if stale:
                 rec.holders.difference_update(stale)
                 self._obj_maybe_gc(rec)
+        for tok in [t for t in self._transit_pins if t.startswith(transit_prefix)]:
+            del self._transit_pins[tok]
         if state.get("role") == "worker":
             rec = self.workers.get(cid)
             if rec is not None:
@@ -2296,6 +2315,24 @@ class Head:
                 cutoff = now - 60.0
                 for tok in [t for t, ts in self._spent_transit.items() if ts < cutoff]:
                     del self._spent_transit[tok]
+            if self._transit_pins:
+                # reclaim pins whose transit_done was lost (receiver's RPC
+                # timed out after the sender pinned).  10 minutes is far
+                # beyond any live transfer, so this can only fire on a
+                # genuinely lost ack
+                cutoff = now - 600.0
+                for tok in [
+                    t for t, (ts, _) in self._transit_pins.items() if ts < cutoff
+                ]:
+                    _, oids = self._transit_pins.pop(tok)
+                    for oid in oids:
+                        rec = self.objects.get(oid)
+                        if rec is not None and tok in rec.holders:
+                            rec.holders.discard(tok)
+                            self._obj_maybe_gc(rec)
+                        early = self._early_refs.get(oid)
+                        if early is not None:
+                            early.discard(tok)
             if (
                 self.mem_monitor is not None
                 and now - self._last_mem_check

@@ -436,6 +436,8 @@ class LeasePool:
 class Worker:
     """Per-process core runtime."""
 
+    _OWNER_ADDR_NEG_TTL = 5.0  # seconds a failed owner-address lookup caches
+
     def __init__(
         self,
         mode: str,
@@ -534,7 +536,10 @@ class Worker:
         # Connection-able addr (None = owner unreachable/non-serving; the
         # head fallback handles it).  One head lookup per OWNER, not per
         # object.
-        self._owner_addr_cache: Dict[str, Optional[str]] = {}
+        # owner -> (addr | None, expiry | None): positive entries live for
+        # the session, negative ones expire so transient head failures
+        # don't permanently disable the p2p/owner path for a healthy peer
+        self._owner_addr_cache: Dict[str, Tuple[Optional[str], Optional[float]]] = {}
         self._p2p_server = None  # driver-mode mini server (workers use theirs)
         self.current_task_id: Optional[TaskID] = None
         self.current_actor_id: Optional[ActorID] = None
@@ -666,6 +671,12 @@ class Worker:
             cap = (msg.get("data") or {}).get("cap")
             to_return = []
             for pool in self._lease_pools.values():
+                if pool.pg is not None:
+                    # PG leases return to the placement group's own
+                    # reservation, never to free cluster capacity — shedding
+                    # them can't satisfy the contending client and only costs
+                    # this client re-acquisition latency
+                    continue
                 if cap is not None:
                     pool.contended_cap = int(cap)
                     pool.contended_until = time.monotonic() + 1.0
@@ -793,7 +804,7 @@ class Worker:
         async def handle(state, msg, reply, reply_err):
             m = msg["m"]
             if m == "owner_locate":
-                reply(**self.owner_locate_local(msg["oid"]))
+                reply(**await self.owner_locate_async(msg["oid"]))
             elif m == "coll_push":
                 self.coll_deliver(
                     msg["group"], msg["key"], msg["src"],
@@ -809,6 +820,11 @@ class Worker:
         await self._p2p_server.start()
 
     def owner_locate_local(self, oid_b: bytes) -> dict:
+        """Sync shim over owner_locate_async for off-loop callers (tests,
+        diagnostics); the serve handlers await the async form directly."""
+        return self.run_coro(self.owner_locate_async(oid_b), timeout=30)
+
+    async def owner_locate_async(self, oid_b: bytes) -> dict:
         """Answer a borrower's location query from THIS process's authority
         over objects it owns (ownership_based_object_directory.h read path).
 
@@ -832,13 +848,23 @@ class Worker:
                 "size": e.size,
                 "node": self.node_id,
             }
-        if e.state == "packed":
-            return {"found": True, "v": e.packed}
-        if e.state == "value":
+        if e.state in ("packed", "value"):
+            # inline result served by value.  Nested ObjectRefs smuggled in
+            # the payload need the same transit-pin protocol as task args
+            # (_pack_with_transit_async): without a pin, the head may GC the
+            # inner object between our reply and the borrower registering
+            # its handle.  Packed blobs are re-packed through capture for
+            # the same reason — the original pack ran before this borrower
+            # existed.
             try:
-                return {"found": True, "v": serialization.pack(e.value)}
+                value = (
+                    serialization.unpack(e.packed) if e.state == "packed"
+                    else e.value
+                )
+                spec = await self._pack_with_transit_async(value)
             except Exception:
                 return {"found": False}
+            return {"found": True, **spec}
         return {"found": False}
 
     def coll_deliver(self, group: str, key: str, src: int, data, shape, dtype):
@@ -895,15 +921,21 @@ class Worker:
         self.run_coro(_send(), timeout=timeout)
 
     async def _owner_addr_async(self, owner: Optional[str]) -> Optional[str]:
-        """Resolve (and cache) the serving address of an object owner.  One
-        head lookup per owner process for the session; None = owner can't be
-        dialed (dead, remote client, or unknown) — callers fall back to the
-        head."""
+        """Resolve (and cache) the serving address of an object owner.
+        Positive results cache for the session (one head lookup per owner
+        process); None = owner can't be dialed right now (dead, remote
+        client, unknown, or the head was briefly unreachable) — callers fall
+        back to the head.  Negative results only cache for a short TTL so a
+        transient head hiccup can't permanently disable the owner/p2p path
+        for a healthy peer."""
         if not owner or owner == self.client_id:
             return None
-        if owner in self._owner_addr_cache:
-            return self._owner_addr_cache[owner]
-        addr: Optional[str] = None
+        cached = self._owner_addr_cache.get(owner)
+        if cached is not None:
+            addr, expiry = cached
+            if expiry is None or time.monotonic() < expiry:
+                return addr
+        addr = None
         try:
             reply = await self.head.call("client_addr", client_id=owner)
             if reply.get("found"):
@@ -913,14 +945,20 @@ class Worker:
                     addr = reply.get("addr_tcp") or reply.get("addr") or None
         except Exception:
             addr = None
-        self._owner_addr_cache[owner] = addr
+        self._owner_addr_cache[owner] = (
+            (addr, None) if addr is not None
+            else (None, time.monotonic() + self._OWNER_ADDR_NEG_TTL)
+        )
         return addr
 
     def _owner_addr(self, owner: Optional[str]) -> Optional[str]:
         if not owner or owner == self.client_id:
             return None
-        if owner in self._owner_addr_cache:
-            return self._owner_addr_cache[owner]
+        cached = self._owner_addr_cache.get(owner)
+        if cached is not None:
+            addr, expiry = cached
+            if expiry is None or time.monotonic() < expiry:
+                return addr
         return self.run_coro(self._owner_addr_async(owner), timeout=30)
 
     async def conn_to(self, addr: str) -> Connection:
@@ -1263,15 +1301,43 @@ class Worker:
                 reply = {}
                 asked_head = False
                 if owner_addr is not None:
+                    dialing = owner_conn is None or owner_conn.closed
                     try:
-                        if owner_conn is None or owner_conn.closed:
-                            owner_conn = await self.conn_to(owner_addr)
+                        if dialing:
+                            # bounded dial: an unreachable host must fail fast
+                            # into the head fallback, not sit in the kernel
+                            # SYN timeout with the every-8th head check stuck
+                            # behind it.  shield: conn_to's in-flight future
+                            # is shared per-addr — a bare wait_for would
+                            # cancel-poison every other coroutine awaiting
+                            # the same dial
+                            owner_conn = await asyncio.wait_for(
+                                asyncio.shield(self.conn_to(owner_addr)),
+                                timeout=5,
+                            )
                         reply = await owner_conn.call(
                             "owner_locate", oid=oid_b, timeout=10
                         )
                     except Exception:
-                        owner_addr = None  # owner died: head takes over
                         owner_conn = None
+                        if dialing:
+                            # undialable: expire the session-long positive
+                            # cache so resolutions re-ask the head instead of
+                            # re-dialing a dead address
+                            owner_addr = None
+                            self._owner_addr_cache[owner] = (
+                                None,
+                                time.monotonic() + self._OWNER_ADDR_NEG_TTL,
+                            )
+                        # a mere call timeout (owner busy running the task)
+                        # keeps the address: inline-only objects exist ONLY
+                        # at the owner, so giving up on it for the rest of
+                        # the poll could make them unresolvable
+                elif attempt % 8 == 7:
+                    # the owner path may have recovered (restarted head,
+                    # momentary blip at first resolution): re-ask under the
+                    # neg-TTL cache, which bounds head traffic
+                    owner_addr = await self._owner_addr_async(owner)
                 # every 8th attempt (and always without an owner), check the
                 # head too — it alone knows spill relocations and survives
                 # owner death
@@ -1289,9 +1355,24 @@ class Worker:
                         try:
                             value = serialization.unpack(reply["v"])
                         except Exception:
+                            if reply.get("t"):
+                                # we can't consume it: release the owner's
+                                # transit pin without claiming holdership, or
+                                # every retry tick leaks another pin
+                                self.transit_done(
+                                    reply["t"], reply.get("roids") or [],
+                                    register=False,
+                                )
                             reply = {}  # corrupt/unreadable: keep polling
                         else:
                             self.memory_store.put_value(oid, value)
+                            if reply.get("t"):
+                                # our handles for smuggled nested refs are
+                                # registered by unpack: release the owner's
+                                # transit pin (borrowing protocol)
+                                self.transit_done(
+                                    reply["t"], reply.get("roids") or []
+                                )
                             return
                     else:
                         self.memory_store.put_shm(
@@ -1300,10 +1381,11 @@ class Worker:
                         return
                 attempt += 1
                 await asyncio.sleep(interval)
-                # owner polls stay snappy (direct, distributed); head-only
-                # polls back off like before to protect the shared loop
-                if owner_addr is None or asked_head:
-                    interval = min(interval * 2, 1.0)
+                # owner polls back off to a low cap (direct and distributed,
+                # but the owner's IO loop is also running the producing task);
+                # head-only polls back off further to protect the shared loop
+                cap = 1.0 if (owner_addr is None or asked_head) else 0.2
+                interval = min(interval * 2, cap)
 
         try:
             self.loop.call_soon_threadsafe(lambda: spawn_bg(_poll()))
@@ -1877,13 +1959,19 @@ class Worker:
         self._notify_threadsafe("obj_refs", inc=list(nested), as_id=token)
         return token
 
-    def transit_done(self, token: str, roids: List[bytes]) -> None:
+    def transit_done(self, token: str, roids: List[bytes],
+                     register: bool = True) -> None:
         """Receiver-side ack: register this process as holder of the smuggled
-        refs and release the sender's transit pin (thread-safe)."""
+        refs and release the sender's transit pin (thread-safe).
+        register=False releases the pin without claiming holdership — for
+        payloads the receiver failed to unpack."""
         def _send():
             if self.head is not None and not self.head.closed:
                 try:
-                    self.head.notify("transit_done", token=token, oids=roids)
+                    self.head.notify(
+                        "transit_done", token=token, oids=roids,
+                        register=register,
+                    )
                 except Exception:
                     pass
 
@@ -2559,8 +2647,7 @@ class Worker:
             for c in self._conns.values():
                 await c.close()
             if self._p2p_server is not None:
-                for srv in self._p2p_server._servers:
-                    srv.close()
+                await self._p2p_server.stop()
                 for a in self._p2p_server.bound_addrs:
                     if a.startswith("unix:"):
                         try:

@@ -655,8 +655,8 @@ class WorkerProcess:
                 reply_err(e)
         elif m == "owner_locate":
             # ownership-based object directory read path: this process is
-            # authoritative for objects it owns (see Worker.owner_locate_local)
-            reply(**self.worker.owner_locate_local(msg["oid"]))
+            # authoritative for objects it owns (see Worker.owner_locate_async)
+            reply(**await self.worker.owner_locate_async(msg["oid"]))
         elif m == "coll_push":
             # p2p collective transport: land the chunk in the rank mailbox
             self.worker.coll_deliver(

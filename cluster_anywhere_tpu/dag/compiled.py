@@ -58,13 +58,22 @@ def _extract_input(input_payload, key):
     raise KeyError(key)
 
 
-def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple[dict, int]],
+def _dag_actor_loop(instance, schedule: List[tuple], node_ops: Dict[int, dict],
+                    reader_specs: Dict[int, Tuple[dict, int]],
                     writer_specs: Dict[int, dict], timeout: float):
     """Runs inside the actor (via the __ca_exec__ builtin): loop until the
-    input side closes, executing this actor's nodes each tick."""
+    input side closes, executing this actor's operation schedule each tick.
+
+    `schedule` is this actor's projection of the global operation schedule
+    (dag/operation.py, reference dag_node_operation.py): an ordered list of
+    ("read", channel_id) / ("compute", node_id) / ("write", node_id) ops.
+    Scheduled reads replace lazy ones — the schedule is a slice of one
+    global topological order, so a blocking read here can never deadlock
+    against another actor's schedule, and each channel is read exactly once
+    per tick (readers desynchronize from writers otherwise)."""
     readers = {nid: open_channel(spec, ridx) for nid, (spec, ridx) in reader_specs.items()}
     writers = {nid: open_channel(spec) for nid, spec in writer_specs.items()}
-    tensor_nids = {nid for nid, (spec, _) in reader_specs.items() if spec.get("tensor")}
+    tensor_chans = {nid for nid, (spec, _) in reader_specs.items() if spec.get("tensor")}
     tensor_writers = {nid for nid, spec in writer_specs.items() if spec.get("tensor")}
 
     def _to_device(v):
@@ -93,90 +102,75 @@ def _dag_actor_loop(instance, program: List[dict], reader_specs: Dict[int, Tuple
     ticks = 0
     try:
         while True:
+            chan_vals: Dict[int, Any] = {}
             tick_vals: Dict[int, Any] = {}
-
-            def chan_val(nid):
-                # block without deadline: teardown closes the channel to wake us.
-                # Reads are lazy and in topo order — an eager prefetch of all
-                # input channels could deadlock on cyclic actor placements
-                # (A.n1 -> B.n2 -> A.n3 would have A wait on n2 before writing n1)
-                if nid not in tick_vals:
-                    v = readers[nid].read(None)
-                    if nid in tensor_nids and not isinstance(v, _DagError):
-                        v = _to_device(v)
-                    tick_vals[nid] = v
-                return tick_vals[nid]
-
             err: Optional[_DagError] = None
             closed = False
-            for op in program:
-                def resolve(spec):
-                    kind, ref = spec
-                    if kind == "const":
-                        return ref
-                    if kind == "chan":
-                        v = chan_val(ref)
-                        return v
-                    if kind == "local":
-                        return tick_vals[ref]
-                    if kind == "input":
-                        return _extract_input(chan_val(ref[0]), ref[1])
-                    raise ValueError(kind)
 
-                def drain_op():
-                    # every channel must be read exactly once per tick or
-                    # readers desynchronize from writers on the next execution
-                    # (chan_val caches, so re-draining already-read args is a
-                    # no-op)
-                    for spec in list(op["args"]) + list(op["kwargs"].values()):
-                        kind, ref = spec
-                        if kind == "chan":
-                            chan_val(ref)
-                        elif kind == "input":
-                            chan_val(ref[0])
+            def resolve(spec):
+                kind, ref = spec
+                if kind == "const":
+                    return ref
+                if kind == "chan":
+                    return chan_vals[ref]
+                if kind == "local":
+                    return tick_vals[ref]
+                if kind == "input":
+                    payload = chan_vals[ref[0]]
+                    if isinstance(payload, _DagError):
+                        return payload
+                    return _extract_input(payload, ref[1])
+                raise ValueError(kind)
 
-                if err is None:
-                    try:
-                        args = [resolve(s) for s in op["args"]]
-                        kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
-                        bad = next((a for a in args + list(kwargs.values())
-                                    if isinstance(a, _DagError)), None)
-                        if bad is not None:
-                            result = bad
+            for kind, ref in schedule:
+                try:
+                    if kind == "read":
+                        # block without deadline: teardown closes the channel
+                        # to wake us
+                        v = readers[ref].read(None)
+                        if ref in tensor_chans and not isinstance(v, _DagError):
+                            try:
+                                v = _to_device(v)
+                            except BaseException as e:  # noqa: BLE001
+                                # a bad landing (device OOM, shard-spec
+                                # mismatch) is this tick's error, not the
+                                # loop's death: forward it to the driver
+                                v = _DagError(e)
+                                err = err or v
+                        chan_vals[ref] = v
+                    elif kind == "compute":
+                        op = node_ops[ref]
+                        if err is not None:
+                            # actor-local poisoning: once an op on this actor
+                            # fails in a tick, later ops forward the error so
+                            # the driver sees the root cause, not knock-ons
+                            result = err
                         else:
-                            result = getattr(instance, op["method"])(*args, **kwargs)
-                    except ChannelClosedError:
-                        closed = True
-                        break
-                    except BaseException as e:  # noqa: BLE001 — forwarded to driver
-                        result = _DagError(e)
-                        err = result
-                        try:  # arg resolution may have aborted mid-way
-                            drain_op()
-                        except ChannelClosedError:
-                            closed = True
-                            break
-                else:
-                    try:
-                        drain_op()
-                    except ChannelClosedError:
-                        closed = True
-                        break
-                    result = err
-                tick_vals[op["node_id"]] = result
-                if op["node_id"] in writers:
-                    out = result
-                    if op["node_id"] in tensor_writers and not isinstance(result, _DagError):
-                        try:
-                            out = _pack_tensor(result)
-                        except BaseException as e:  # noqa: BLE001 — surfaced to driver
-                            out = _DagError(e)
-                            err = err or out
-                    try:
-                        writers[op["node_id"]].write(out, timeout)
-                    except ChannelClosedError:
-                        closed = True
-                        break
+                            try:
+                                args = [resolve(s) for s in op["args"]]
+                                kwargs = {k: resolve(s) for k, s in op["kwargs"].items()}
+                                bad = next((a for a in args + list(kwargs.values())
+                                            if isinstance(a, _DagError)), None)
+                                if bad is not None:
+                                    result = bad
+                                else:
+                                    result = getattr(instance, op["method"])(*args, **kwargs)
+                            except BaseException as e:  # noqa: BLE001 — forwarded to driver
+                                result = _DagError(e)
+                                err = result
+                        tick_vals[ref] = result
+                    else:  # write
+                        out = tick_vals[ref]
+                        if ref in tensor_writers and not isinstance(out, _DagError):
+                            try:
+                                out = _pack_tensor(out)
+                            except BaseException as e:  # noqa: BLE001 — surfaced to driver
+                                out = _DagError(e)
+                                err = err or out
+                        writers[ref].write(out, timeout)
+                except ChannelClosedError:
+                    closed = True
+                    break
             if closed:
                 break
             ticks += 1
@@ -319,11 +313,24 @@ class CompiledDAG:
             for i, key in enumerate(sorted(cons)):
                 reader_index[(nid, key)] = i
 
-        # per-actor programs in global topo order
+        # per-actor operation schedules from the global operation graph
+        # (dag/operation.py; reference dag_node_operation.py).  The schedule
+        # decides when each channel read happens, so multi-stage actors
+        # front-load shallow-stage work instead of blocking a whole tick on
+        # a deeper stage's upstream — GPipe-style microbatch pipelining.
+        from .operation import build_operation_graph, generate_actor_schedules
+
+        channel_node_ids = {nid for nid in self._channels if nid != INPUT_ID}
+        ops, op_edges = build_operation_graph(
+            compute, owner, channel_node_ids, INPUT_ID
+        )
+        raw_schedules = generate_actor_schedules(ops, op_edges)
+
         self._loop_refs = []
         self._handles = handles
+        self._actor_schedules: Dict[str, List[tuple]] = {}
         for key, handle in handles.items():
-            program = []
+            node_ops: Dict[int, dict] = {}
             reader_specs: Dict[int, Tuple[dict, int]] = {}
             writer_specs: Dict[int, dict] = {}
             for n in compute:
@@ -359,29 +366,36 @@ class CompiledDAG:
                         return ("chan", dep._id)
                     return ("const", dep)
 
-                program.append(
-                    {
-                        "node_id": n._id,
-                        "method": n._method_name,
-                        "args": [
-                            arg_spec(a) if isinstance(a, DAGNode) else ("const", a)
-                            for a in n._bound_args
-                        ],
-                        "kwargs": {
-                            k: arg_spec(v) if isinstance(v, DAGNode) else ("const", v)
-                            for k, v in n._bound_kwargs.items()
-                        },
-                    }
-                )
+                node_ops[n._id] = {
+                    "method": n._method_name,
+                    "args": [
+                        arg_spec(a) if isinstance(a, DAGNode) else ("const", a)
+                        for a in n._bound_args
+                    ],
+                    "kwargs": {
+                        k: arg_spec(v) if isinstance(v, DAGNode) else ("const", v)
+                        for k, v in n._bound_kwargs.items()
+                    },
+                }
                 if n._id in self._channels:
                     wspec = dict(self._channels[n._id].spec())
                     if getattr(n, "_tensor_transport", False):
                         wspec["tensor"] = True
                     writer_specs[n._id] = wspec
+
+            # project the actor's OpIds into loop ops: READ carries the
+            # channel id, COMPUTE/WRITE carry the node id
+            schedule: List[tuple] = []
+            for opid in raw_schedules.get(key, []):
+                kind, ref = opid
+                schedule.append(("read", ref[0]) if kind == "read" else (kind, ref))
+            self._actor_schedules[key] = schedule
+
             from ..core.actor import ActorMethod
 
             ref = ActorMethod(handle, "__ca_exec__").remote(
-                _dag_actor_loop, program, reader_specs, writer_specs, self._timeout
+                _dag_actor_loop, schedule, node_ops, reader_specs, writer_specs,
+                self._timeout,
             )
             self._loop_refs.append(ref)
 
@@ -487,3 +501,9 @@ class CompiledDAG:
 
     def visualize(self) -> str:
         return self._root.visualize()
+
+    def actor_schedules(self) -> Dict[str, List[tuple]]:
+        """The per-actor operation schedules this DAG executes (reference:
+        CompiledDAG.actor_to_execution_schedule).  Read-only introspection
+        for tests and debugging."""
+        return {k: list(v) for k, v in self._actor_schedules.items()}

@@ -326,11 +326,16 @@ class P2PCollectiveGroup:
     # ------------------------------------------------------------ collectives
     @staticmethod
     def _acc_dtype(dtype: np.dtype, op: str):
+        # mirror the kv backend's np.stack(...).<op>(axis=0) result dtypes so
+        # the two interchangeable backends agree bit-for-bit in type
         if op == "mean":
             return np.result_type(dtype, np.float64)
-        if np.issubdtype(dtype, np.integer):
-            return np.int64  # match np.sum/stack-reduce accumulator dtype
-        return dtype
+        if op == "sum":
+            if np.issubdtype(dtype, np.unsignedinteger):
+                return np.uint64  # np.sum keeps unsigned unsigned
+            if dtype == np.bool_ or np.issubdtype(dtype, np.integer):
+                return np.int64  # bools count (not saturate), ints widen like np.sum
+        return dtype  # max/min (and float sum) preserve the input dtype
 
     @staticmethod
     def _combine(acc: np.ndarray, incoming: np.ndarray, op: str):
@@ -349,8 +354,8 @@ class P2PCollectiveGroup:
         self._seq += 1
         acc_dt = self._acc_dtype(arr.dtype, op)
         if n == 1:
-            out = arr.astype(acc_dt, copy=True)
-            return out if op != "mean" else out  # mean of one = itself
+            out = arr.astype(acc_dt, copy=True)  # mean of one = itself
+            return self._mean_result_dtype(out, arr.dtype, op)
         seq = self._seq
         left, right = (self.rank - 1) % n, (self.rank + 1) % n
         flat = arr.astype(acc_dt).reshape(-1)
@@ -374,6 +379,15 @@ class P2PCollectiveGroup:
         out = np.concatenate([c.reshape(-1) for c in chunks]).reshape(arr.shape)
         if op == "mean":
             out = out / n
+        return self._mean_result_dtype(out, arr.dtype, op)
+
+    @staticmethod
+    def _mean_result_dtype(out: np.ndarray, in_dtype: np.dtype, op: str):
+        # match the kv backend (np.stack(...).mean(axis=0)): mean preserves
+        # an inexact input dtype and yields float64 for integers — the f64
+        # ring accumulator must not leak into the result
+        if op == "mean" and np.issubdtype(in_dtype, np.inexact):
+            return out.astype(in_dtype)
         return out
 
     def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:

@@ -358,9 +358,10 @@ def test_compiled_dag_cross_actor_pipeline_overlap(ca_cluster_module):
         serial = K * 2 * delay  # 1.8s: no overlap, each exec pays both stages
         pipeline = (K + 1) * delay  # 1.05s: perfect 2-stage fill + drain
         # one bound, strictly between the pipeline and serial regimes
-        # (pipeline*1.35 = 1.42s < serial*0.8 = 1.44s): passing requires
-        # genuine overlap AND staying near the (K+1)*delay schedule
-        assert elapsed < pipeline * 1.35, (
+        # (pipeline*1.45 = 1.52s < serial*0.85 = 1.53s): passing requires
+        # genuine overlap, with ~0.47s of co-tenant headroom over the
+        # perfect schedule (this 1-core host swings with load — SCALE.md)
+        assert elapsed < pipeline * 1.45, (
             f"stages did not pipeline: {elapsed:.2f}s vs pipeline bound "
             f"{pipeline:.2f}s (serial would be {serial:.2f}s)"
         )
@@ -392,6 +393,68 @@ def test_compiled_dag_three_stage_throughput_scales(ca_cluster_module):
         serial = K * 3 * delay
         assert elapsed < serial * 0.67, (
             f"3-stage chain ran serially: {elapsed:.2f}s vs {serial:.2f}s"
+        )
+    finally:
+        dag.teardown()
+
+
+def test_compiled_dag_interleaved_stages_schedule(ca_cluster_module):
+    """Multi-node-per-actor microbatch interleaving (the shape the explicit
+    operation schedule exists for, reference dag_node_operation.py): actor A
+    hosts stages 0 and 2, actor B hosts stage 1, and FOUR microbatch paths
+    run through one DAG.  A depth-first program order serialises the
+    microbatches — A cannot start microbatch 1's stage 0 until microbatch
+    0's stage 2 has come back through B — giving ~B x 3 x delay per tick.
+    The depth-prioritised schedule front-loads every microbatch's stage-0
+    compute before A's first stage-1 read, pushing the tick down toward
+    actor A's own compute floor of 2B x delay (A runs 2 of the 3 stages,
+    so it is the bottleneck; B's stage overlaps entirely)."""
+    import time as _t
+
+    from cluster_anywhere_tpu.dag.operation import COMPUTE, READ
+
+    delay = 0.15
+    B = 4
+    a, b = _SlowStage.remote(), _SlowStage.remote()
+    with InputNode() as inp:
+        outs = []
+        s0_ids, s2_read_producers = [], []
+        for m in range(B):
+            s0 = a.work.bind(inp[m], delay=delay)
+            s1 = b.work.bind(s0, delay=delay)
+            s2 = a.work.bind(s1, delay=delay)
+            s0_ids.append(s0._id)
+            s2_read_producers.append(s1._id)
+            outs.append(s2)
+    dag = MultiOutputNode(outs).experimental_compile(max_inflight_executions=B)
+    try:
+        # schedule shape: on actor A, every stage-0 COMPUTE precedes the
+        # first stage-1 READ (the op that blocks on B)
+        sched = dag.actor_schedules()
+        a_key = a.actor_id.hex()
+        a_sched = sched[a_key]
+        s0_pos = [a_sched.index((COMPUTE, nid)) for nid in s0_ids]
+        read_pos = [
+            i for i, (kind, ref) in enumerate(a_sched)
+            if kind == "read" and ref in s2_read_producers
+        ]
+        assert max(s0_pos) < min(read_pos), (
+            f"schedule serialises microbatches: stage-0 computes at {s0_pos}, "
+            f"stage-1 reads at {read_pos}\n{a_sched}"
+        )
+
+        dag.execute(*range(B)).get(timeout=60)  # warmup
+        t0 = _t.monotonic()
+        got = dag.execute(*[10 * m for m in range(B)]).get(timeout=60)
+        elapsed = _t.monotonic() - t0
+        assert got == [10 * m + 3 for m in range(B)]
+        serial = B * 3 * delay  # 1.8s: each microbatch pays all 3 stages
+        floor = 2 * B * delay  # 1.2s: actor A's own computes, back to back
+        # 0.84 x serial = 1.51s sits 0.3s above the hard floor (scheduling +
+        # channel overhead headroom on a loaded host) yet well below serial
+        assert elapsed < serial * 0.84, (
+            f"microbatches did not interleave: {elapsed:.2f}s "
+            f"(serial {serial:.2f}s, A-bound floor {floor:.2f}s)"
         )
     finally:
         dag.teardown()

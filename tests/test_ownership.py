@@ -142,3 +142,57 @@ def test_owner_locate_answers_for_driver_objects(ca_cluster_module):
     # and over the wire: a worker can dial the driver's p2p socket
     addr = w._p2p_addr() or w.serve_addr
     assert addr, "driver has no p2p listener"
+
+
+def test_owner_served_inline_nested_refs_survive_container_release(ca_cluster_module):
+    """An inline container of ObjectRefs served by value over the owner path
+    must carry transit pins for the nested refs (the task-arg borrowing
+    protocol): without them the head can GC the inner object between the
+    owner's reply and the borrower registering its handle.  Regression for
+    the bare-serialization.pack gap in owner_locate."""
+
+    @ca.remote
+    def make_container():
+        inner = ca.put(np.arange(256, dtype=np.float64))
+        time.sleep(0.4)  # borrower polls while we're still pending
+        return [inner]  # small list of refs: stays inline on the owner
+
+    @ca.remote
+    def consume(holder):
+        # resolve the forwarded container ref (owner-served, inline), then
+        # drop every container handle before touching the inner ref
+        container = ca.get(holder[0])
+        inner = container[0]
+        del container, holder
+        import gc
+
+        gc.collect()
+        time.sleep(0.3)  # any missing pin lets GC reap the inner object now
+        return int(ca.get(inner).sum())
+
+    r = make_container.remote()
+    out = ca.get(consume.remote([r]), timeout=60)
+    assert out == int(np.arange(256).sum())
+
+
+def test_p2p_and_kv_backend_dtype_parity(ca_cluster_module):
+    """The two interchangeable host backends must agree on result dtypes and
+    values: bool sums count (not saturate), integer max/min keep their
+    dtype, float32 mean stays float32."""
+    cases = [
+        (np.array([True, False, True]), "sum", np.int64),
+        (np.array([3, 9], dtype=np.int32), "max", np.int32),
+        (np.array([3, 9], dtype=np.int32), "min", np.int32),
+        (np.array([2.0, 4.0], dtype=np.float32), "mean", np.float32),
+        (np.array([1, 2], dtype=np.int32), "mean", np.float64),
+    ]
+    for i, (arr, op, want_dtype) in enumerate(cases):
+        gk = coll.init_collective_group(1, 0, backend="kv", group_name=f"dk{i}")
+        gp = coll.init_collective_group(1, 0, backend="host", group_name=f"dp{i}")
+        try:
+            rk, rp = gk.allreduce(arr, op=op), gp.allreduce(arr, op=op)
+            assert rk.dtype == rp.dtype == want_dtype, (op, arr.dtype, rk.dtype, rp.dtype)
+            np.testing.assert_allclose(rk, rp)
+        finally:
+            coll.destroy_collective_group(f"dk{i}")
+            coll.destroy_collective_group(f"dp{i}")
