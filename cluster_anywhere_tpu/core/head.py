@@ -1847,8 +1847,12 @@ class Head:
             del self._spent_transit[cid]
         else:
             inc = msg.get("inc", [])
-            if inc and cid.startswith("t:"):
-                # track for the TTL sweep (lost-reply reclamation)
+            if inc and msg.get("ttl") and cid.startswith("t:"):
+                # track for the TTL sweep (lost-reply reclamation).  Only
+                # pins that opt in (bounded-ack protocols like owner_locate
+                # serving); task-arg pins ack at execution time, which lease
+                # queueing can delay past any fixed TTL — those are cleaned
+                # by sender liveness (the disconnect sweep) instead
                 self._transit_pins[cid] = (time.monotonic(), list(inc))
             for oid in inc:
                 rec = self.objects.get(oid)

@@ -861,7 +861,7 @@ class Worker:
                     serialization.unpack(e.packed) if e.state == "packed"
                     else e.value
                 )
-                spec = await self._pack_with_transit_async(value)
+                spec = await self._pack_with_transit_async(value, ttl_pin=True)
             except Exception:
                 return {"found": False}
             return {"found": True, **spec}
@@ -930,11 +930,9 @@ class Worker:
         for a healthy peer."""
         if not owner or owner == self.client_id:
             return None
-        cached = self._owner_addr_cache.get(owner)
-        if cached is not None:
-            addr, expiry = cached
-            if expiry is None or time.monotonic() < expiry:
-                return addr
+        hit = self._cached_owner_addr(owner)
+        if hit is not None:
+            return hit[0]
         addr = None
         try:
             reply = await self.head.call("client_addr", client_id=owner)
@@ -951,14 +949,22 @@ class Worker:
         )
         return addr
 
-    def _owner_addr(self, owner: Optional[str]) -> Optional[str]:
-        if not owner or owner == self.client_id:
-            return None
+    def _cached_owner_addr(self, owner: str):
+        """Live cache entry as a (addr,) 1-tuple, or None on miss/expiry —
+        the single place the (addr, expiry) format is interpreted."""
         cached = self._owner_addr_cache.get(owner)
         if cached is not None:
             addr, expiry = cached
             if expiry is None or time.monotonic() < expiry:
-                return addr
+                return (addr,)
+        return None
+
+    def _owner_addr(self, owner: Optional[str]) -> Optional[str]:
+        if not owner or owner == self.client_id:
+            return None
+        hit = self._cached_owner_addr(owner)
+        if hit is not None:
+            return hit[0]
         return self.run_coro(self._owner_addr_async(owner), timeout=30)
 
     async def conn_to(self, addr: str) -> Connection:
@@ -1980,16 +1986,25 @@ class Worker:
         except RuntimeError:
             pass
 
-    async def _pack_with_transit_async(self, value: Any) -> dict:
+    async def _pack_with_transit_async(self, value: Any, ttl_pin: bool = False) -> dict:
         """_pack_with_transit usable on the IO loop: client-mode promotion
-        awaits the head instead of blocking head_call."""
+        awaits the head instead of blocking head_call.
+
+        ttl_pin=True marks the pin for the head's lost-ack TTL sweep — ONLY
+        for protocols whose ack time is bounded (the owner_locate serve path,
+        where the borrower acks on unpack or promptly re-polls).  Task-arg
+        pins must NOT set it: a queued task's ack waits for execution, which
+        lease contention can delay indefinitely; their cleanup is sender
+        liveness (head disconnect sweep)."""
         with serialization.ref_capture() as nested:
             blob = serialization.pack(value)
         if not nested:
             return {"v": blob}
         await self._promote_nested_async(nested)
         token = f"t:{self.client_id}:{self._put_counter.next()}"
-        self._notify_threadsafe("obj_refs", inc=list(nested), as_id=token)
+        self._notify_threadsafe(
+            "obj_refs", inc=list(nested), as_id=token, ttl=bool(ttl_pin)
+        )
         return {"v": blob, "t": token, "roids": nested}
 
     async def _build_arg(self, value: Any) -> dict:

@@ -46,6 +46,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
     attn_impl: str = "auto"  # dense | ring | ulysses | auto
+    # flash kernel tile overrides (None = the kernel's measured defaults);
+    # exposed so the bench can sweep tiles per shape without forking the model
+    flash_block_q: Any = None
+    flash_block_k: Any = None
     pp: int = 1
     sp: int = 1
     num_microbatches: int = 1
@@ -229,7 +233,10 @@ def _attention(q, k, v, cfg: TransformerConfig, sp_manual: bool):
     if impl == "flash":  # force the Pallas kernel (perf A/B)
         from ..ops.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(
+            q, k, v, causal=True,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+        )
     # auto dense path: the dispatcher picks per shape/platform
     return dense_attention(q, k, v, causal=True)
 
