@@ -13,6 +13,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 QUICK = "--quick" in sys.argv
 
@@ -201,8 +202,8 @@ def bench_model():
             fwd = b * t * per_tok_layer * L + b * attn_per_seq_layer * L + b * t * 2 * e * V
             return 3 * fwd  # bwd ~= 2x fwd
 
-        def run(attn_impl: str):
-            cfg = TransformerConfig(
+        def run(attn_impl: str, donate: Optional[bool] = None, **cfg_overrides):
+            base = dict(
                 vocab_size=32000,
                 d_model=1024 if on_tpu else 128,
                 n_layers=8 if on_tpu else 2,
@@ -222,7 +223,15 @@ def bench_model():
                 flash_block_q=512,
                 flash_block_k=512,
             )
-            mesh = make_mesh(MeshSpec(dp=len(devs)))
+            base.update(cfg_overrides)
+            cfg = TransformerConfig(**base)
+            if cfg.n_experts:
+                # MoE routes over the ep axis; single-process bench uses
+                # ep=1 (all experts resident) — the A/B isolates routing +
+                # expert-FFN cost, not cross-chip all_to_all
+                mesh = make_mesh(MeshSpec(ep=1, dp=len(devs)))
+            else:
+                mesh = make_mesh(MeshSpec(dp=len(devs)))
             step, init_state = make_train_step(cfg, mesh)
             params, opt_state = init_state(jax.random.PRNGKey(0))
             b, t = (8, 1024) if on_tpu else (4, 128)
@@ -231,7 +240,14 @@ def bench_model():
                     np.random.randint(0, cfg.vocab_size, (b, t + 1), dtype=np.int32)
                 )
             }
-            jstep = jax.jit(step, donate_argnums=(0, 1))
+            # donation + partial-manual shard_map is pathological on this
+            # backend: the MoE step ran 3.4 s donated vs 74 ms undonated
+            # (measured, SCALE.md) — the input-output aliasing forces the
+            # tunnel runtime into per-buffer round trips.  Dense (no
+            # shard_map) donates fine and saves the param-copy HBM.
+            if donate is None:
+                donate = not cfg.n_experts
+            jstep = jax.jit(step, donate_argnums=(0, 1) if donate else ())
             params, opt_state, loss = jstep(params, opt_state, batch)  # compile
             _ = float(loss)  # host readback = real completion barrier
             n = 3 if QUICK else 10
@@ -268,6 +284,31 @@ def bench_model():
             f"mfu_pct: {mfu[0]:.1f} (causal-discounted {mfu[1]:.1f}) "
             f"({devs[0].platform})"
         )
+        # MoE A/B: same stack with the FFN switched to 4 top-1 experts
+        # (parallel/moe.py).  tokens/s only — MoE FLOP accounting differs
+        # (each token visits one expert + router), so MFU vs the dense
+        # count would mislead.
+        if not QUICK:
+            try:
+                # MoE A/B at L4, undonated, jnp attention on BOTH sides:
+                # - jnp attn: the ep shard_map is manual over 'ep' but
+                #   GSPMD-auto elsewhere, which Mosaic kernels can't join;
+                # - no donation: see the aliasing pathology above;
+                # - L4: the 4-expert stack at L8 is 360M params and an
+                #   UNdonated step needs two param+opt copies -> HBM spill
+                #   (4.3 s measured).  Holding depth/attn/donation fixed,
+                #   the pair isolates dense-FFN vs top-1 expert routing.
+                dt_d4, tok_d4, _ = run("jnp", donate=False, n_layers=4)
+                dt_moe, tok_moe, _ = run(
+                    "jnp", donate=False, n_layers=4, n_experts=4
+                )
+                log(
+                    f"model_step_moe[L4 e4 top-1, jnp attn]: {dt_moe*1000:.1f} ms, "
+                    f"tokens_per_s: {tok_moe:,.0f} "
+                    f"(dense L4 A/B: {dt_d4*1000:.1f} ms / {tok_d4:,.0f} tok/s)"
+                )
+            except Exception as e:  # MoE bench is supplementary
+                log(f"moe bench skipped: {type(e).__name__}: {e}")
     except Exception as e:
         log(f"model bench skipped: {type(e).__name__}: {e}")
 

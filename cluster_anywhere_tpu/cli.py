@@ -297,8 +297,41 @@ def cmd_metrics(args):
     ca = _connect(args)
     from cluster_anywhere_tpu.util import metrics
 
-    print(metrics.prometheus_text(), end="")
+    if getattr(args, "grafana_out", None):
+        from cluster_anywhere_tpu.util.grafana import write_grafana_dashboards
+
+        snap = metrics.get_metrics_snapshot()
+        for p in write_grafana_dashboards(args.grafana_out, snapshot=snap):
+            print(p)
+    else:
+        print(metrics.prometheus_text(), end="")
     ca.shutdown()
+
+
+def cmd_debug(args):
+    """List active remote breakpoints and attach (reference `ray debug`)."""
+    ca = _connect(args)
+    from cluster_anywhere_tpu.core.worker import global_worker
+    from cluster_anywhere_tpu.util import rpdb
+
+    try:
+        bps = rpdb.list_breakpoints(global_worker())
+        if not bps:
+            print("no active breakpoints")
+            return
+        for i, bp in enumerate(bps):
+            print(f"[{i}] {bp['label']}  (pid {bp['pid']}, {bp['host']}:{bp['port']})")
+        idx = args.index
+        if idx is None:
+            if len(bps) == 1:
+                idx = 0
+            else:
+                idx = int(input("attach to which breakpoint? "))
+        bp = bps[idx]
+        print(f"attaching to {bp['label']} ... (Ctrl-D to detach)")
+        rpdb.attach(bp["host"], bp["port"])
+    finally:
+        ca.shutdown()
 
 
 def cmd_dashboard(args):
@@ -430,7 +463,16 @@ def main(argv=None):
 
     sp = sub.add_parser("metrics", help="Prometheus metrics snapshot")
     addr(sp)
+    sp.add_argument(
+        "--grafana-out", default=None, metavar="DIR",
+        help="write Grafana dashboard JSON + provisioning stub to DIR",
+    )
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("debug", help="attach to a remote breakpoint (rpdb)")
+    addr(sp)
+    sp.add_argument("index", nargs="?", type=int, default=None)
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("dashboard", help="print the dashboard URL")
     addr(sp)

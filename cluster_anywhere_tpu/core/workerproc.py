@@ -225,6 +225,21 @@ class WorkerProcess:
                 "task interrupted by a cancellation aimed at another task "
                 "and declared non-retriable (max_retries=0)"
             )
+        except BaseException as e:
+            # CA_POST_MORTEM=1 (reference RAY_DEBUG_POST_MORTEM role): serve
+            # a remote pdb on the failure frame before the error propagates.
+            # Runs on the executor thread, so the worker's IO loop (and its
+            # health checks) stay live while a human is attached.
+            if os.environ.get("CA_POST_MORTEM") == "1" and not isinstance(
+                e, (SystemExit, KeyboardInterrupt)
+            ):
+                try:
+                    from ..util.rpdb import post_mortem
+
+                    post_mortem(e)
+                except Exception:
+                    pass
+            raise
         finally:
             if self._cancel_requested or self._precancelled:
                 # backstop for the delivery race: retract any async

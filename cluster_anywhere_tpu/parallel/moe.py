@@ -1,10 +1,15 @@
 """Expert parallelism: switch-style Mixture-of-Experts FFN with capacity-based
 top-1 routing and all-to-all token exchange over the 'ep' mesh axis.
 
-Dispatch/combine are expressed as one-hot einsums (MXU-friendly, static
-shapes — no gather/scatter), the standard TPU MoE formulation.  Experts'
-weights are sharded over 'ep'; tokens travel to their expert's device via
-`lax.all_to_all` and return after the expert FFN.
+Dispatch/combine use STATIC-SHAPE scatter/gather on flat slot indices
+(token n -> slot expert_idx[n] * capacity + position-within-expert), with
+dropped tokens routed to one overflow row that is sliced away.  The classic
+one-hot-einsum formulation ("nxc,ne->xce") is O(N·X·C·E) — at N=8k tokens,
+4 experts, capacity 2.5k it spends ~2.5x the expert FFN's FLOPs on routing
+alone and materialises [N, X, C] dispatch tensors (measured 3.4 s/step vs
+0.1 s dense on v5e); the scatter form is O(N·E) with the same static
+shapes, gradients, and all_to_all layout.  Experts' weights are sharded
+over 'ep'; tokens travel to their expert's device via `lax.all_to_all`.
 """
 
 from __future__ import annotations
@@ -42,19 +47,19 @@ def moe_ffn(
     gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]  # [N]
 
     capacity = int(max(1, (n_local * capacity_factor) // n_experts + 1))
-    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=x.dtype)  # [N, X]
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # position within expert
-    keep = (pos < capacity) & (onehot > 0)
-    pos_clamped = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
-    pos_onehot = jax.nn.one_hot(pos_clamped, capacity, dtype=x.dtype) * keep.astype(x.dtype)[
-        :, :, None
-    ]
-    # dispatch tensor [N, X, C]
-    dispatch = onehot[:, :, None] * pos_onehot
-    combine = dispatch * gate[:, None, None]
-
-    # route tokens: [X, C, E] -> all_to_all over experts' owner devices
-    expert_in = jnp.einsum("nxc,ne->xce", dispatch, x)
+    # position of each token within its expert's queue (cumulative count of
+    # same-expert tokens before it); int path — no [N, X, C] one-hots
+    onehot_i = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [N, X]
+    pos = jnp.take_along_axis(
+        jnp.cumsum(onehot_i, axis=0) - 1, expert_idx[:, None], axis=-1
+    )[:, 0]  # [N]
+    keep = pos < capacity
+    # flat slot: expert * capacity + position; dropped tokens go to the one
+    # overflow row (X*C) that both sides discard
+    slot = jnp.where(keep, expert_idx * capacity + pos, n_experts * capacity)
+    expert_in = jnp.zeros((n_experts * capacity + 1, e_model), x.dtype)
+    expert_in = expert_in.at[slot].set(x)  # unique slots: set, not add
+    expert_in = expert_in[: n_experts * capacity]
     expert_in = expert_in.reshape(ep, local_experts, capacity, e_model)
     # each device receives, for its local experts, the token slots from every
     # source device: [ep_src, local_experts, C, E]
@@ -71,11 +76,14 @@ def moe_ffn(
         1, 0, 2, 3
     )
     expert_out = lax.all_to_all(expert_out, axis_name, split_axis=0, concat_axis=0, tiled=False)
-    expert_out = expert_out.reshape(n_experts, capacity, e_model)
-    out = jnp.einsum("nxc,xce->ne", combine, expert_out)
+    expert_out = expert_out.reshape(n_experts * capacity, e_model)
+    # combine: gather each token's slot back and gate it; dropped tokens
+    # contribute zero (residual connection carries them unchanged upstream)
+    out = jnp.take(expert_out, jnp.minimum(slot, n_experts * capacity - 1), axis=0)
+    out = out * (gate * keep.astype(gate.dtype))[:, None]
 
     # load-balance aux loss: fraction routed * mean prob, summed over experts
-    frac = jnp.mean(onehot, axis=0)
+    frac = jnp.mean(onehot_i.astype(probs.dtype), axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = jnp.sum(frac * mean_prob) * n_experts
     return MoEOutput(out, aux)

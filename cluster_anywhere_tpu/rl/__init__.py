@@ -35,6 +35,7 @@ from .module import (
     QModule,
     RecurrentPolicyModule,
 )
+from .marwil import MARWILLearner, compute_returns, train_marwil
 from .offline import (
     BCLearner,
     CQLLearner,
@@ -68,6 +69,9 @@ __all__ = [
     "CoordinationGame",
     "RockPaperScissors",
     "BCLearner",
+    "MARWILLearner",
+    "train_marwil",
+    "compute_returns",
     "CQLLearner",
     "train_cql",
     "RolloutReader",

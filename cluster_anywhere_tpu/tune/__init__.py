@@ -27,6 +27,9 @@ from ..train.session import (
     make_temp_checkpoint_dir,
     report,
 )
+from .bohb import TuneBOHB
+from .hyperband import PAUSE, HyperBandForBOHB, HyperBandScheduler
+from .pb2 import PB2
 from .schedulers import (
     CONTINUE,
     STOP,
@@ -106,8 +109,13 @@ __all__ = [
     "FIFOScheduler",
     "AsyncHyperBandScheduler",
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "HyperBandForBOHB",
+    "TuneBOHB",
+    "PB2",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "CONTINUE",
     "STOP",
+    "PAUSE",
 ]

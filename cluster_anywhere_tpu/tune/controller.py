@@ -17,9 +17,18 @@ from typing import Any, Dict, List, Optional
 
 from ..core import api as ca
 from ..core.actor import kill
+from .hyperband import PAUSE
 from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
 from .search import BasicVariantGenerator, Searcher
-from .trial import ERRORED, PENDING, RUNNING, TERMINATED, Trial, TrialRunner
+from .trial import (
+    ERRORED,
+    PAUSED,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trial,
+    TrialRunner,
+)
 
 _STATE_FILE = "experiment_state.json"
 
@@ -59,8 +68,21 @@ class TuneController:
             num_samples=num_samples, seed=seed
         )
         self.searcher.set_search_properties(metric, mode, param_space)
+        # model-based searchers (TPE/BOHB/...) suggest forever; an explicit
+        # num_samples (> 1; the default 1 has always meant "unset" alongside
+        # a search_alg here — searchers bound themselves by returning None,
+        # or stop criteria end the run) is the experiment's trial budget for
+        # them.  Without this cap a forever-suggesting searcher plus a
+        # bracket scheduler creates trials unboundedly.
+        self._sample_cap = (
+            num_samples if search_alg is not None and num_samples > 1 else None
+        )
         self.scheduler = scheduler or FIFOScheduler()
         self.scheduler.set_properties(metric or "_", mode)
+        if hasattr(self.scheduler, "attach_searcher"):
+            # BOHB coupling: rung completions feed the searcher's
+            # per-budget model (hyperband.HyperBandForBOHB)
+            self.scheduler.attach_searcher(self.searcher)
         self.max_concurrent = max_concurrent_trials or max(
             1, int(ca.cluster_resources().get("CPU", 4))
         )
@@ -82,12 +104,27 @@ class TuneController:
         )
         last_state_write = 0.0
         while True:
+            self._drain_scheduler_queues()
             self._maybe_start_trials()
             running = [t for t in self.trials if t.status == RUNNING]
             if not running and (
                 self._searcher_exhausted
                 or not any(t.status == PENDING for t in self.trials)
             ):
+                if any(t.status == PAUSED for t in self.trials):
+                    # tell a sync scheduler no reinforcements are coming so
+                    # partial cohorts promote; if that frees work, loop on
+                    if hasattr(self.scheduler, "on_no_more_trials"):
+                        self.scheduler.on_no_more_trials()
+                        self._drain_scheduler_queues()
+                        if any(
+                            t.status in (PENDING, RUNNING) for t in self.trials
+                        ):
+                            continue
+                    # remaining paused trials can never resume: close them out
+                    for t in self.trials:
+                        if t.status == PAUSED:
+                            self._stop_trial(t, TERMINATED)
                 break
             self._poll_running(running)
             if deadline is not None and time.monotonic() > deadline:
@@ -105,6 +142,40 @@ class TuneController:
         return self.trials
 
     # ------------------------------------------------------------- lifecycle
+    def _drain_scheduler_queues(self):
+        """Sync-scheduler hooks (hyperband.py): resume promoted paused
+        trials from their checkpoints; terminate rung losers."""
+        if hasattr(self.scheduler, "trials_to_stop"):
+            for tid in self.scheduler.trials_to_stop():
+                t = next((x for x in self.trials if x.trial_id == tid), None)
+                if t is not None and t.status == PAUSED:
+                    self._stop_trial(t, TERMINATED)
+        if hasattr(self.scheduler, "trials_to_resume"):
+            for tid, _budget in self.scheduler.trials_to_resume():
+                t = next((x for x in self.trials if x.trial_id == tid), None)
+                if t is not None and t.status == PAUSED:
+                    # PENDING: _maybe_start_trials restarts it from
+                    # trial.latest_checkpoint_path under the concurrency cap
+                    t.status = PENDING
+
+    @staticmethod
+    def _release_actor(trial: Trial):
+        """Kill the trial's actor (if any) and clear the handle — the one
+        place actor-release semantics live."""
+        if trial.actor is not None:
+            try:
+                kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def _pause_trial(self, trial: Trial):
+        """Checkpointed barrier stop: release the actor, keep the trial
+        resumable (reference trial_runner PAUSED semantics)."""
+        self._release_actor(trial)
+        trial.status = PAUSED
+        self._cb("on_trial_pause", trial)
+
     def _maybe_start_trials(self):
         while True:
             running = sum(1 for t in self.trials if t.status == RUNNING)
@@ -115,6 +186,12 @@ class TuneController:
                 self._start_trial(pending)
                 continue
             if self._searcher_exhausted:
+                return
+            if (
+                self._sample_cap is not None
+                and self._trial_counter >= self._sample_cap
+            ):
+                self._searcher_exhausted = True
                 return
             trial_id = f"{self.experiment_name}_{self._trial_counter:05d}"
             cfg = self.searcher.suggest(trial_id)
@@ -155,12 +232,7 @@ class TuneController:
                 pass  # logging must never take down the experiment loop
 
     def _stop_trial(self, trial: Trial, status: str, error: Optional[str] = None):
-        if trial.actor is not None:
-            try:
-                kill(trial.actor)
-            except Exception:
-                pass
-            trial.actor = None
+        self._release_actor(trial)
         trial.status = status
         trial.error = error
         self.searcher.on_trial_complete(
@@ -193,10 +265,13 @@ class TuneController:
             decision = CONTINUE
             for rep in out["reports"]:
                 decision = self._on_report(trial, rep)
-                if decision == STOP:
+                if decision in (STOP, PAUSE):
                     break
             if decision == STOP:
                 self._stop_trial(trial, TERMINATED)
+                continue
+            if decision == PAUSE:
+                self._pause_trial(trial)
                 continue
             if out["done"]:
                 if out["error"]:
@@ -237,12 +312,7 @@ class TuneController:
 
     def _on_trial_error(self, trial: Trial, error: str):
         trial.num_failures += 1
-        if trial.actor is not None:
-            try:
-                kill(trial.actor)
-            except Exception:
-                pass
-            trial.actor = None
+        self._release_actor(trial)
         if self.max_failures < 0 or trial.num_failures <= self.max_failures:
             # retry from the latest checkpoint
             self._start_trial(trial)
@@ -257,12 +327,7 @@ class TuneController:
         decision = self.scheduler.choose_perturbation(trial, self.trials)
         if not decision:
             return
-        if trial.actor is not None:
-            try:
-                kill(trial.actor)
-            except Exception:
-                pass
-            trial.actor = None
+        self._release_actor(trial)
         trial.config = decision["config"]
         self._start_trial(trial, checkpoint_path=decision.get("checkpoint_path"))
 

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
+PAUSED = "PAUSED"  # checkpointed + resources released (sync HyperBand rungs)
 TERMINATED = "TERMINATED"
 ERRORED = "ERRORED"
 

@@ -1,0 +1,413 @@
+"""HyperBand / BOHB / PB2 schedulers+searchers, MARWIL, rpdb, Grafana
+factory (the r4 verdict's long-tail items; reference
+tune/schedulers/hyperband.py, hb_bohb.py, pb2.py, search/bohb/,
+rllib/algorithms/marwil, util/rpdb.py,
+dashboard/modules/metrics/grafana_dashboard_factory.py)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu import tune
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    ca.init(num_cpus=4)
+    yield
+    ca.shutdown()
+
+
+class _T:
+    """Minimal trial stand-in for scheduler unit tests."""
+
+    def __init__(self, tid, config=None):
+        self.trial_id = tid
+        self.config = config or {}
+        self.last_result = None
+        self.latest_checkpoint_path = None
+        self.last_perturb_t = 0
+
+
+# --------------------------------------------------------------- HyperBand
+
+
+def test_hyperband_bracket_arithmetic():
+    from cluster_anywhere_tpu.tune.hyperband import HyperBandScheduler
+
+    hb = HyperBandScheduler(max_t=9, reduction_factor=3)
+    hb.set_properties("score", "max")
+    # s_max = 2: n0 = ceil((s_max+1)/(s+1) * eta^s) -> (9,1), (5,3), (3,9)
+    assert [b["n0"] for b in hb.brackets] == [9, 5, 3]
+    assert [b["rungs"][0]["budget"] for b in hb.brackets] == [1, 3, 9]
+    assert [len(b["rungs"]) for b in hb.brackets] == [3, 2, 1]
+
+
+def test_hyperband_sync_promotion():
+    from cluster_anywhere_tpu.tune.hyperband import PAUSE, HyperBandScheduler
+    from cluster_anywhere_tpu.tune.schedulers import CONTINUE, STOP
+
+    hb = HyperBandScheduler(max_t=9, reduction_factor=3)
+    hb.set_properties("score", "max")
+    trials = [_T(f"t{i}") for i in range(9)]  # fills bracket 0 (n0=9, r0=1)
+    # below the rung budget: CONTINUE
+    assert hb.on_trial_result(trials[0], {"training_iteration": 0, "score": 0}) == CONTINUE
+    # 8 of 9 report at the rung: all PAUSE, no promotion yet (sync barrier)
+    for i in range(8):
+        d = hb.on_trial_result(trials[i], {"training_iteration": 1, "score": i})
+        assert d == PAUSE
+    assert hb.trials_to_resume() == []
+    # the 9th completes the cohort: top 1/3 promoted
+    assert hb.on_trial_result(trials[8], {"training_iteration": 1, "score": 8}) == PAUSE
+    resumed = hb.trials_to_resume()
+    assert sorted(tid for tid, _ in resumed) == ["t6", "t7", "t8"]
+    assert all(budget == 3 for _, budget in resumed)
+    # final rung: STOP
+    for tid in ("t6", "t7"):
+        t = next(tr for tr in trials if tr.trial_id == tid)
+        assert hb.on_trial_result(t, {"training_iteration": 3, "score": 1}) == PAUSE
+    t8 = trials[8]
+    assert hb.on_trial_result(t8, {"training_iteration": 3, "score": 9}) == PAUSE
+    (tid, budget), = hb.trials_to_resume()
+    assert tid == "t8" and budget == 9
+    assert hb.on_trial_result(t8, {"training_iteration": 9, "score": 10}) == STOP
+
+
+def test_hyperband_errored_trial_unblocks_cohort():
+    from cluster_anywhere_tpu.tune.hyperband import HyperBandScheduler
+
+    hb = HyperBandScheduler(max_t=9, reduction_factor=3)
+    hb.set_properties("score", "max")
+    trials = [_T(f"t{i}") for i in range(9)]
+    for i in range(8):
+        hb.on_trial_result(trials[i], {"training_iteration": 1, "score": i})
+    # the 9th dies before reporting: cohort must still promote
+    hb._place(trials[8])
+    hb.on_trial_complete(trials[8], None)
+    assert len(hb.trials_to_resume()) == 3
+
+
+def test_hyperband_e2e_with_controller(tmp_path):
+    """Full tuner run: sync HyperBand pauses trials at rungs and resumes the
+    promoted ones from their checkpoints."""
+
+    def trainable(config):
+        w = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                w = float(open(os.path.join(d, "w.txt")).read())
+        step = int(round(w / max(config["lr"], 1e-9)))
+        while step < 9:
+            step += 1
+            w += config["lr"]
+            d = tune.make_temp_checkpoint_dir()
+            with open(os.path.join(d, "w.txt"), "w") as f:
+                f.write(str(w))
+            tune.report(
+                {"w": w, "training_iteration": step},
+                checkpoint=tune.Checkpoint(d),
+            )
+
+    from cluster_anywhere_tpu.tune.hyperband import HyperBandScheduler
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.uniform(0.01, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="w", mode="max", scheduler=sched, num_samples=9,
+            max_concurrent_trials=3,
+        ),
+        run_config=tune.RunConfig(
+            name="hb_e2e", storage_path=str(tmp_path), verbose=0
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.metrics["w"] > 0
+    # the best trial must have been promoted through the full ladder
+    assert best.metrics["training_iteration"] == 9
+    # and at least one trial was stopped early by the bracket (not all 9
+    # ran the full budget)
+    iters = [r.metrics.get("training_iteration", 0) for r in results]
+    assert min(iters) < 9
+
+
+# -------------------------------------------------------------------- BOHB
+
+
+def test_bohb_models_good_region():
+    from cluster_anywhere_tpu.tune.bohb import TuneBOHB
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    s = TuneBOHB(space, seed=7, random_fraction=0.0, num_candidates=32)
+    s.set_search_properties("score", "max", space)
+    rng = np.random.default_rng(0)
+    # optimum at x=0.8: feed observations at one budget
+    for _ in range(30):
+        x = float(rng.random())
+        s.on_rung_result(3, {"x": x}, -((x - 0.8) ** 2))
+    sugg = [s.suggest(f"t{i}")["x"] for i in range(20)]
+    # model-based suggestions concentrate near the optimum
+    assert abs(np.median(sugg) - 0.8) < 0.2, sugg
+
+
+def test_bohb_with_hyperband_coupling():
+    from cluster_anywhere_tpu.tune.bohb import TuneBOHB
+    from cluster_anywhere_tpu.tune.hyperband import HyperBandForBOHB
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    s = TuneBOHB(space, seed=1)
+    hb = HyperBandForBOHB(max_t=9, reduction_factor=3, searcher=s)
+    hb.set_properties("score", "max")
+    s.set_search_properties("score", "max", space)
+    trials = [_T(f"t{i}", {"x": i / 9}) for i in range(9)]
+    for t in trials:
+        hb.on_trial_result(
+            t, {"training_iteration": 1, "score": -(t.config["x"] - 0.5) ** 2}
+        )
+    # every rung completion fed the searcher's budget-1 model
+    assert len(s.obs.get(1, [])) == 9
+
+
+# --------------------------------------------------------------------- PB2
+
+
+def test_pb2_gp_learns_direction():
+    from cluster_anywhere_tpu.tune.pb2 import _TinyGP
+
+    rng = np.random.default_rng(0)
+    X = rng.random((24, 2))
+    y = 3.0 * X[:, 0] - 1.0 * X[:, 1]
+    gp = _TinyGP()
+    gp.fit(X, y)
+    mu, sd = gp.predict(np.array([[0.9, 0.1], [0.1, 0.9]]))
+    assert mu[0] > mu[1]  # the GP learned the slope
+    assert (sd >= 0).all()
+
+
+def test_pb2_perturbs_within_bounds():
+    from cluster_anywhere_tpu.tune.pb2 import PB2
+
+    sched = PB2(
+        perturbation_interval=1,
+        hyperparam_bounds={"lr": (0.001, 1.0)},
+        seed=0,
+    )
+    sched.set_properties("score", "max")
+    good, bad = _T("good", {"lr": 0.5}), _T("bad", {"lr": 0.002})
+    good.latest_checkpoint_path = "ckpt-good"
+    for step in range(1, 6):
+        for t, base in ((good, 1.0), (bad, 0.01)):
+            t.last_result = {"score": base * step, "training_iteration": step}
+            sched.on_trial_result(t, t.last_result)
+    bad.ready_to_perturb = True
+    decision = sched.choose_perturbation(bad, [good, bad])
+    assert decision is not None
+    assert decision["checkpoint_path"] == "ckpt-good"
+    assert 0.001 <= decision["config"]["lr"] <= 1.0
+
+
+# ------------------------------------------------------------------ MARWIL
+
+
+def test_marwil_beats_bc_on_mixed_quality_data(tmp_path):
+    """Logged data: half the actions are good (reward 1), half bad (0).
+    BC imitates the 50/50 logging policy; MARWIL's exp(beta*A) weighting
+    must concentrate on the rewarded action."""
+    import jax
+    import jax.numpy as jnp
+
+    from cluster_anywhere_tpu.rl.marwil import train_marwil
+    from cluster_anywhere_tpu.rl.offline import RolloutWriter, train_bc
+
+    rng = np.random.default_rng(0)
+    n = 1024
+    obs = rng.normal(size=(n, 4)).astype(np.float32)
+    actions = rng.integers(0, 2, size=n).astype(np.int32)
+    rewards = (actions == 1).astype(np.float32)
+    dones = np.ones(n, dtype=np.float32)  # 1-step episodes
+    path = str(tmp_path / "rollouts")
+    RolloutWriter(path).write(
+        {"obs": obs, "actions": actions, "rewards": rewards, "dones": dones}
+    )
+
+    marwil = train_marwil(path, 4, 2, beta=2.0, num_updates=300, seed=0)
+    bc = train_bc(path, 4, 2, num_updates=300, seed=0)
+
+    test_obs = jnp.asarray(rng.normal(size=(256, 4)).astype(np.float32))
+
+    def p_good(learner):
+        logits = learner.module.logits(learner.params, test_obs)
+        return float(jax.nn.softmax(logits, axis=-1)[:, 1].mean())
+
+    assert p_good(bc) == pytest.approx(0.5, abs=0.15)  # BC copies the logger
+    assert p_good(marwil) > 0.8, p_good(marwil)  # MARWIL prefers reward
+
+
+def test_marwil_compute_returns_interleaved():
+    from cluster_anywhere_tpu.rl.marwil import compute_returns
+
+    # two envs, T=3, flattened T-major like record_rollouts:
+    # row = t*N + n -> env0 stream r=[1,0,1] d=[0,0,1];
+    #                  env1 stream r=[10,0,10] d=[0,1,1]
+    r = np.array([1, 10, 0, 0, 1, 10], dtype=np.float32)
+    d = np.array([0, 0, 0, 1, 1, 1], dtype=np.float32)
+    out = compute_returns(r, d, gamma=0.5, n_envs=2)
+    # env0: t2 (done) = 1; t1 = 0 + .5*1 = 0.5; t0 = 1 + .5*0.5 = 1.25
+    np.testing.assert_allclose(out.reshape(3, 2)[:, 0], [1.25, 0.5, 1.0])
+    # env1: t2 (done) = 10; t1 (done) = 0; t0 = 10 + .5*0 = 10
+    np.testing.assert_allclose(out.reshape(3, 2)[:, 1], [10.0, 0.0, 10.0])
+    # a naive interleaved pass would have mixed env streams: prove it differs
+    naive = compute_returns(r, d, gamma=0.5, n_envs=1)
+    assert not np.allclose(naive, out)
+
+
+# ----------------------------------------------------------------- Grafana
+
+
+def test_grafana_factory_shapes(tmp_path):
+    from cluster_anywhere_tpu.util.grafana import (
+        dashboard_from_snapshot,
+        generate_default_dashboard,
+        write_grafana_dashboards,
+    )
+
+    dash = generate_default_dashboard()
+    assert dash["panels"] and dash["schemaVersion"] >= 30
+    assert any(
+        "ca_trace_submit_latency_seconds" in t["expr"]
+        for p in dash["panels"] for t in p["targets"]
+    )
+    snap = {
+        "my_counter": {"type": "counter", "desc": "c"},
+        "my_gauge": {"type": "gauge"},
+        "my_hist": {"type": "histogram"},
+    }
+    auto = dashboard_from_snapshot(snap)
+    assert len(auto["panels"]) == 3
+    hist_panel = next(p for p in auto["panels"] if p["title"] == "my_hist")
+    assert "histogram_quantile" in hist_panel["targets"][0]["expr"]
+
+    paths = write_grafana_dashboards(str(tmp_path), snapshot=snap)
+    assert len(paths) == 3
+    for p in paths:
+        assert os.path.exists(p)
+        if p.endswith(".json"):
+            json.load(open(p))  # valid JSON round-trip
+
+
+# -------------------------------------------------------------------- rpdb
+
+
+def test_rpdb_breakpoint_attach_e2e():
+    """A task hits ca.util.rpdb.set_trace(); the driver lists the breakpoint
+    via the KV registry, attaches over TCP, inspects a variable, continues,
+    and the task completes."""
+    import socket as _socket
+
+    from cluster_anywhere_tpu.core.worker import global_worker
+    from cluster_anywhere_tpu.util import rpdb
+
+    @ca.remote
+    def buggy(x):
+        secret = x * 7
+        from cluster_anywhere_tpu.util.rpdb import set_trace
+
+        set_trace(timeout=30)
+        return secret
+
+    ref = buggy.remote(6)
+    w = global_worker()
+    deadline = time.monotonic() + 20
+    bps = []
+    while time.monotonic() < deadline:
+        bps = rpdb.list_breakpoints(w)
+        if bps:
+            break
+        time.sleep(0.2)
+    assert bps, "breakpoint never registered"
+    bp = bps[-1]
+    sock = _socket.create_connection(("127.0.0.1", bp["port"]), timeout=10)
+    f = sock.makefile("rw", encoding="utf-8", newline="\n")
+    # wait for the prompt, inspect, continue
+    buf = ""
+    deadline = time.monotonic() + 10
+    sock.settimeout(2)
+    f.write("p secret\nc\n")
+    f.flush()
+    try:
+        while time.monotonic() < deadline:
+            try:
+                data = sock.recv(4096)
+            except (TimeoutError, OSError):
+                break
+            if not data:
+                break
+            buf += data.decode(errors="replace")
+            if "42" in buf:
+                break
+    finally:
+        sock.close()
+    assert "42" in buf, buf
+    assert ca.get(ref, timeout=30) == 42
+    assert rpdb.list_breakpoints(w) == []  # deregistered
+
+
+def test_rpdb_timeout_does_not_wedge():
+    @ca.remote
+    def brief():
+        from cluster_anywhere_tpu.util.rpdb import set_trace
+
+        set_trace(timeout=0.5)
+        return "survived"
+
+    assert ca.get(brief.remote(), timeout=30) == "survived"
+
+
+def test_rpdb_post_mortem_timeout_returns():
+    """post_mortem with no attached debugger times out and lets the error
+    propagate normally (a forgotten CA_POST_MORTEM=1 must not wedge)."""
+
+    @ca.remote
+    def fails():
+        from cluster_anywhere_tpu.util.rpdb import post_mortem
+
+        try:
+            raise ValueError("inspect me")
+        except ValueError as e:
+            post_mortem(e, timeout=0.5)
+            raise
+
+    with pytest.raises(Exception, match="inspect me"):
+        ca.get(fails.remote(), timeout=30)
+
+
+def test_model_searcher_respects_num_samples(tmp_path):
+    """Model-based searchers suggest forever; num_samples must cap the
+    experiment's trial count (regression: TuneBOHB + HyperBand once spawned
+    trials unboundedly)."""
+
+    def trainable(config):
+        tune.report({"score": config["x"], "training_iteration": 1})
+
+    space = {"x": tune.uniform(0, 1)}
+    tuner = tune.Tuner(
+        trainable,
+        param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            search_alg=tune.TuneBOHB(space, seed=0),
+            num_samples=5, max_concurrent_trials=2,
+        ),
+        run_config=tune.RunConfig(name="cap", storage_path=str(tmp_path), verbose=0),
+    )
+    results = tuner.fit()
+    assert len(list(results)) == 5
