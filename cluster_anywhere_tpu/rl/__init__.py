@@ -35,6 +35,12 @@ from .module import (
     QModule,
     RecurrentPolicyModule,
 )
+from .dreamer import (
+    DreamerConfig,
+    DreamerV3Learner,
+    evaluate_dreamer,
+    train_dreamer,
+)
 from .marwil import MARWILLearner, compute_returns, train_marwil
 from .offline import (
     BCLearner,
@@ -69,6 +75,10 @@ __all__ = [
     "CoordinationGame",
     "RockPaperScissors",
     "BCLearner",
+    "DreamerConfig",
+    "DreamerV3Learner",
+    "train_dreamer",
+    "evaluate_dreamer",
     "MARWILLearner",
     "train_marwil",
     "compute_returns",

@@ -1,0 +1,618 @@
+"""DreamerV3 (compact): model-based RL via a recurrent state-space world
+model and an actor-critic trained purely in imagination.
+
+Reference parity: ``rllib/algorithms/dreamerv3`` (the reference's port of
+Hafner et al., 2023).  This is an independent jax implementation of the
+algorithm's core, sized for vector-observation control tasks:
+
+- **RSSM**: deterministic GRU path + categorical stochastic latents
+  (groups x classes, straight-through gradients, 1% unimix), prior from
+  h_t, posterior from [h_t, enc(o_t)].
+- **Heads**: decoder (symlog MSE), reward (twohot over symlog bins),
+  continue (Bernoulli).
+- **World-model loss**: prediction terms + KL balancing (dyn/rep scales
+  with free bits).
+- **Imagination actor-critic**: H-step latent rollouts from posterior
+  starts; lambda-returns; critic twohot regression with an EMA target
+  network; actor REINFORCE with returns normalized by an EMA of the
+  5th-95th percentile range (the V3 robustness trick) + entropy bonus.
+
+Everything jits end-to-end: the world-model update, the imagination
+update, and the per-step act() are three compiled functions with static
+shapes (scan over sequence/horizon).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional
+
+import numpy as np
+
+
+def _nets():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+# ------------------------------------------------------------------ symlog
+
+
+def symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def twohot(x, bins):
+    """Two-hot encode scalar x over a 1-D bin grid (piecewise-linear)."""
+    import jax.numpy as jnp
+
+    x = jnp.clip(x, bins[0], bins[-1])
+    idx = jnp.sum((bins[None, :] <= x[..., None]).astype(jnp.int32), axis=-1) - 1
+    idx = jnp.clip(idx, 0, len(bins) - 2)
+    lo, hi = bins[idx], bins[idx + 1]
+    w_hi = (x - lo) / jnp.maximum(hi - lo, 1e-8)
+    onehot_lo = jax_nn_one_hot(idx, len(bins))
+    onehot_hi = jax_nn_one_hot(idx + 1, len(bins))
+    return onehot_lo * (1 - w_hi)[..., None] + onehot_hi * w_hi[..., None]
+
+
+def jax_nn_one_hot(idx, n):
+    import jax
+
+    return jax.nn.one_hot(idx, n)
+
+
+class DreamerConfig(NamedTuple):
+    obs_dim: int = 4
+    num_actions: int = 2
+    hidden: int = 128
+    deter: int = 128
+    groups: int = 8
+    classes: int = 8
+    num_bins: int = 41
+    horizon: int = 10
+    seq_len: int = 16
+    batch_size: int = 16
+    wm_lr: float = 3e-4
+    ac_lr: float = 1e-4
+    gamma: float = 0.985
+    lam: float = 0.95
+    entropy: float = 3e-3
+    kl_dyn: float = 0.5
+    kl_rep: float = 0.1
+    free_bits: float = 1.0
+    unimix: float = 0.01
+    critic_ema: float = 0.02
+    retnorm_decay: float = 0.99
+
+
+def _mlp_params(key, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    params = []
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        params.append(
+            {
+                "w": jax.random.normal(k, (m, n), jnp.float32) * (2.0 / m) ** 0.5,
+                "b": jnp.zeros((n,), jnp.float32),
+            }
+        )
+    return params
+
+
+def _mlp(params, x, act_last=False):
+    import jax
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or act_last:
+            x = jax.nn.silu(x)
+    return x
+
+
+def _gru_params(key, in_dim, hidden):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(key)
+    s = (2.0 / (in_dim + hidden)) ** 0.5
+    return {
+        "wi": jax.random.normal(k1, (in_dim, 3 * hidden), jnp.float32) * s,
+        "wh": jax.random.normal(k2, (hidden, 3 * hidden), jnp.float32) * s,
+        "b": jnp.zeros((3 * hidden,), jnp.float32),
+    }
+
+
+def _gru(p, h, x):
+    import jax
+    import jax.numpy as jnp
+
+    gates = x @ p["wi"] + h @ p["wh"] + p["b"]
+    r, z, n = jnp.split(gates, 3, axis=-1)
+    r, z = jax.nn.sigmoid(r), jax.nn.sigmoid(z)
+    n = jnp.tanh(r * n)
+    return (1 - z) * n + z * h
+
+
+class DreamerV3Learner:
+    """World model + imagination actor-critic with jitted updates."""
+
+    def __init__(self, cfg: DreamerConfig, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cfg = cfg
+        self.jax, self.jnp = jax, jnp
+        stoch = cfg.groups * cfg.classes
+        feat = cfg.deter + stoch
+        key = jax.random.key(seed)
+        ks = jax.random.split(key, 12)
+        self.params = {
+            "enc": _mlp_params(ks[0], [cfg.obs_dim, cfg.hidden, cfg.hidden]),
+            "gru": _gru_params(ks[1], stoch + cfg.num_actions, cfg.deter),
+            "prior": _mlp_params(ks[2], [cfg.deter, cfg.hidden, stoch]),
+            "post": _mlp_params(ks[3], [cfg.deter + cfg.hidden, cfg.hidden, stoch]),
+            "dec": _mlp_params(ks[4], [feat, cfg.hidden, cfg.obs_dim]),
+            "rew": _mlp_params(ks[5], [feat, cfg.hidden, cfg.num_bins]),
+            "cont": _mlp_params(ks[6], [feat, cfg.hidden, 1]),
+        }
+        self.ac_params = {
+            "actor": _mlp_params(ks[7], [feat, cfg.hidden, cfg.num_actions]),
+            "critic": _mlp_params(ks[8], [feat, cfg.hidden, cfg.num_bins]),
+        }
+        self.target_critic = jax.tree.map(lambda x: x, self.ac_params["critic"])
+        self.bins = jnp.linspace(-10.0, 10.0, cfg.num_bins)  # symlog space
+        self.wm_opt = optax.chain(
+            optax.clip_by_global_norm(100.0), optax.adam(cfg.wm_lr)
+        )
+        self.ac_opt = optax.chain(
+            optax.clip_by_global_norm(100.0), optax.adam(cfg.ac_lr)
+        )
+        self.wm_state = self.wm_opt.init(self.params)
+        self.ac_state = self.ac_opt.init(self.ac_params)
+        self.ret_range = jnp.asarray(1.0)  # EMA of return 5-95 percentile
+        self._build()
+
+    # ------------------------------------------------------------ primitives
+
+    def _sample_latent(self, key, logits):
+        """Straight-through categorical sample per group with unimix."""
+        jax, jnp = self.jax, self.jnp
+        cfg = self.cfg
+        logits = logits.reshape(logits.shape[:-1] + (cfg.groups, cfg.classes))
+        probs = jax.nn.softmax(logits, -1)
+        probs = (1 - cfg.unimix) * probs + cfg.unimix / cfg.classes
+        logp = jnp.log(probs)
+        idx = jax.random.categorical(key, logp)
+        onehot = jax.nn.one_hot(idx, cfg.classes)
+        sample = onehot + probs - jax.lax.stop_gradient(probs)  # straight-through
+        return sample.reshape(sample.shape[:-2] + (-1,)), logp
+
+    def _head_scalar(self, logits):
+        """Expected value of a twohot head, decoded from symlog space."""
+        jax, jnp = self.jax, self.jnp
+        probs = jax.nn.softmax(logits, -1)
+        return symexp(jnp.sum(probs * self.bins, -1))
+
+    def _twohot_nll(self, logits, target_scalar):
+        jax, jnp = self.jax, self.jnp
+        target = twohot(symlog(target_scalar), self.bins)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.sum(target * logp, -1)
+
+    # ---------------------------------------------------------------- build
+
+    def _build(self):
+        jax, jnp = self.jax, self.jnp
+        cfg = self.cfg
+        stoch = cfg.groups * cfg.classes
+
+        def obs_step(params, key, h, z_prev, a_prev_onehot, obs):
+            """One posterior RSSM step."""
+            h = _gru(params["gru"], h, jnp.concatenate([z_prev, a_prev_onehot], -1))
+            e = _mlp(params["enc"], obs, act_last=True)
+            post_logits = _mlp(params["post"], jnp.concatenate([h, e], -1))
+            prior_logits = _mlp(params["prior"], h)
+            z, _ = self._sample_latent(key, post_logits)
+            return h, z, post_logits, prior_logits
+
+        def wm_loss(params, key, batch):
+            """batch: obs [B,L,O], actions [B,L] int, rewards [B,L],
+            cont [B,L] (1 - done)."""
+            B, L = batch["actions"].shape
+            a_onehot = jax.nn.one_hot(batch["actions"], cfg.num_actions)
+            keys = jax.random.split(key, L)
+
+            is_first = batch["is_first"]
+
+            def step(carry, t):
+                h, z = carry
+                # episode boundary inside the segment: reset the recurrent
+                # state and the previous action (stream replay — segments
+                # span episodes, the canonical Dreamer data pipeline)
+                keep = (1.0 - is_first[:, t])[:, None]
+                h = h * keep
+                z = z * keep
+                a_prev = jnp.where(
+                    t == 0, jnp.zeros_like(a_onehot[:, 0]), a_onehot[:, t - 1]
+                ) * keep
+                h, z, post_l, prior_l = obs_step(
+                    params, keys[t], h, z, a_prev, batch["obs"][:, t]
+                )
+                return (h, z), (h, z, post_l, prior_l)
+
+            h0 = jnp.zeros((B, cfg.deter))
+            z0 = jnp.zeros((B, stoch))
+            (_, _), (hs, zs, post_l, prior_l) = jax.lax.scan(
+                step, (h0, z0), jnp.arange(L)
+            )
+            # scan stacks time-major: [L, B, ...] -> [B, L, ...]
+            hs, zs = hs.transpose(1, 0, 2), zs.transpose(1, 0, 2)
+            post_l = post_l.transpose(1, 0, 2)
+            prior_l = prior_l.transpose(1, 0, 2)
+            feat = jnp.concatenate([hs, zs], -1)
+
+            recon = _mlp(params["dec"], feat)
+            loss_obs = jnp.mean(jnp.sum((recon - symlog(batch["obs"])) ** 2, -1))
+            loss_rew = jnp.mean(
+                self._twohot_nll(_mlp(params["rew"], feat), batch["rewards"])
+            )
+            cont_logit = _mlp(params["cont"], feat)[..., 0]
+            loss_cont = jnp.mean(
+                optax_sigmoid_ce(cont_logit, batch["cont"])
+            )
+
+            def cat_kl(lp, lq):
+                """KL(p || q) per group, summed over groups; unimix'd."""
+                shape = lp.shape[:-1] + (cfg.groups, cfg.classes)
+                p = jax.nn.softmax(lp.reshape(shape), -1)
+                p = (1 - cfg.unimix) * p + cfg.unimix / cfg.classes
+                q = jax.nn.softmax(lq.reshape(shape), -1)
+                q = (1 - cfg.unimix) * q + cfg.unimix / cfg.classes
+                return jnp.sum(p * (jnp.log(p) - jnp.log(q)), (-2, -1))
+
+            sg = jax.lax.stop_gradient
+            kl_dyn = jnp.maximum(
+                jnp.mean(cat_kl(sg(post_l), prior_l)), cfg.free_bits
+            )
+            kl_rep = jnp.maximum(
+                jnp.mean(cat_kl(post_l, sg(prior_l))), cfg.free_bits
+            )
+            loss = (
+                loss_obs
+                + loss_rew
+                + loss_cont
+                + cfg.kl_dyn * kl_dyn
+                + cfg.kl_rep * kl_rep
+            )
+            aux = {
+                "wm_loss": loss,
+                "obs_loss": loss_obs,
+                "rew_loss": loss_rew,
+                "kl_dyn": kl_dyn,
+                "feat": feat,
+            }
+            return loss, aux
+
+        import optax
+
+        def optax_sigmoid_ce(logits, labels):
+            return optax.sigmoid_binary_cross_entropy(logits, labels)
+
+        def wm_update(params, opt_state, key, batch):
+            (loss, aux), grads = jax.value_and_grad(wm_loss, has_aux=True)(
+                params, key, batch
+            )
+            updates, opt_state = self.wm_opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, aux
+
+        def imagine(wm_params, ac_params, key, feat0):
+            """Roll the actor H steps through the PRIOR dynamics."""
+            N = feat0.shape[0]
+            h0 = feat0[:, : cfg.deter]
+            z0 = feat0[:, cfg.deter :]
+            keys = jax.random.split(key, cfg.horizon)
+
+            def step(carry, k):
+                h, z = carry
+                ka, kz = jax.random.split(k)
+                logits = _mlp(ac_params["actor"], jnp.concatenate([h, z], -1))
+                a = jax.random.categorical(ka, logits)
+                a_onehot = jax.nn.one_hot(a, cfg.num_actions)
+                h = _gru(wm_params["gru"], h, jnp.concatenate([z, a_onehot], -1))
+                prior_logits = _mlp(wm_params["prior"], h)
+                z, _ = self._sample_latent(kz, prior_logits)
+                return (h, z), (jnp.concatenate([h, z], -1), a)
+
+            (_, _), (feats, acts) = jax.lax.scan(step, (h0, z0), keys)
+            return feats, acts  # [H, N, F], [H, N]
+
+        def ac_loss(ac_params, wm_params, target_critic, ret_range, key, feat0):
+            sg = jax.lax.stop_gradient
+            feats_post, acts = imagine(wm_params, ac_params, key, feat0)
+            # state indexing: feat0 = s_0 (where a_0 is chosen);
+            # feats_post[t] = s_{t+1} (reached by a_t).  Rewards/continues
+            # belong to the arrived-at states s_1..s_H; action log-probs and
+            # advantages to the pre-action states s_0..s_{H-1}.
+            feats_pre = sg(
+                jnp.concatenate([feat0[None], feats_post[:-1]], 0)
+            )  # [H, N, F] = s_0..s_{H-1}
+            feats_post = sg(feats_post)
+            rew = self._head_scalar(_mlp(wm_params["rew"], feats_post))
+            cont = jax.nn.sigmoid(_mlp(wm_params["cont"], feats_post)[..., 0])
+            disc = cfg.gamma * cont
+            v_post = self._head_scalar(_mlp(target_critic, feats_post))
+
+            # lambda-returns at s_0..s_{H-1}:
+            #   R_t = r_{t+1} + gamma c_{t+1} ((1-lam) V(s_{t+1}) + lam R_{t+1})
+            def ret_step(nxt, t):
+                r = rew[t] + disc[t] * ((1 - cfg.lam) * v_post[t] + cfg.lam * nxt)
+                return r, r
+
+            _, rets = jax.lax.scan(
+                ret_step, v_post[-1], jnp.arange(cfg.horizon - 1, -1, -1)
+            )
+            rets = rets[::-1]  # [H, N]: rets[t] = R at s_t
+
+            # percentile return scale (EMA outside)
+            lo = jnp.percentile(rets, 5)
+            hi = jnp.percentile(rets, 95)
+            new_range = jnp.maximum(hi - lo, 1.0)
+
+            critic_logits = _mlp(ac_params["critic"], feats_pre)
+            critic_loss = jnp.mean(self._twohot_nll(critic_logits, sg(rets)))
+
+            actor_logits = _mlp(ac_params["actor"], feats_pre)
+            logp = jax.nn.log_softmax(actor_logits, -1)
+            act_logp = jnp.take_along_axis(logp, acts[..., None], -1)[..., 0]
+            v_pre = self._head_scalar(critic_logits)
+            adv = sg((rets - v_pre) / jnp.maximum(ret_range, 1.0))
+            entropy = -jnp.sum(jnp.exp(logp) * logp, -1)
+            # weight[t] = probability the imagined trajectory is still alive
+            # AT s_t (products of continues up to s_t); s_0 is alive
+            weight = sg(jnp.cumprod(
+                jnp.concatenate([jnp.ones_like(disc[:1]), disc[:-1]], 0), 0
+            ))
+            actor_loss = -jnp.mean(
+                weight * (act_logp * adv + cfg.entropy * entropy)
+            )
+            total = actor_loss + critic_loss
+            return total, (new_range, jnp.mean(rets), jnp.mean(entropy))
+
+        self._wm_update = jax.jit(wm_update)
+
+        def _ac_impl(wm_params, ac_params, opt_state, target_critic, ret_range,
+                     key, feat0):
+            (loss, (new_range, ret_mean, ent)), grads = jax.value_and_grad(
+                ac_loss, has_aux=True
+            )(ac_params, wm_params, target_critic, ret_range, key, feat0)
+            import optax as _optax
+
+            updates, opt_state = self.ac_opt.update(grads, opt_state, ac_params)
+            ac_params = _optax.apply_updates(ac_params, updates)
+            target_critic = jax.tree.map(
+                lambda t, o: (1 - cfg.critic_ema) * t + cfg.critic_ema * o,
+                target_critic,
+                ac_params["critic"],
+            )
+            ret_range = (
+                cfg.retnorm_decay * ret_range + (1 - cfg.retnorm_decay) * new_range
+            )
+            return ac_params, opt_state, target_critic, ret_range, (loss, ret_mean, ent)
+
+        self._ac_update = jax.jit(_ac_impl)
+
+        def act_fn(wm_params, ac_params, key, h, z, a_prev_onehot, obs, greedy):
+            k_latent, k_act = jax.random.split(key)
+            h, z, _, _ = obs_step(wm_params, k_latent, h, z, a_prev_onehot, obs)
+            logits = _mlp(ac_params["actor"], jnp.concatenate([h, z], -1))
+            a_sample = jax.random.categorical(k_act, logits)
+            a_greedy = jnp.argmax(logits, -1)
+            a = jnp.where(greedy, a_greedy, a_sample)
+            return h, z, a
+
+        self._act = jax.jit(act_fn)
+
+    # ------------------------------------------------------------------- api
+
+    def init_state(self, batch: int = 1):
+        jnp = self.jnp
+        cfg = self.cfg
+        return (
+            jnp.zeros((batch, cfg.deter)),
+            jnp.zeros((batch, cfg.groups * cfg.classes)),
+            jnp.zeros((batch, cfg.num_actions)),
+        )
+
+    def act(self, key, state, obs, greedy=False):
+        h, z, a_prev = state
+        jnp = self.jnp
+        obs = jnp.asarray(obs, jnp.float32)[None]
+        h, z, a = self._act(
+            self.params, self.ac_params, key, h, z, a_prev, obs, greedy
+        )
+        a_onehot = self.jax.nn.one_hot(a, self.cfg.num_actions)
+        return (h, z, a_onehot), int(a[0])
+
+    def update(self, key, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jax, jnp = self.jax, self.jnp
+        k1, k2 = jax.random.split(key)
+        jb = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "cont": jnp.asarray(batch["cont"], jnp.float32),
+            "is_first": jnp.asarray(batch["is_first"], jnp.float32),
+        }
+        self.params, self.wm_state, aux = self._wm_update(
+            self.params, self.wm_state, k1, jb
+        )
+        feat = aux.pop("feat").reshape(-1, self.cfg.deter + self.cfg.groups * self.cfg.classes)
+        (
+            self.ac_params,
+            self.ac_state,
+            self.target_critic,
+            self.ret_range,
+            (ac_l, ret_mean, ent),
+        ) = self._ac_update(
+            self.params, self.ac_params, self.ac_state, self.target_critic,
+            self.ret_range, k2, feat,
+        )
+        out = {k: float(v) for k, v in aux.items()}
+        out.update(ac_loss=float(ac_l), ret_mean=float(ret_mean), entropy=float(ent))
+        return out
+
+
+class _SeqReplay:
+    """Stream replay: episodes concatenate into one step stream with
+    is_first flags; any L-window is sampleable (segments span episode
+    boundaries, which wm_loss handles by resetting the RSSM state).  A
+    per-episode sampler silently excludes episodes shorter than L — a
+    degrading policy then stops contributing data at all, making collapse
+    an absorbing state (observed)."""
+
+    def __init__(self, seq_len: int, capacity: int = 200_000, seed: int = 0):
+        self.seq_len = seq_len
+        self.capacity = capacity
+        self.rng = np.random.default_rng(seed)
+        self.obs: list = []
+        self.actions: list = []
+        self.rewards: list = []
+        self.cont: list = []
+        self.is_first: list = []
+
+    def add_episode(self, obs, actions, rewards, dones):
+        n = len(actions)
+        self.obs.extend(np.asarray(o, np.float32) for o in obs)
+        self.actions.extend(int(a) for a in actions)
+        self.rewards.extend(float(r) for r in rewards)
+        self.cont.extend(1.0 - float(d) for d in dones)
+        self.is_first.extend([1.0] + [0.0] * (n - 1))
+        if len(self.actions) > self.capacity:
+            cut = len(self.actions) - self.capacity
+            for lst in (self.obs, self.actions, self.rewards, self.cont,
+                        self.is_first):
+                del lst[:cut]
+            if self.is_first:
+                self.is_first[0] = 1.0  # truncated head starts a segment
+
+    @property
+    def num_steps(self):
+        return len(self.actions)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        L = self.seq_len
+        if len(self.actions) < L:
+            raise ValueError("replay shorter than one segment")
+        out = {"obs": [], "actions": [], "rewards": [], "cont": [],
+               "is_first": []}
+        for _ in range(batch_size):
+            start = int(self.rng.integers(0, len(self.actions) - L + 1))
+            sl = slice(start, start + L)
+            out["obs"].append(np.stack(self.obs[sl]))
+            out["actions"].append(np.asarray(self.actions[sl], np.int32))
+            out["rewards"].append(np.asarray(self.rewards[sl], np.float32))
+            out["cont"].append(np.asarray(self.cont[sl], np.float32))
+            first = np.asarray(self.is_first[sl], np.float32)
+            first[0] = 1.0  # a window head is always a fresh RSSM start
+            out["is_first"].append(first)
+        return {k: np.stack(v) for k, v in out.items()}
+
+
+def train_dreamer(
+    env_maker,
+    *,
+    cfg: Optional[DreamerConfig] = None,
+    episodes: int = 60,
+    updates_per_episode: int = 20,
+    seed: int = 0,
+    warmup_episodes: int = 5,
+    explore_eps: float = 0.1,
+) -> DreamerV3Learner:
+    """Online DreamerV3 loop: collect an episode with the current policy,
+    then train the world model + imagination actor-critic from replay.
+
+    explore_eps: epsilon-random actions during collection.  Imagination
+    training is only as good as the model, and the model only knows the
+    data — without a floor of exploration an early actor collapse makes the
+    replay single-action and the collapse self-reinforcing."""
+    import jax
+
+    env = env_maker()
+    if cfg is None:
+        cfg = DreamerConfig(
+            obs_dim=env.observation_dim, num_actions=env.num_actions
+        )
+    learner = DreamerV3Learner(cfg, seed=seed)
+    replay = _SeqReplay(cfg.seq_len, seed=seed)
+    key = jax.random.key(seed + 1)
+    rng = np.random.default_rng(seed)
+    returns = []
+    for ep in range(episodes):
+        obs = env.reset(seed=int(rng.integers(2**31)))
+        state = learner.init_state(1)
+        ep_obs, ep_act, ep_rew, ep_done = [], [], [], []
+        done = False
+        while not done:
+            key, k = jax.random.split(key)
+            if ep < warmup_episodes:
+                a = int(rng.integers(env.num_actions))
+                # still advance the RSSM state so a_prev stays consistent
+                state, _ = learner.act(k, state, obs)
+                h, z, _ = state
+                state = (h, z, learner.jax.nn.one_hot(
+                    learner.jnp.asarray([a]), learner.cfg.num_actions))
+            else:
+                state, a = learner.act(k, state, obs)
+                if rng.random() < explore_eps:
+                    a = int(rng.integers(env.num_actions))
+                    h, z, _ = state
+                    state = (h, z, learner.jax.nn.one_hot(
+                        learner.jnp.asarray([a]), learner.cfg.num_actions))
+            nxt, r, done, _ = env.step(a)
+            ep_obs.append(obs); ep_act.append(a); ep_rew.append(r)
+            ep_done.append(float(done))
+            obs = nxt
+        replay.add_episode(ep_obs, ep_act, ep_rew, ep_done)
+        returns.append(sum(ep_rew))
+        if replay.num_steps >= cfg.batch_size * cfg.seq_len:
+            for _ in range(updates_per_episode):
+                key, k = jax.random.split(key)
+                learner.last_stats = learner.update(
+                    k, replay.sample(cfg.batch_size)
+                )
+    learner.episode_returns = returns
+    return learner
+
+
+def evaluate_dreamer(learner: DreamerV3Learner, env_maker, episodes: int = 3,
+                     seed: int = 123) -> float:
+    import jax
+
+    env = env_maker()
+    key = jax.random.key(seed)
+    total = 0.0
+    for ep in range(episodes):
+        obs = env.reset(seed=seed + ep)
+        state = learner.init_state(1)
+        done = False
+        while not done:
+            key, k = jax.random.split(key)
+            state, a = learner.act(k, state, obs, greedy=True)
+            obs, r, done, _ = env.step(a)
+            total += r
+    return total / episodes

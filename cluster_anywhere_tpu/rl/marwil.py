@@ -160,18 +160,12 @@ def train_marwil(
     from .offline import RolloutReader
 
     reader = RolloutReader(path, seed=seed)
-    data = reader._all()
-    if "returns" not in data:
-        parts = []
-        for shard in reader:  # per-shard: the time-ordering unit
-            parts.append(
-                compute_returns(
-                    shard["rewards"], shard["dones"], gamma=gamma, n_envs=n_envs
-                )
-            )
-        data = dict(data)
-        data["returns"] = np.concatenate(parts)
-        reader._cache = data
+    reader.add_derived_column(
+        "returns",
+        lambda shard: compute_returns(
+            shard["rewards"], shard["dones"], gamma=gamma, n_envs=n_envs
+        ),
+    )
     learner = MARWILLearner(
         DiscretePolicyModule(obs_dim, num_actions, hidden),
         beta=beta, vf_coeff=vf_coeff, lr=lr, seed=seed,

@@ -87,6 +87,25 @@ class RolloutReader:
         idx = self.rng.integers(0, self.num_rows, size=batch_size)
         return {k: v[idx] for k, v in data.items()}
 
+    def add_derived_column(self, name: str, per_shard_fn) -> None:
+        """Attach a computed column aligned with the stored rows.
+
+        per_shard_fn(shard_dict) -> 1-D array of len(shard rows); shards are
+        visited in this reader's iteration order, so the concatenation
+        matches `_all()`'s row order by construction — callers never need to
+        reason about (or reach into) the cache layout.  Used by MARWIL to
+        inject per-episode discounted returns."""
+        parts = [np.asarray(per_shard_fn(shard)) for shard in self]
+        data = dict(self._all())
+        col = np.concatenate(parts)
+        if len(col) != self.num_rows:
+            raise ValueError(
+                f"derived column {name!r} has {len(col)} rows, store has "
+                f"{self.num_rows}"
+            )
+        data[name] = col
+        self._cache = data
+
 
 class BCLearner:
     """Behavior cloning: maximize log-likelihood of the logged actions

@@ -28,6 +28,12 @@ from ..train.session import (
     report,
 )
 from .bohb import TuneBOHB
+from .external import (
+    BayesOptSearch,
+    ExternalSearcher,
+    HyperOptSearch,
+    OptunaSearch,
+)
 from .hyperband import PAUSE, HyperBandForBOHB, HyperBandScheduler
 from .pb2 import PB2
 from .schedulers import (
@@ -113,6 +119,10 @@ __all__ = [
     "HyperBandForBOHB",
     "TuneBOHB",
     "PB2",
+    "ExternalSearcher",
+    "HyperOptSearch",
+    "OptunaSearch",
+    "BayesOptSearch",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "CONTINUE",

@@ -83,7 +83,10 @@ class TuneBOHB(Searcher):
                 )
             return (v - dom.low) / (dom.high - dom.low + 1e-12)
         if isinstance(dom, Integer):
-            return (v - dom.low) / max(1, dom.high - dom.low)
+            # Integer.sample draws from [low, high) — normalize over the
+            # actual value range [low, high-1] so the KDE tail can't land
+            # on the excluded endpoint
+            return (v - dom.low) / max(1, dom.high - 1 - dom.low)
         raise TypeError(dom)
 
     def _from_unit(self, dom: Domain, u: float):
@@ -96,10 +99,10 @@ class TuneBOHB(Searcher):
             else:
                 v = dom.low + u * (dom.high - dom.low)
             if dom.q:
-                v = round(v / dom.q) * dom.q
+                v = min(round(v / dom.q) * dom.q, dom.high)
             return float(v)
         if isinstance(dom, Integer):
-            return int(round(dom.low + u * (dom.high - dom.low)))
+            return int(round(dom.low + u * max(0, dom.high - 1 - dom.low)))
         raise TypeError(dom)
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:

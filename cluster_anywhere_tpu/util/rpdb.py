@@ -118,10 +118,21 @@ def _serve_breakpoint(frame, label: str, timeout: float, tb=None) -> None:
     srv.bind(("0.0.0.0", 0))
     srv.listen(1)
     port = srv.getsockname()[1]
-    try:
-        host = socket.gethostbyname(socket.gethostname())
-    except OSError:
-        host = "127.0.0.1"
+    # advertised host: prefer the address the worker already advertises for
+    # its TCP serving socket (chosen to be reachable across nodes); a bare
+    # gethostbyname(gethostname()) resolves to 127.0.1.1 on common distro
+    # /etc/hosts layouts and would send cross-node attaches to the wrong box
+    host = None
+    adv = getattr(worker, "serve_addr_tcp", None)
+    if adv and adv.startswith("tcp:"):
+        h = adv[4:].rsplit(":", 1)[0]
+        if h and h not in ("0.0.0.0", "::"):
+            host = h
+    if not host:
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            host = "127.0.0.1"
     key = f"{worker.client_id}:{os.getpid()}:{port}"
     _register(
         worker,
