@@ -24,18 +24,9 @@ shapes (scan over sequence/horizon).
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Dict, NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional
 
 import numpy as np
-
-
-def _nets():
-    import jax
-    import jax.numpy as jnp
-
-    return jax, jnp
-
 
 # ------------------------------------------------------------------ symlog
 
@@ -56,20 +47,16 @@ def twohot(x, bins):
     """Two-hot encode scalar x over a 1-D bin grid (piecewise-linear)."""
     import jax.numpy as jnp
 
+    import jax
+
     x = jnp.clip(x, bins[0], bins[-1])
     idx = jnp.sum((bins[None, :] <= x[..., None]).astype(jnp.int32), axis=-1) - 1
     idx = jnp.clip(idx, 0, len(bins) - 2)
     lo, hi = bins[idx], bins[idx + 1]
     w_hi = (x - lo) / jnp.maximum(hi - lo, 1e-8)
-    onehot_lo = jax_nn_one_hot(idx, len(bins))
-    onehot_hi = jax_nn_one_hot(idx + 1, len(bins))
+    onehot_lo = jax.nn.one_hot(idx, len(bins))
+    onehot_hi = jax.nn.one_hot(idx + 1, len(bins))
     return onehot_lo * (1 - w_hi)[..., None] + onehot_hi * w_hi[..., None]
-
-
-def jax_nn_one_hot(idx, n):
-    import jax
-
-    return jax.nn.one_hot(idx, n)
 
 
 class DreamerConfig(NamedTuple):
@@ -587,7 +574,17 @@ def train_dreamer(
             ep_obs.append(obs); ep_act.append(a); ep_rew.append(r)
             ep_done.append(float(done))
             obs = nxt
-        replay.add_episode(ep_obs, ep_act, ep_rew, ep_done)
+        # canonical DreamerV3 row layout: one row per OBSERVED state incl.
+        # the terminal one; reward is the reward received ON ARRIVAL at that
+        # state (so the reward head's target depends only on (o_t, a_{t-1}),
+        # both of which feat_t encodes), and cont marks the state itself
+        # non-terminal.  The terminal row's action is a dummy — the next row
+        # is a new episode whose is_first resets a_prev anyway.
+        rows_obs = ep_obs + [obs]
+        rows_act = ep_act + [0]
+        rows_rew = [0.0] + list(ep_rew)
+        rows_cont_inv = [0.0] * len(ep_obs) + [1.0]  # "done" per row
+        replay.add_episode(rows_obs, rows_act, rows_rew, rows_cont_inv)
         returns.append(sum(ep_rew))
         if replay.num_steps >= cfg.batch_size * cfg.seq_len:
             for _ in range(updates_per_episode):

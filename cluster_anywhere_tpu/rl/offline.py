@@ -94,7 +94,13 @@ class RolloutReader:
         visited in this reader's iteration order, so the concatenation
         matches `_all()`'s row order by construction — callers never need to
         reason about (or reach into) the cache layout.  Used by MARWIL to
-        inject per-episode discounted returns."""
+        inject per-episode discounted returns.
+
+        A column already present in the data (e.g. returns logged at
+        collection time with a different scheme) is honored, not
+        overwritten."""
+        if name in self._all():
+            return
         parts = [np.asarray(per_shard_fn(shard)) for shard in self]
         data = dict(self._all())
         col = np.concatenate(parts)

@@ -288,6 +288,7 @@ class ServeController:
                     st.init_kwargs,
                     st.cfg.user_config,
                     rid,
+                    deployment_name=f"{st.app}:{st.name}",
                 )
                 ca.get(h.check_health.remote(), timeout=60)
             except Exception as e:

@@ -70,27 +70,35 @@ class ProxyActor:
         from .router import DeploymentHandle
 
         while True:
-            try:
-                ctrl = get_actor(CONTROLLER_NAME)
-                routes = ca.get(ctrl.list_routes.remote(), timeout=10)
-                new = {}
-                for app, info in routes.items():
-                    if info["ingress"]:
-                        new[info["route_prefix"]] = DeploymentHandle(app, info["ingress"])
-                with self._routes_lock:
-                    # keep existing handles (their routers have warm caches)
-                    for prefix, h in new.items():
-                        if prefix not in self._routes or (
-                            self._routes[prefix].app != h.app
-                            or self._routes[prefix].deployment != h.deployment
-                        ):
-                            self._routes[prefix] = h
-                    for prefix in list(self._routes):
-                        if prefix not in new:
-                            del self._routes[prefix]
-            except Exception:
-                pass
+            self._refresh_routes_once()
             time.sleep(0.5)
+
+    def _refresh_routes_once(self):
+        from ..core import api as ca
+        from ..core.actor import get_actor
+        from .controller import CONTROLLER_NAME
+        from .router import DeploymentHandle
+
+        try:
+            ctrl = get_actor(CONTROLLER_NAME)
+            routes = ca.get(ctrl.list_routes.remote(), timeout=10)
+            new = {}
+            for app, info in routes.items():
+                if info["ingress"]:
+                    new[info["route_prefix"]] = DeploymentHandle(app, info["ingress"])
+            with self._routes_lock:
+                # keep existing handles (their routers have warm caches)
+                for prefix, h in new.items():
+                    if prefix not in self._routes or (
+                        self._routes[prefix].app != h.app
+                        or self._routes[prefix].deployment != h.deployment
+                    ):
+                        self._routes[prefix] = h
+                for prefix in list(self._routes):
+                    if prefix not in new:
+                        del self._routes[prefix]
+        except Exception:
+            pass
 
     def _match(self, path: str):
         with self._routes_lock:
@@ -166,6 +174,13 @@ class ProxyActor:
     async def _dispatch(self, req: Request, writer: asyncio.StreamWriter):
         try:
             match = self._match(req.path)
+            if match is None:
+                # a route deployed milliseconds ago may not have reached the
+                # 0.5s poller yet: refresh once (off-loop) before 404ing so
+                # serve.run() -> immediate request never races the sync
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self._refresh_routes_once)
+                match = self._match(req.path)
             if match is None:
                 await self._respond(writer, 404, {"error": f"no route for {req.path}"})
                 return

@@ -26,6 +26,34 @@ def get_request_context() -> RequestContext:
     return ctx if ctx is not None else RequestContext()
 
 
+_metrics_cache = {}
+
+
+def _serve_metrics():
+    """Per-request Prometheus series (reference serve metrics:
+    ray_serve_deployment_request_counter / _processing_latency_ms — here
+    ca_serve_requests_total / ca_serve_request_latency_seconds /
+    ca_serve_request_errors_total, tagged by deployment).  Lazy: replicas
+    that never serve a request register nothing."""
+    if not _metrics_cache:
+        from ..util import metrics as m
+
+        _metrics_cache["requests"] = m.Counter(
+            "ca_serve_requests_total", "serve requests handled",
+            tag_keys=("deployment",),
+        )
+        _metrics_cache["errors"] = m.Counter(
+            "ca_serve_request_errors_total", "serve requests errored",
+            tag_keys=("deployment",),
+        )
+        _metrics_cache["latency"] = m.Histogram(
+            "ca_serve_request_latency_seconds", "serve request latency",
+            boundaries=[0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 5.0],
+            tag_keys=("deployment",),
+        )
+    return _metrics_cache
+
+
 class Replica:
     """One replica process. Methods are async so many requests interleave on
     the actor's event loop up to max_ongoing_requests."""
@@ -38,6 +66,7 @@ class Replica:
         user_config: Optional[Dict[str, Any]],
         replica_id: str,
         handle_specs: Optional[Dict[str, Any]] = None,
+        deployment_name: Optional[str] = None,
     ):
         # late-bind nested DeploymentHandles (model composition): bound
         # sub-deployments arrive as specs and materialize into handles here
@@ -57,6 +86,7 @@ class Replica:
         init_args = tuple(resolve(a) for a in init_args)
         init_kwargs = {k: resolve(v) for k, v in init_kwargs.items()}
         self.replica_id = replica_id
+        self._metric_tags = {"deployment": deployment_name or replica_id}
         self._is_function = not inspect.isclass(deployment_def)
         if self._is_function:
             self.instance = deployment_def
@@ -106,8 +136,13 @@ class Replica:
 
     # ----------------------------------------------------------- request path
     async def handle_request(self, meta: Dict[str, Any], *args, **kwargs):
+        import time as _time
+
         self.num_ongoing += 1
         self.total_requests += 1
+        mets = _serve_metrics()
+        mets["requests"].inc(1, tags=self._metric_tags)
+        t0 = _time.perf_counter()
         token = _request_context.set(
             RequestContext(
                 request_id=meta.get("request_id", ""),
@@ -127,7 +162,16 @@ class Replica:
             loop = asyncio.get_running_loop()
             ctx = contextvars.copy_context()
             return await loop.run_in_executor(None, lambda: ctx.run(fn, *args, **kwargs))
+        except Exception:
+            # Exception only: client cancellation (CancelledError /
+            # GeneratorExit are BaseException) is not a deployment error and
+            # must not feed the errors series alerts watch
+            mets["errors"].inc(1, tags=self._metric_tags)
+            raise
         finally:
+            mets["latency"].observe(
+                _time.perf_counter() - t0, tags=self._metric_tags
+            )
             _request_context.reset(token)
             self.num_ongoing -= 1
 
@@ -135,8 +179,13 @@ class Replica:
         """Generator twin of handle_request: iterates the user method's
         generator so items stream back as ObjectRefGenerator frames
         (reference replica.py streaming path)."""
+        import time as _time
+
         self.num_ongoing += 1
         self.total_requests += 1
+        mets = _serve_metrics()
+        mets["requests"].inc(1, tags=self._metric_tags)
+        t0 = _time.perf_counter()
         token = _request_context.set(
             RequestContext(
                 request_id=meta.get("request_id", ""),
@@ -153,6 +202,16 @@ class Replica:
                 yield out  # non-generator result: one-item stream
                 return
             yield from out
+        except Exception:
+            # Exception only: client cancellation (CancelledError /
+            # GeneratorExit are BaseException) is not a deployment error and
+            # must not feed the errors series alerts watch
+            mets["errors"].inc(1, tags=self._metric_tags)
+            raise
         finally:
+            # latency covers the full stream (first byte to exhaustion)
+            mets["latency"].observe(
+                _time.perf_counter() - t0, tags=self._metric_tags
+            )
             _request_context.reset(token)
             self.num_ongoing -= 1

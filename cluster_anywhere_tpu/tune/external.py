@@ -56,7 +56,15 @@ class ExternalSearcher(Searcher):
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         cfg = self._live.pop(trial_id, None)
-        if cfg is None or error or not result or self.metric not in result:
+        if cfg is None:
+            return
+        if error or not result or self.metric not in result:
+            # optimizers with pending-trial state (e.g. an optuna study)
+            # must hear about failures or they accumulate zombie in-flight
+            # trials that skew future suggestions
+            fail = getattr(self.opt, "tell_failure", None)
+            if fail is not None:
+                fail(cfg)
             return
         value = float(result[self.metric])
         if self.mode == "max" and self.negate_for_max:
@@ -138,6 +146,13 @@ def OptunaSearch(space=None, **kw) -> Searcher:
                 handles = self._pending.get(frozenset(cfg.items()))
                 if handles:
                     self.study.tell(handles.pop(0), value)
+
+            def tell_failure(self, cfg):
+                handles = self._pending.get(frozenset(cfg.items()))
+                if handles:
+                    self.study.tell(
+                        handles.pop(0), state=optuna.trial.TrialState.FAIL
+                    )
 
         return ExternalSearcher(_OptunaAskTell(study, space))
 

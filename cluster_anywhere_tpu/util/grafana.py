@@ -123,6 +123,31 @@ def generate_default_dashboard() -> Dict[str, Any]:
             [_target("sum by (name) (rate(ca_trace_span_seconds_count[1m]))", "{{name}}")],
             panel_id=4, x=12, y=8, unit="ops",
         ),
+        _panel(
+            "Serve requests / s by deployment",
+            [
+                _target(
+                    "sum by (deployment) (rate(ca_serve_requests_total[1m]))",
+                    "{{deployment}}",
+                ),
+                _target(
+                    "sum by (deployment) (rate(ca_serve_request_errors_total[1m]))",
+                    "errors {{deployment}}",
+                ),
+            ],
+            panel_id=5, x=0, y=16, unit="reqps",
+        ),
+        _panel(
+            "Serve request latency p99 by deployment",
+            [
+                _target(
+                    "histogram_quantile(0.99, sum by (le, deployment) "
+                    "(rate(ca_serve_request_latency_seconds_bucket[5m])))",
+                    "{{deployment}}",
+                )
+            ],
+            panel_id=6, x=12, y=16, unit="s",
+        ),
     ]
     return _dashboard("cluster_anywhere_tpu — core", "ca-default", panels)
 

@@ -412,3 +412,39 @@ def test_run_config_deploys_from_yaml(ca_cluster_module, tmp_path, monkeypatch):
     st = serve.status()
     assert st["cfgapp"]["Adder"]["replica_states"].get("RUNNING") == 2, st
     serve.delete("cfgapp")
+
+
+def test_serve_request_metrics_exported():
+    """Per-request Prometheus series (reference serve metrics role):
+    requests/errors counters and a latency histogram, tagged by deployment,
+    flow through the cluster metrics pipeline."""
+    from cluster_anywhere_tpu.util.metrics import get_metrics_snapshot
+
+    @serve.deployment
+    class Meter:
+        def __call__(self, x):
+            if x < 0:
+                raise ValueError("negative")
+            return x + 1
+
+    handle = serve.run(Meter.bind(), name="meter")
+    for i in range(5):
+        assert handle.remote(i).result() == i + 1
+    with pytest.raises(Exception):
+        handle.remote(-1).result()
+
+    def tagged(rec, pred):
+        return any("meter" in k and pred(v) for k, v in rec.get("data", {}).items())
+
+    deadline = time.monotonic() + 15
+    snap = {}
+    while time.monotonic() < deadline:
+        snap = get_metrics_snapshot()
+        if tagged(snap.get("ca_serve_requests_total", {}), lambda v: v >= 6):
+            break
+        time.sleep(0.5)
+    assert tagged(snap.get("ca_serve_requests_total", {}), lambda v: v >= 6), snap
+    assert tagged(snap.get("ca_serve_request_errors_total", {}), lambda v: v >= 1)
+    lat = snap.get("ca_serve_request_latency_seconds", {})
+    assert tagged(lat, lambda v: v["count"] >= 6), lat
+    serve.delete("meter")
