@@ -552,7 +552,7 @@ def train_dreamer(
     for ep in range(episodes):
         obs = env.reset(seed=int(rng.integers(2**31)))
         state = learner.init_state(1)
-        ep_obs, ep_act, ep_rew, ep_done = [], [], [], []
+        ep_obs, ep_act, ep_rew = [], [], []
         done = False
         while not done:
             key, k = jax.random.split(key)
@@ -572,7 +572,6 @@ def train_dreamer(
                         learner.jnp.asarray([a]), learner.cfg.num_actions))
             nxt, r, done, _ = env.step(a)
             ep_obs.append(obs); ep_act.append(a); ep_rew.append(r)
-            ep_done.append(float(done))
             obs = nxt
         # canonical DreamerV3 row layout: one row per OBSERVED state incl.
         # the terminal one; reward is the reward received ON ARRIVAL at that

@@ -64,11 +64,6 @@ class ProxyActor:
 
     # ------------------------------------------------------------ route sync
     def _refresh_routes_loop(self):
-        from ..core import api as ca
-        from ..core.actor import get_actor
-        from .controller import CONTROLLER_NAME
-        from .router import DeploymentHandle
-
         while True:
             self._refresh_routes_once()
             time.sleep(0.5)
@@ -177,10 +172,17 @@ class ProxyActor:
             if match is None:
                 # a route deployed milliseconds ago may not have reached the
                 # 0.5s poller yet: refresh once (off-loop) before 404ing so
-                # serve.run() -> immediate request never races the sync
-                loop = asyncio.get_running_loop()
-                await loop.run_in_executor(None, self._refresh_routes_once)
-                match = self._match(req.path)
+                # serve.run() -> immediate request never races the sync.
+                # Rate-limited by its OWN timestamp (not the poller's): a
+                # miss must always get one fresh look at the controller,
+                # while a 404 burst (scanners, favicon probes) costs at most
+                # ~2 extra RPCs/s
+                now = time.monotonic()
+                if now - getattr(self, "_last_miss_refresh", 0.0) >= 0.45:
+                    self._last_miss_refresh = now
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(None, self._refresh_routes_once)
+                    match = self._match(req.path)
             if match is None:
                 await self._respond(writer, 404, {"error": f"no route for {req.path}"})
                 return

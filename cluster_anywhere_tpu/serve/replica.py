@@ -136,13 +136,11 @@ class Replica:
 
     # ----------------------------------------------------------- request path
     async def handle_request(self, meta: Dict[str, Any], *args, **kwargs):
-        import time as _time
-
         self.num_ongoing += 1
         self.total_requests += 1
         mets = _serve_metrics()
         mets["requests"].inc(1, tags=self._metric_tags)
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         token = _request_context.set(
             RequestContext(
                 request_id=meta.get("request_id", ""),
@@ -170,7 +168,7 @@ class Replica:
             raise
         finally:
             mets["latency"].observe(
-                _time.perf_counter() - t0, tags=self._metric_tags
+                time.perf_counter() - t0, tags=self._metric_tags
             )
             _request_context.reset(token)
             self.num_ongoing -= 1
@@ -179,13 +177,11 @@ class Replica:
         """Generator twin of handle_request: iterates the user method's
         generator so items stream back as ObjectRefGenerator frames
         (reference replica.py streaming path)."""
-        import time as _time
-
         self.num_ongoing += 1
         self.total_requests += 1
         mets = _serve_metrics()
         mets["requests"].inc(1, tags=self._metric_tags)
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         token = _request_context.set(
             RequestContext(
                 request_id=meta.get("request_id", ""),
@@ -211,7 +207,7 @@ class Replica:
         finally:
             # latency covers the full stream (first byte to exhaustion)
             mets["latency"].observe(
-                _time.perf_counter() - t0, tags=self._metric_tags
+                time.perf_counter() - t0, tags=self._metric_tags
             )
             _request_context.reset(token)
             self.num_ongoing -= 1

@@ -244,6 +244,12 @@ def attach(host: str, port: int) -> int:
         while not done.is_set():
             line = sys.stdin.readline()
             if not line:
+                if not sys.stdin.isatty():
+                    # piped input exhausted: the commands are already in
+                    # flight — drain the remote's replies until it closes
+                    # the session, or closing now races away the output
+                    done.wait(timeout=15)
+                # interactive Ctrl-D: detach immediately
                 break
             try:
                 sock.sendall(line.encode())

@@ -284,3 +284,48 @@ def test_ca_up_down(tmp_path):
         )
     assert down.returncode == 0, down.stdout + down.stderr
     assert "stopping cluster" in down.stdout
+
+
+def test_cli_debug_attaches_to_breakpoint():
+    """`ca debug <idx>` end to end: a task parks on set_trace, the CLI
+    subprocess lists the KV-registered breakpoint, attaches over TCP,
+    inspects a local, continues, and the task finishes (reference
+    `ray debug`)."""
+    import time as _t
+
+    if not ca.is_initialized():  # the up/down test above tears down
+        ca.init(num_cpus=4)
+
+    @ca.remote
+    def buggy(x):
+        secret = x * 7
+        from cluster_anywhere_tpu.util.rpdb import set_trace
+
+        set_trace(timeout=60)
+        return secret
+
+    ref = buggy.remote(6)
+    # wait for the breakpoint to register
+    from cluster_anywhere_tpu.core.worker import global_worker
+    from cluster_anywhere_tpu.util import rpdb
+
+    w = global_worker()
+    deadline = _t.monotonic() + 20
+    while _t.monotonic() < deadline and not rpdb.list_breakpoints(w):
+        _t.sleep(0.2)
+    assert rpdb.list_breakpoints(w)
+
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    session = w.session_dir
+    out = subprocess.run(
+        [sys.executable, "-m", "cluster_anywhere_tpu.cli", "debug", "0",
+         "--address", session],
+        input="p secret\nc\n",
+        capture_output=True,
+        text=True,
+        timeout=90,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "42" in out.stdout, out.stdout
+    assert ca.get(ref, timeout=30) == 42
