@@ -39,6 +39,7 @@ from jax import lax
 
 from ..models.generate import (
     _block_decode_rowpos,
+    _nucleus_mask,
     _rms_norm,
     _sample,
     prefill,
@@ -53,6 +54,7 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
     eos_id: Optional[int] = None
     # filled as the request runs
     out_tokens: List[int] = field(default_factory=list)
@@ -60,11 +62,11 @@ class Request:
     done: bool = False
 
 
-def _sample_rowwise(logits, rngs, temps, top_ks):
-    """Per-row sampling with TRACED temperature and top-k (requests in one
-    decode batch carry their own knobs; a static top_k would force one value
-    per compiled program).  top_k <= 0 means no truncation; temp <= 0 means
-    greedy."""
+def _sample_rowwise(logits, rngs, temps, top_ks, top_ps):
+    """Per-row sampling with TRACED temperature, top-k, and top-p (requests
+    in one decode batch carry their own knobs; a static top_k would force
+    one value per compiled program).  top_k <= 0 means no truncation;
+    top_p outside (0, 1) means no nucleus mask; temp <= 0 means greedy."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     t = jnp.maximum(temps, 1e-6)[:, None]
     scaled = logits / t
@@ -74,6 +76,8 @@ def _sample_rowwise(logits, rngs, temps, top_ks):
     kth_idx = jnp.clip(top_ks - 1, 0, v - 1)[:, None]
     kth = jnp.take_along_axis(sorted_desc, kth_idx, axis=-1)
     scaled = jnp.where((top_ks[:, None] > 0) & (scaled < kth), -1e30, scaled)
+    # per-row nucleus mask: [S,1] top_p broadcasts through the shared helper
+    scaled = _nucleus_mask(scaled, top_ps[:, None])
     sampled = jax.vmap(lambda rng, row: jax.random.categorical(rng, row))(
         rngs, scaled
     ).astype(jnp.int32)
@@ -81,7 +85,7 @@ def _sample_rowwise(logits, rngs, temps, top_ks):
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def _decode_step_rowpos(params, cache, tokens, pos, pads, temps, top_ks, rngs, *, cfg):
+def _decode_step_rowpos(params, cache, tokens, pos, pads, temps, top_ks, top_ps, rngs, *, cfg):
     """One token for every slot with PER-ROW cache positions.
     tokens/pos/pads/temps/top_ks: [S]; rngs: [S] keys.  Returns
     (next_tokens [S], cache).  The cache is donated: decode rewrites it in
@@ -96,7 +100,7 @@ def _decode_step_rowpos(params, cache, tokens, pos, pads, temps, top_ks, rngs, *
     x, (k_all, v_all) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
     x = _rms_norm(x, params["ln_f"])
     logits = (x[:, 0] @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    nxt = _sample_rowwise(logits, rngs, temps, top_ks)
+    nxt = _sample_rowwise(logits, rngs, temps, top_ks, top_ps)
     return nxt, {"k": k_all, "v": v_all}
 
 
@@ -145,6 +149,7 @@ class ContinuousBatcher:
         self._pads = np.zeros(slots, np.int32)
         self._temps = np.zeros(slots, np.float32)
         self._topks = np.zeros(slots, np.int32)
+        self._topps = np.ones(slots, np.float32)
         self._by_slot: List[Optional[Request]] = [None] * slots
         self.queue: deque[Request] = deque()
         # bounded: pump() drains it; step()-driven servers track their own
@@ -162,6 +167,7 @@ class ContinuousBatcher:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         top_k: Optional[int] = None,
+        top_p: float = 1.0,
         eos_id: Optional[int] = None,
     ) -> Request:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -172,7 +178,7 @@ class ContinuousBatcher:
             )
         req = Request(
             next(self._ids), prompt, int(max_new_tokens), float(temperature),
-            self.top_k if top_k is None else int(top_k), eos_id,
+            self.top_k if top_k is None else int(top_k), float(top_p), eos_id,
         )
         self.queue.append(req)
         return req
@@ -200,6 +206,7 @@ class ContinuousBatcher:
             jnp.asarray(self._pads),
             jnp.asarray(self._temps),
             jnp.asarray(self._topks),
+            jnp.asarray(self._topps),
             jnp.stack(keys),
             cfg=self.cfg,
         )
@@ -265,7 +272,10 @@ class ContinuousBatcher:
             self._rng, k = jax.random.split(self._rng)
             first = int(
                 np.asarray(
-                    _sample(logits, k, jnp.float32(req.temperature), req.top_k)
+                    _sample(
+                        logits, k, jnp.float32(req.temperature), req.top_k,
+                        jnp.float32(req.top_p),
+                    )
                 )[0]
             )
             req.out_tokens.append(first)
@@ -278,6 +288,7 @@ class ContinuousBatcher:
             self._pads[slot] = pad
             self._temps[slot] = req.temperature
             self._topks[slot] = req.top_k
+            self._topps[slot] = req.top_p
             self.stats["admitted"] += 1
             if len(req.out_tokens) >= req.max_new_tokens or (
                 req.eos_id is not None and first == req.eos_id

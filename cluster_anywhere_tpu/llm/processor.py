@@ -65,6 +65,7 @@ class ProcessorConfig:
     max_new_tokens: int = 16
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0
     apply_chat_template: bool = False
     system_prompt: str = ""
 
@@ -95,6 +96,7 @@ class _InferenceWorker:
         max_new_tokens: Optional[int] = None,
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
     ) -> Dict[str, np.ndarray]:
         import jax
         import jax.numpy as jnp
@@ -105,6 +107,7 @@ class _InferenceWorker:
         max_new_tokens = cfg.max_new_tokens if max_new_tokens is None else max_new_tokens
         temperature = cfg.temperature if temperature is None else temperature
         top_k = cfg.top_k if top_k is None else top_k
+        top_p = getattr(cfg, "top_p", 1.0) if top_p is None else top_p
         prompts = [str(p) for p in batch["prompt"].tolist()]
         encoded = [self.tok.encode(p)[: cfg.max_prompt_len] for p in prompts]
         # left-pad to the FIXED max_prompt_len so every batch hits the same
@@ -124,6 +127,7 @@ class _InferenceWorker:
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             top_k=top_k,
+            top_p=top_p,
             prompt_lens=jnp.asarray(lens),
         )
         out = np.asarray(out)
@@ -139,6 +143,7 @@ class _InferenceWorker:
         max_new_tokens: Optional[int] = None,
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
     ):
         """Token-by-token decoding of one prompt; a generator meant to run as
         a num_returns="streaming" actor call, so clients receive tokens as
@@ -163,6 +168,7 @@ class _InferenceWorker:
             max_new_tokens=max_new_tokens,
             temperature=cfg.temperature if temperature is None else temperature,
             top_k=cfg.top_k if top_k is None else top_k,
+            top_p=getattr(cfg, "top_p", 1.0) if top_p is None else top_p,
             prompt_lens=jnp.asarray([len(encoded)], np.int32),
         ):
             tid = int(tok[0])

@@ -48,6 +48,8 @@ class LLMServer:
             overrides["temperature"] = float(body["temperature"])
         if "top_k" in body:
             overrides["top_k"] = int(body["top_k"])
+        if "top_p" in body:
+            overrides["top_p"] = float(body["top_p"])
         out = self.worker(batch, **overrides)
         return {
             "prompt": prompt,
@@ -69,6 +71,8 @@ class LLMServer:
             kwargs["temperature"] = float(body["temperature"])
         if "top_k" in body:
             kwargs["top_k"] = int(body["top_k"])
+        if "top_p" in body:
+            kwargs["top_p"] = float(body["top_p"])
         yield from self.worker.stream(body.get("prompt", ""), **kwargs)
 
 
@@ -196,6 +200,7 @@ class ContinuousLLMServer:
         mnt = int(body.get("max_new_tokens", self.config.max_new_tokens))
         temp = float(body.get("temperature", self.config.temperature))
         top_k = body.get("top_k")
+        top_p = float(body.get("top_p", 1.0))
         q = self._queue_cls()
         with self._lock:
             if self._engine_error is not None:
@@ -207,6 +212,7 @@ class ContinuousLLMServer:
             req = self.cb.submit(
                 ids, max_new_tokens=mnt, temperature=temp,
                 top_k=None if top_k is None else int(top_k),
+                top_p=top_p,
             )
             self._queues[req.request_id] = q
             self._reqs[req.request_id] = req

@@ -219,15 +219,32 @@ def decode_one(params, cache, token, pos, cfg: TransformerConfig, pad=None):
     return logits, {"k": k_all, "v": v_all}
 
 
-def _sample(logits, rng, temperature, top_k: int):
-    """temperature is traced (no recompile per request value); top_k stays
-    static (lax.top_k needs a static k). temperature <= 0 means greedy."""
+def _nucleus_mask(scaled, top_p):
+    """Mask logits outside the smallest probability-mass prefix >= top_p
+    (nucleus sampling).  top_p is TRACED; <= 0 or >= 1 disables.  The
+    highest-probability token is always kept (its exclusive cumsum is 0)."""
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sorted_p = -jnp.sort(-probs, axis=-1)
+    cum_excl = jnp.cumsum(sorted_p, axis=-1) - sorted_p
+    included = cum_excl < top_p
+    thresh = jnp.min(
+        jnp.where(included, sorted_p, jnp.inf), axis=-1, keepdims=True
+    )
+    apply = (top_p > 0.0) & (top_p < 1.0)
+    return jnp.where(apply & (probs < thresh), -1e30, scaled)
+
+
+def _sample(logits, rng, temperature, top_k: int, top_p=1.0):
+    """temperature/top_p are traced (no recompile per request value); top_k
+    stays static (lax.top_k needs a static k). temperature <= 0 means
+    greedy; top_p in (0, 1) applies nucleus truncation."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     t = jnp.maximum(temperature, 1e-6)
     scaled = logits / t
     if top_k > 0:
         top = lax.top_k(scaled, top_k)[0][..., -1:]
         scaled = jnp.where(scaled < top, -1e30, scaled)
+    scaled = _nucleus_mask(scaled, top_p)
     sampled = jax.random.categorical(rng, scaled).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
@@ -242,6 +259,7 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     prompt_lens: Optional[jax.Array] = None,
 ) -> jax.Array:
     """prompt_ids: [B, T_prompt] int32 -> generated ids [B, max_new_tokens].
@@ -254,12 +272,12 @@ def generate(
     pad = None if prompt_lens is None else (t_prompt - prompt_lens).astype(jnp.int32)
     logits, cache = prefill(params, prompt_ids, cfg, t_max, pad)
     rngs = jax.random.split(rng, max_new_tokens)
-    first = _sample(logits, rngs[0], temperature, top_k)
+    first = _sample(logits, rngs[0], temperature, top_k, top_p)
 
     def step(carry, rng_i):
         token, cache, pos = carry
         logits, cache = decode_one(params, cache, token, pos, cfg, pad)
-        nxt = _sample(logits, rng_i, temperature, top_k)
+        nxt = _sample(logits, rng_i, temperature, top_k, top_p)
         return (nxt, cache, pos + 1), nxt
 
     (_, _, _), tokens = lax.scan(
@@ -271,17 +289,18 @@ def generate(
 
 
 @functools.lru_cache(maxsize=8)
-def _stream_fns(cfg: TransformerConfig, t_prompt: int, t_max: int, temperature: float, top_k: int):
+def _stream_fns(cfg: TransformerConfig, t_prompt: int, t_max: int, top_k: int):
     """Jitted prefill+sample and single-decode-step closures for streaming
-    decoding (compiled once per shape/config)."""
+    decoding (compiled once per shape/config/top_k; temperature and top_p
+    are TRACED operands, so per-request values never recompile)."""
 
-    def _prefill(params, ids, pad, rng):
+    def _prefill(params, ids, pad, rng, temperature, top_p):
         logits, cache = prefill(params, ids, cfg, t_max, pad)
-        return _sample(logits, rng, temperature, top_k), cache
+        return _sample(logits, rng, temperature, top_k, top_p), cache
 
-    def _step(params, cache, token, pos, pad, rng):
+    def _step(params, cache, token, pos, pad, rng, temperature, top_p):
         logits, cache = decode_one(params, cache, token, pos, cfg, pad)
-        return _sample(logits, rng, temperature, top_k), cache
+        return _sample(logits, rng, temperature, top_k, top_p), cache
 
     return jax.jit(_prefill), jax.jit(_step)
 
@@ -295,6 +314,7 @@ def stream_generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     prompt_lens: Optional[jax.Array] = None,
 ):
     """Python generator yielding one [B] int32 token array per decode step.
@@ -308,12 +328,14 @@ def stream_generate(
     b, t_prompt = prompt_ids.shape
     t_max = t_prompt + max_new_tokens
     pad = None if prompt_lens is None else (t_prompt - prompt_lens).astype(jnp.int32)
-    pre, step = _stream_fns(cfg, t_prompt, t_max, float(temperature), int(top_k))
+    pre, step = _stream_fns(cfg, t_prompt, t_max, int(top_k))
+    temp_op = jnp.float32(temperature)
+    top_p_op = jnp.float32(top_p)
     rngs = jax.random.split(rng, max_new_tokens)
-    token, cache = pre(params, prompt_ids, pad, rngs[0])
+    token, cache = pre(params, prompt_ids, pad, rngs[0], temp_op, top_p_op)
     yield np.asarray(token)
     pos = t_prompt
     for i in range(1, max_new_tokens):
-        token, cache = step(params, cache, token, jnp.int32(pos), pad, rngs[i])
+        token, cache = step(params, cache, token, jnp.int32(pos), pad, rngs[i], temp_op, top_p_op)
         pos += 1
         yield np.asarray(token)

@@ -43,6 +43,7 @@ class ProxyActor:
         self.port = port
         self._routes: Dict[str, Any] = {}  # route_prefix -> DeploymentHandle
         self._routes_lock = threading.Lock()
+        self._miss_lock = threading.Lock()
         self._loop = global_worker().loop
         self._server = None
         self._started = threading.Event()
@@ -68,7 +69,15 @@ class ProxyActor:
             self._refresh_routes_once()
             time.sleep(0.5)
 
-    def _refresh_routes_once(self):
+    def _miss_refresh(self):
+        # serialized: each caller's refresh STARTS after its miss, so the
+        # serve.run() -> immediate-request race can't 404; concurrency to
+        # the controller stays 1.  Short RPC timeout: a dead controller
+        # must cost a miss ~2s, not 10
+        with self._miss_lock:
+            self._refresh_routes_once(rpc_timeout=2)
+
+    def _refresh_routes_once(self, rpc_timeout: float = 10):
         from ..core import api as ca
         from ..core.actor import get_actor
         from .controller import CONTROLLER_NAME
@@ -76,7 +85,7 @@ class ProxyActor:
 
         try:
             ctrl = get_actor(CONTROLLER_NAME)
-            routes = ca.get(ctrl.list_routes.remote(), timeout=10)
+            routes = ca.get(ctrl.list_routes.remote(), timeout=rpc_timeout)
             new = {}
             for app, info in routes.items():
                 if info["ingress"]:
@@ -171,18 +180,13 @@ class ProxyActor:
             match = self._match(req.path)
             if match is None:
                 # a route deployed milliseconds ago may not have reached the
-                # 0.5s poller yet: refresh once (off-loop) before 404ing so
-                # serve.run() -> immediate request never races the sync.
-                # Rate-limited by its OWN timestamp (not the poller's): a
-                # miss must always get one fresh look at the controller,
-                # while a 404 burst (scanners, favicon probes) costs at most
-                # ~2 extra RPCs/s
-                now = time.monotonic()
-                if now - getattr(self, "_last_miss_refresh", 0.0) >= 0.45:
-                    self._last_miss_refresh = now
-                    loop = asyncio.get_running_loop()
-                    await loop.run_in_executor(None, self._refresh_routes_once)
-                    match = self._match(req.path)
+                # 0.5s poller yet: EVERY miss gets one fresh look at the
+                # controller before 404ing, serialized through one lock so a
+                # 404 burst (scanners, favicon probes) queues behind a
+                # single in-flight RPC instead of flooding the controller
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, self._miss_refresh)
+                match = self._match(req.path)
             if match is None:
                 await self._respond(writer, 404, {"error": f"no route for {req.path}"})
                 return

@@ -153,3 +153,54 @@ def test_moe_transformer_trains_on_ep_mesh():
     assert losses[-1] < losses[0], losses
     router_after = np.asarray(jax.device_get(params["blocks"]["router"]))
     assert not np.allclose(router_before, router_after), "router got no gradient"
+
+
+def test_nucleus_sampling_masks_tail():
+    """top-p (nucleus) truncation: with p smaller than the top token's
+    probability only the argmax can be sampled; p>=1 leaves the
+    distribution untouched; the top token is always kept."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cluster_anywhere_tpu.models.generate import _nucleus_mask, _sample
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # p=0.4 < P(top): nucleus = {argmax} only
+    masked = _nucleus_mask(logits, jnp.float32(0.4))
+    assert np.asarray(masked[0, 0]) > -1e29
+    assert (np.asarray(masked[0, 1:]) < -1e29).all()
+    # p=0.85: keeps 0.5+0.3 (=0.8 exclusive-cum at third token is 0.8 < 0.85
+    # -> third kept too); fourth excluded
+    masked = _nucleus_mask(logits, jnp.float32(0.85))
+    assert (np.asarray(masked[0, :3]) > -1e29).all()
+    assert np.asarray(masked[0, 3]) < -1e29
+    # p>=1: no-op
+    masked = _nucleus_mask(logits, jnp.float32(1.0))
+    assert (np.asarray(masked) > -1e29).all()
+    # sampling respects the mask
+    keys = jax.random.split(jax.random.key(0), 64)
+    toks = [int(_sample(logits, k, jnp.float32(1.0), 0, jnp.float32(0.4))[0]) for k in keys[:16]]
+    assert set(toks) == {0}
+
+
+def test_rowwise_nucleus_sampling_per_request():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cluster_anywhere_tpu.llm.continuous import _sample_rowwise
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]] * 2))
+    rngs = jax.random.split(jax.random.key(1), 2)
+    temps = jnp.asarray([1.0, 1.0])
+    top_ks = jnp.asarray([0, 0])
+    # row 0 nucleus-collapsed to argmax; row 1 unrestricted
+    top_ps = jnp.asarray([0.4, 1.0])
+    seen_row1 = set()
+    for i in range(24):
+        ks = jax.random.split(jax.random.key(100 + i), 2)
+        out = np.asarray(_sample_rowwise(logits, ks, temps, top_ks, top_ps))
+        assert out[0] == 0
+        seen_row1.add(int(out[1]))
+    assert len(seen_row1) > 1  # row 1 still samples the tail
