@@ -244,12 +244,17 @@ def _sample(logits, rng, temperature, top_k: int, top_p=1.0):
     if top_k > 0:
         top = lax.top_k(scaled, top_k)[0][..., -1:]
         scaled = jnp.where(scaled < top, -1e30, scaled)
-    scaled = _nucleus_mask(scaled, top_p)
+    # statically skip a guaranteed no-op mask (python-float defaults): the
+    # nucleus pass costs a full-vocab softmax+sort per step.  Traced top_p
+    # (streaming/continuous paths) always runs it — the mask itself gates
+    # on (0, 1) membership.
+    if not (isinstance(top_p, (int, float)) and not (0.0 < float(top_p) < 1.0)):
+        scaled = _nucleus_mask(scaled, top_p)
     sampled = jax.random.categorical(rng, scaled).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k"))
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k", "top_p"))
 def generate(
     params,
     prompt_ids,

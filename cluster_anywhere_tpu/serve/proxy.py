@@ -44,6 +44,7 @@ class ProxyActor:
         self._routes: Dict[str, Any] = {}  # route_prefix -> DeploymentHandle
         self._routes_lock = threading.Lock()
         self._miss_lock = threading.Lock()
+        self._refresh_gen = 0
         self._loop = global_worker().loop
         self._server = None
         self._started = threading.Event()
@@ -70,12 +71,18 @@ class ProxyActor:
             time.sleep(0.5)
 
     def _miss_refresh(self):
-        # serialized: each caller's refresh STARTS after its miss, so the
-        # serve.run() -> immediate-request race can't 404; concurrency to
-        # the controller stays 1.  Short RPC timeout: a dead controller
-        # must cost a miss ~2s, not 10
+        # true single-flight via a generation counter: a waiter whose miss
+        # preceded a refresh that has since COMPLETED skips its own RPC —
+        # a 404 burst costs one controller round-trip total, while the
+        # serve.run() -> immediate-request race still gets a refresh that
+        # finished after its miss.  Short RPC timeout: a dead controller
+        # costs a miss ~2s, not 10.
+        my_gen = self._refresh_gen
         with self._miss_lock:
+            if self._refresh_gen != my_gen:
+                return
             self._refresh_routes_once(rpc_timeout=2)
+            self._refresh_gen += 1
 
     def _refresh_routes_once(self, rpc_timeout: float = 10):
         from ..core import api as ca

@@ -36,6 +36,7 @@ from .external import (
 )
 from .hyperband import PAUSE, HyperBandForBOHB, HyperBandScheduler
 from .pb2 import PB2
+from .resource_changing import DistributeResources, ResourceChangingScheduler
 from .schedulers import (
     CONTINUE,
     STOP,
@@ -119,6 +120,8 @@ __all__ = [
     "HyperBandForBOHB",
     "TuneBOHB",
     "PB2",
+    "ResourceChangingScheduler",
+    "DistributeResources",
     "ExternalSearcher",
     "HyperOptSearch",
     "OptunaSearch",

@@ -79,6 +79,7 @@ class TuneController:
         )
         self.scheduler = scheduler or FIFOScheduler()
         self.scheduler.set_properties(metric or "_", mode)
+        self.scheduler._controller = self
         if hasattr(self.scheduler, "attach_searcher"):
             # BOHB coupling: rung completions feed the searcher's
             # per-budget model (hyperband.HyperBandForBOHB)
@@ -205,13 +206,16 @@ class TuneController:
             self.trials.append(trial)
             self._start_trial(trial)
 
-    def _actor_options(self) -> Dict[str, Any]:
+    def _actor_options(self, trial: Optional[Trial] = None) -> Dict[str, Any]:
         opts = dict(self.resources)
+        if trial is not None and getattr(trial, "resources", None):
+            # per-trial override (ResourceChangingScheduler reallocation)
+            opts.update(trial.resources)
         opts.setdefault("max_concurrency", 2)  # poll() while the fn runs
         return opts
 
     def _start_trial(self, trial: Trial, checkpoint_path: Optional[str] = None):
-        Runner = ca.remote(TrialRunner).options(**self._actor_options())
+        Runner = ca.remote(TrialRunner).options(**self._actor_options(trial))
         trial.actor = Runner.remote(
             self.trainable,
             trial.config,
@@ -329,6 +333,21 @@ class TuneController:
             return
         self._release_actor(trial)
         trial.config = decision["config"]
+        if decision.get("resources"):
+            trial.resources = dict(decision["resources"])
+            # kill() releases the old actor's resources asynchronously; a
+            # grown request can race that release and fail create_actor.
+            # Wait (bounded) until the cluster can actually host the new
+            # shape before restarting.
+            need = float(trial.resources.get("num_cpus", 0))
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                try:
+                    if ca.available_resources().get("CPU", 0.0) >= need:
+                        break
+                except Exception:
+                    break
+                time.sleep(0.05)
         self._start_trial(trial, checkpoint_path=decision.get("checkpoint_path"))
 
     # ------------------------------------------------------------ persistence

@@ -30,6 +30,8 @@ class Trial:
         self.local_dir = os.path.join(experiment_dir, self.trial_id)
         self.latest_checkpoint_path: Optional[str] = None
         self.checkpoint_paths: List[str] = []
+        # per-trial actor resource override (ResourceChangingScheduler)
+        self.resources: Optional[Dict[str, float]] = None
         # scheduler bookkeeping
         self.rungs_recorded: set = set()
         self.last_perturb_t: int = 0
@@ -46,6 +48,7 @@ class Trial:
             "latest_checkpoint_path": self.latest_checkpoint_path,
             "checkpoint_paths": self.checkpoint_paths,
             "local_dir": self.local_dir,
+            "resources": self.resources,
         }
 
     @classmethod
@@ -57,6 +60,7 @@ class Trial:
         t.error = d.get("error")
         t.latest_checkpoint_path = d.get("latest_checkpoint_path")
         t.checkpoint_paths = d.get("checkpoint_paths", [])
+        t.resources = d.get("resources")
         return t
 
 

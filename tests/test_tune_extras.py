@@ -411,3 +411,45 @@ def test_model_searcher_respects_num_samples(tmp_path):
     )
     results = tuner.fit()
     assert len(list(results)) == 5
+
+
+def test_resource_changing_scheduler_grows_trial_share(tmp_path):
+    """ResourceChangingScheduler (reference resource_changing_scheduler.py):
+    a live trial inherits freed CPUs via a checkpointed restart with new
+    actor resources."""
+    import time as _t
+
+    def trainable(config):
+        w = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                w = float(open(os.path.join(d, "w.txt")).read())
+        step = int(round(w))
+        while step < 12:
+            step += 1
+            w += 1.0
+            d = tune.make_temp_checkpoint_dir()
+            with open(os.path.join(d, "w.txt"), "w") as f:
+                f.write(str(w))
+            tune.report({"w": w, "training_iteration": step},
+                        checkpoint=tune.Checkpoint(d))
+            _t.sleep(0.05)
+
+    sched = tune.ResourceChangingScheduler(
+        resources_allocation_function=tune.DistributeResources(base_cpus=1),
+        reallocate_interval_s=0.2,
+    )
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="w", mode="max", scheduler=sched,
+                                    max_concurrent_trials=1),
+        run_config=tune.RunConfig(name="rc", storage_path=str(tmp_path), verbose=0),
+    ).fit()
+    (r,) = list(results)
+    assert r.metrics["training_iteration"] == 12
+    # the lone trial should have been reallocated the cluster's CPUs
+    trial = results._trials[0] if hasattr(results, "_trials") else None
+    if trial is not None:
+        assert getattr(trial, "resources", {}).get("num_cpus", 0) >= 2
