@@ -68,6 +68,8 @@ from .callbacks import (
     CSVLoggerCallback,
     JsonLoggerCallback,
     MLflowLoggerCallback,
+    WandbLoggerCallback,
+    CometLoggerCallback,
 )
 from .tuner import (
     ResultGrid,
@@ -85,6 +87,8 @@ __all__ = [
     "CSVLoggerCallback",
     "JsonLoggerCallback",
     "MLflowLoggerCallback",
+    "WandbLoggerCallback",
+    "CometLoggerCallback",
     "Tuner",
     "TuneConfig",
     "ResultGrid",

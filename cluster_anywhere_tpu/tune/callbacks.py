@@ -232,3 +232,98 @@ class MLflowLoggerCallback(Callback):
 
     def on_trial_error(self, trial) -> None:
         self._finish(trial, 4)  # FAILED
+
+
+def _numeric_metrics(result: Dict[str, Any]) -> Dict[str, float]:
+    """Chartable scalars only: bools are ints in python but are status
+    flags, not metrics (matches MLflowLoggerCallback's filter)."""
+    return {
+        k: v for k, v in result.items()
+        if not isinstance(v, bool) and isinstance(v, (int, float))
+    }
+
+
+class WandbLoggerCallback(Callback):
+    """Weights & Biases logger (reference air/integrations/wandb.py role).
+    The SDK is not installed in this offline image; construction raises a
+    clear gated error unless `wandb` is importable (e.g. pulled in via a
+    runtime_env).  With it present, each trial becomes a wandb run and
+    results stream to `wandb.log`."""
+
+    def __init__(self, project: str = "cluster_anywhere_tpu", **init_kwargs):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "wandb is not installed in this environment; install it via "
+                "a runtime_env (pip) or use JSON/CSV/MLflowLoggerCallback"
+            ) from e
+        self._wandb = wandb
+        self.project = project
+        self.init_kwargs = init_kwargs
+        self._runs: Dict[str, Any] = {}
+
+    def on_trial_start(self, trial) -> None:
+        if trial.trial_id in self._runs:
+            return  # restart (retry/pause-resume/reallocation): same run
+        self._runs[trial.trial_id] = self._wandb.init(
+            project=self.project, name=trial.trial_id, config=dict(trial.config),
+            reinit=True, **self.init_kwargs,
+        )
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        run = self._runs.get(trial.trial_id)
+        if run is not None:
+            run.log(_numeric_metrics(result))
+
+    def on_trial_complete(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish()
+
+    def on_trial_error(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish(exit_code=1)  # shows as failed, not successful
+
+
+class CometLoggerCallback(Callback):
+    """Comet ML logger (reference air/integrations/comet.py role); gated on
+    the `comet_ml` SDK exactly like WandbLoggerCallback."""
+
+    def __init__(self, project_name: str = "cluster_anywhere_tpu", **kw):
+        try:
+            import comet_ml  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "comet_ml is not installed in this environment; install it "
+                "via a runtime_env (pip) or use JSON/CSV/MLflowLoggerCallback"
+            ) from e
+        self._comet = comet_ml
+        self.project_name = project_name
+        self.kw = kw
+        self._exps: Dict[str, Any] = {}
+
+    def on_trial_start(self, trial) -> None:
+        if trial.trial_id in self._exps:
+            return  # restart: keep logging into the same experiment
+        exp = self._comet.Experiment(project_name=self.project_name, **self.kw)
+        exp.set_name(trial.trial_id)
+        exp.log_parameters(dict(trial.config))
+        self._exps[trial.trial_id] = exp
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        exp = self._exps.get(trial.trial_id)
+        if exp is not None:
+            exp.log_metrics(_numeric_metrics(result))
+
+    def on_trial_complete(self, trial) -> None:
+        exp = self._exps.pop(trial.trial_id, None)
+        if exp is not None:
+            exp.end()
+
+    def on_trial_error(self, trial) -> None:
+        exp = self._exps.pop(trial.trial_id, None)
+        if exp is not None:
+            exp.add_tag("failed")
+            exp.end()
