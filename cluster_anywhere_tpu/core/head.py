@@ -28,7 +28,7 @@ import signal
 import subprocess
 import sys
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -291,6 +291,11 @@ class Head:
         # out after the owner had already pinned and replied) — without it
         # such a pin would hold the objects for the owner's whole lifetime
         self._transit_pins: Dict[str, Tuple[float, List[bytes]]] = {}
+        # tombstones of disconnected client ids (drivers/workers): lets
+        # client_addr answer "dead", which borrowers use to fail fast with
+        # ObjectLostError instead of polling a dead owner to their timeout
+        # (OwnerDiedError role).  Bounded FIFO.
+        self._departed_clients: "OrderedDict[str, None]" = OrderedDict()
         # fault tolerance (gcs_server.h StorageType analogue, file-backed):
         # debounced snapshots of the cluster tables; a restarted head loads
         # them and re-adopts live workers/agents/drivers
@@ -385,6 +390,7 @@ class Head:
                 for a in self.actors.values()
             ],
             "named_actors": self.named_actors,
+            "departed_clients": list(self._departed_clients),
             "kv": self.kv,
             "pgs": [
                 {
@@ -428,6 +434,8 @@ class Head:
         with open(self._ckpt_path, "rb") as f:
             state = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
         now = time.monotonic()
+        for cid in state.get("departed_clients") or []:
+            self._departed_clients[cid] = None
         self.nodes = {}
         for n in state["nodes"]:
             rec = NodeRec(
@@ -1173,6 +1181,7 @@ class Head:
         self.subscribers.setdefault(f"shm_free:{client_id}", []).append(state["writer"])
         if role == "driver":
             self._driver_clients.add(client_id)
+        self._departed_clients.pop(client_id, None)  # it's back: not dead
         if msg.get("addr") or msg.get("addr_tcp"):
             self.client_addrs[client_id] = {
                 "addr": msg.get("addr") or "",
@@ -1768,7 +1777,11 @@ class Head:
         if info is None:
             rec = self.workers.get(cid)
             if rec is None or rec.state == "dead":
-                reply(found=False)
+                dead = (
+                    (rec is not None and rec.state == "dead")
+                    or cid in self._departed_clients
+                )
+                reply(found=False, dead=dead)
                 return
             info = {
                 "addr": rec.addr or "",
@@ -2268,6 +2281,9 @@ class Head:
                 self._obj_maybe_gc(rec)
         for tok in [t for t in self._transit_pins if t.startswith(transit_prefix)]:
             del self._transit_pins[tok]
+        self._departed_clients[cid] = None
+        while len(self._departed_clients) > 10_000:
+            self._departed_clients.popitem(last=False)
         if state.get("role") == "worker":
             rec = self.workers.get(cid)
             if rec is not None:

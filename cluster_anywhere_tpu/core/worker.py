@@ -732,8 +732,12 @@ class Worker:
                 role=self.mode,
                 client_id=self.client_id,
                 pid=os.getpid(),
-                addr=self.serve_addr or "",
-                addr_tcp=self.serve_addr_tcp or "",
+                # same fallbacks as the initial registration: the driver's
+                # only serving socket is its p2p listener — dropping it here
+                # made driver-owned inline objects unresolvable for
+                # borrowers after a head restart
+                addr=self.serve_addr or self._p2p_addr() or "",
+                addr_tcp=self.serve_addr_tcp or self._p2p_addr_tcp() or "",
                 node_id=self.node_id,
                 remote=self.client_mode,
                 timeout=5,
@@ -1300,6 +1304,9 @@ class Worker:
             owner_addr = await self._owner_addr_async(owner)
             owner_conn = None
             attempt = 0
+            dead_strikes = 0
+            first_strike_t = 0.0
+            _now_mono = time.monotonic
             while True:
                 e = self.memory_store.get_entry(oid)
                 if e is None or e.state != "pending":
@@ -1355,6 +1362,47 @@ class Worker:
                         reply = await self.head.call("obj_locate", oid=oid_b)
                     except Exception:
                         reply = {}
+                    if (
+                        not reply.get("found")
+                        and owner
+                        and owner_addr is None
+                        and attempt % 8 == 7
+                    ):
+                        # OwnerDiedError role: the head has no copy AND the
+                        # owner's client record is tombstoned — the object's
+                        # only authority is gone, so fail fast instead of
+                        # polling to the caller's timeout.  Probed at the
+                        # same every-8th cadence as owner re-resolution (no
+                        # per-attempt head RPC), and requiring TWO strikes
+                        # >= 3s apart: a restarting head briefly marks live
+                        # workers dead before re-adoption, and a transient
+                        # disconnect of a live client-mode driver tombstones
+                        # it until its housekeeping reconnect — neither
+                        # window may condemn the object.
+                        try:
+                            cr = await self.head.call(
+                                "client_addr", client_id=owner
+                            )
+                        except Exception:
+                            cr = {}
+                        if cr.get("dead"):
+                            if dead_strikes == 0:
+                                first_strike_t = _now_mono()
+                            dead_strikes += 1
+                        else:
+                            dead_strikes = 0
+                        if dead_strikes >= 2 and _now_mono() - first_strike_t >= 3.0:
+                            e2 = self.memory_store.get_entry(oid)
+                            if e2 is not None and e2.state == "pending":
+                                self.memory_store.put_error(
+                                    oid,
+                                    ObjectLostError(
+                                        f"object {oid} is unrecoverable: its "
+                                        f"owner ({owner}) died and no other "
+                                        "copy or lineage is known to the head"
+                                    ),
+                                )
+                            return
                 if reply.get("found"):
                     if reply.get("v") is not None:
                         # inline payload served straight from the owner

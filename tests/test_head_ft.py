@@ -135,3 +135,28 @@ def test_agent_node_readopted_after_restart():
         assert nid in alive and "n0" in alive
     finally:
         c.shutdown()
+
+
+def test_borrowed_ref_resolves_across_head_restart(ft_cluster):
+    """A borrower polling a DRIVER-owned forwarded ref through a head
+    kill -9 + restart must still resolve: the driver's re-registration
+    carries its p2p serving address (regression — _reconnect_head once
+    dropped the _p2p_addr fallback, leaving driver-owned inline objects
+    unresolvable after a restart)."""
+
+    @ca.remote
+    def slow_make():
+        time.sleep(4.0)
+        return np.arange(300)
+
+    @ca.remote
+    def consume(holder):
+        return int(ca.get(holder[0], timeout=25).sum())
+
+    r = slow_make.remote()
+    out = consume.remote([r])
+    time.sleep(0.5)
+    ft_cluster.kill_head()
+    time.sleep(1.0)
+    ft_cluster.restart_head()
+    assert ca.get(out, timeout=60) == int(np.arange(300).sum())

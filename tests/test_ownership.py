@@ -196,3 +196,100 @@ def test_p2p_and_kv_backend_dtype_parity(ca_cluster_module):
         finally:
             coll.destroy_collective_group(f"dk{i}")
             coll.destroy_collective_group(f"dp{i}")
+
+
+def test_owner_death_fails_fast_with_object_lost():
+    """TRUE owner death (the reference's OwnerDiedError): a ref CREATED BY a
+    worker on a doomed node is forwarded to a borrower pinned to the head
+    node; killing the owner's node makes the borrower's get raise
+    ObjectLostError promptly (head tombstones the departed client; the
+    borrower's head-fallback check concludes unrecoverability) instead of
+    polling to its timeout."""
+    import cluster_anywhere_tpu.cluster_utils as cu
+    from cluster_anywhere_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    if ca.is_initialized():  # the module fixture's single-node cluster
+        ca.shutdown()
+    c = cu.Cluster(head_resources={"CPU": 2})
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes(2)
+    try:
+
+        @ca.remote
+        def slow_make():
+            time.sleep(3.0)
+            return np.arange(500)
+
+        @ca.remote
+        def make_on_node():
+            # the inner ref's OWNER is this worker process on nid
+            return [slow_make.remote()]
+
+        @ca.remote
+        def consume(holder):
+            t0 = time.monotonic()
+            try:
+                val = int(ca.get(holder[0], timeout=30).sum())
+                return ("ok", val)
+            except Exception as e:
+                return ("err", type(e).__name__, time.monotonic() - t0)
+
+        holder = ca.get(
+            make_on_node.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=False)
+            ).remote(),
+            timeout=30,
+        )
+        # pin the borrower to the head node so the kill below cannot take it
+        out_ref = consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy("n0", soft=False)
+        ).remote(holder)
+        time.sleep(1.0)  # borrower is mid-poll against the nid owner
+        c.remove_node(nid)  # the OWNER (and producer) dies
+        out = ca.get(out_ref, timeout=60)
+        assert out[0] == "err" and out[1] == "ObjectLostError", out
+        assert out[2] < 15.0, f"owner death took {out[2]:.1f}s to surface"
+    finally:
+        c.shutdown()
+
+
+def test_producer_node_death_reconstructs_for_borrower():
+    """Contrast case: the ref is DRIVER-owned (normal f.remote return), only
+    the producing node dies — the borrower (pinned to the surviving head
+    node) resolves via lineage reconstruction."""
+    import cluster_anywhere_tpu.cluster_utils as cu
+    from cluster_anywhere_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    if ca.is_initialized():
+        ca.shutdown()
+    c = cu.Cluster(head_resources={"CPU": 2})
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes(2)
+    try:
+
+        @ca.remote
+        def slow_make():
+            time.sleep(1.2)
+            return np.arange(2000)
+
+        @ca.remote
+        def consume(holder):
+            return int(ca.get(holder[0], timeout=90).sum())
+
+        ref = slow_make.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True)
+        ).remote()
+        out_ref = consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy("n0", soft=False)
+        ).remote([ref])
+        time.sleep(0.4)
+        c.remove_node(nid)  # producer dies; the DRIVER owner survives
+        assert ca.get(out_ref, timeout=120) == int(np.arange(2000).sum())
+    finally:
+        c.shutdown()
