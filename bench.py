@@ -60,12 +60,23 @@ def bench_core():
         best_tasks = max(best_tasks, n_small / (time.time() - t0))
     log(f"tasks_async_per_s: {best_tasks:.1f} (baseline 8032.4)")
 
+    from cluster_anywhere_tpu.core.protocol import wire_stats
+
+    ws0 = wire_stats()
     best_actor = 0.0
     for _ in range(rounds):
         t0 = time.time()
         ca.get([actor.ping.remote() for _ in range(n_small)], timeout=120)
         best_actor = max(best_actor, n_small / (time.time() - t0))
     log(f"actor_calls_async_per_s: {best_actor:.1f} (baseline 8107.0)")
+    ws1 = wire_stats()
+    d_msgs = ws1["messages_sent"] - ws0["messages_sent"]
+    d_frames = ws1["frames_sent"] - ws0["frames_sent"]
+    log(
+        f"rpc_batching[actor burst]: {d_msgs} logical msgs in {d_frames} frames "
+        f"({d_msgs / max(1, d_frames):.1f} msgs/frame, "
+        f"{ws1['template_renders'] - ws0['template_renders']} template renders)"
+    )
 
     n_sync = 100 if QUICK else 500
     t0 = time.time()
@@ -313,20 +324,40 @@ def bench_model():
         log(f"model bench skipped: {type(e).__name__}: {e}")
 
 
-def _device_probe_ok(timeout_s: float = 180) -> bool:
-    """Probe accelerator availability in a subprocess: a wedged device tunnel
-    makes jax.devices() hang forever, which must not take the whole bench
-    down with it."""
+def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
+    """Probe accelerator availability in a subprocess with a HARD timeout.
+
+    A wedged device tunnel makes jax.devices() hang forever, which must not
+    take the whole bench down with it.  subprocess.run(capture_output=True)
+    is NOT safe here: on timeout it kills the child but then blocks in
+    communicate() waiting for the pipes to close — and the accelerator
+    runtime forks helpers that inherit them, so the old implementation hung
+    right after printing nothing (BENCH_r05 "probe hung").  Instead: no
+    pipes at all, a fresh process group, and a group-wide SIGKILL on
+    timeout so helper processes die with the probe."""
+    import signal
     import subprocess
 
+    if timeout_s is None:
+        timeout_s = 30 if QUICK else 120
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,  # its own process group: killable as a unit
+    )
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
+        return proc.wait(timeout=timeout_s) == 0
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass  # unreapable zombie: the skip still proceeds cleanly
         return False
 
 

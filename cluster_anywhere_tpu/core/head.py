@@ -2090,10 +2090,17 @@ class Head:
         reply(total=self._agg_total(), available=self._agg_avail())
 
     async def _h_stats(self, state, msg, reply, reply_err):
+        from .protocol import wire_stats
+
+        # the head's own frame/message counters prove control-plane
+        # amortization end-to-end: rpc_messages_* / rpc_frames_* > 1 means
+        # batch envelopes are doing their job (shown by `ca status`)
+        wire = {f"rpc_{k}": v for k, v in wire_stats().items()}
         reply(
             rpc_counts=dict(self.rpc_counts),
             stats=dict(
                 self.stats,
+                **wire,
                 pending_leases=len(self.pending_leases),
                 idle_workers=sum(
                     len(d) for n in self._alive_nodes() for d in n.idle.values()
@@ -2568,8 +2575,20 @@ def main():
             loop.set_task_factory(asyncio.eager_task_factory)
         return loop
 
-    with asyncio.Runner(loop_factory=_loop_factory) as runner:
-        runner.run(head.run())
+    if hasattr(asyncio, "Runner"):  # 3.11+
+        with asyncio.Runner(loop_factory=_loop_factory) as runner:
+            runner.run(head.run())
+    else:
+        loop = _loop_factory()
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(head.run())
+        finally:
+            try:
+                loop.run_until_complete(loop.shutdown_asyncgens())
+            finally:
+                asyncio.set_event_loop(None)
+                loop.close()
 
 
 if __name__ == "__main__":

@@ -38,7 +38,7 @@ from .function_manager import FunctionManager
 from .ids import ActorID, JobID, ObjectID, TaskID, _Counter
 from .object_ref import DeviceRef, ObjectRef
 from .object_store import MemoryStore, ShmObjectStore, _Entry
-from .protocol import Connection, connect_addr, spawn_bg
+from .protocol import WIRE_STATS, Connection, MsgTemplate, connect_addr, spawn_bg
 from .reference_counter import ReferenceCounter
 
 _global_worker: Optional["Worker"] = None
@@ -437,6 +437,7 @@ class Worker:
     """Per-process core runtime."""
 
     _OWNER_ADDR_NEG_TTL = 5.0  # seconds a failed owner-address lookup caches
+    _REFS_FLUSH_DELAY_S = 0.002  # refcount debounce window (IO-loop timer)
 
     def __init__(
         self,
@@ -548,6 +549,18 @@ class Worker:
         self._submit_queue: deque = deque()
         self._submit_wakeup_pending = False
         self._submit_lock = threading.Lock()
+        # refcount piggyback/debounce: every obj_refs update (owner counts,
+        # value pins, transit pins) coalesces into this per-holder dirty map
+        # on the IO loop and flushes as ONE notify per holder after a short
+        # timer — a 4k-object burst of inc/dec churn becomes a handful of
+        # logical messages riding the outgoing batch envelopes instead of a
+        # message per object.  Keyed (as_id, ttl); values {"inc": set,
+        # "dec": set}.
+        self._ref_pending: Dict[tuple, dict] = {}
+        self._ref_flush_scheduled = False
+        # pre-encoded task-spec templates for the argless fast paths, keyed by
+        # the spec's constant fields (fn/actor+method, num_returns, retriable)
+        self._spec_templates: Dict[tuple, MsgTemplate] = {}
         self._stopped = False
         self._head_fenced = False  # head refused re-registration: must exit
         self._external_loop = loop is not None
@@ -751,17 +764,83 @@ class Worker:
         return True
 
     def _flush_refs(self, inc: List[bytes], dec: List[bytes]):
-        def _send():
-            if self.head is not None and not self.head.closed:
+        self._queue_refs(inc, dec)
+
+    # ------------------------------------------------- refcount coalescing
+    def _queue_refs(self, inc, dec, as_id: Optional[str] = None, ttl: bool = False):
+        """Queue an obj_refs update from any thread (debounced send)."""
+        try:
+            self.loop.call_soon_threadsafe(
+                self._queue_refs_on_loop, inc, dec, as_id, ttl
+            )
+        except RuntimeError:
+            pass  # loop closed (shutdown)
+
+    def _queue_refs_on_loop(self, inc, dec, as_id=None, ttl=False):
+        """IO-loop half: merge into the dirty map and arm the flush timer.
+
+        Merge rules (per holder id):
+          - inc then dec in one window are BOTH kept — the head must process
+            the add before the release, or `owner_released` (which only a dec
+            from the owner sets) would never fire and the object would leak.
+            The flush ships every inc of the window before any dec
+            (two-phase), so the pair arrives in the safe order.
+          - dec then inc (drop to zero, then a revived handle) CANCEL: the
+            process holds the object again, and the head never stopped
+            thinking so.  Shipping both would instead release a ref we
+            still hold.
+        """
+        key = (as_id, ttl)
+        ent = self._ref_pending.get(key)
+        if ent is None:
+            ent = self._ref_pending[key] = {"inc": set(), "dec": set()}
+        else:
+            WIRE_STATS["refcount_flushes_suppressed"] += 1
+        for oid in inc:
+            if oid in ent["dec"]:
+                # a pending release followed by a revival: cancel the dec —
+                # whatever inc state the window already carries is again the
+                # truth (covers dec→inc and inc→dec→inc alike)
+                ent["dec"].discard(oid)
+            else:
+                ent["inc"].add(oid)
+        ent["dec"].update(dec)
+        if not self._ref_flush_scheduled:
+            self._ref_flush_scheduled = True
+            self.loop.call_later(self._REFS_FLUSH_DELAY_S, self._flush_ref_pending)
+
+    def _flush_ref_pending(self):
+        """Send the coalesced obj_refs updates, riding whatever batch
+        envelope the cork assembles this tick.
+
+        Two phases — every inc of the window ships before any dec — because
+        holder keys are flushed independently and a dec that reaches the
+        head before a DIFFERENT key's inc for the same object could GC it
+        under a live pin (dec fires _obj_maybe_gc; the late inc would strand
+        in _early_refs).  Promoting an inc is always safe: at worst the
+        object lives until its paired dec in a later message of the same
+        flush, which the head processes in socket order."""
+        self._ref_flush_scheduled = False
+        if not self._ref_pending:
+            return
+        pending, self._ref_pending = self._ref_pending, {}
+        head = self.head
+        if head is None or head.closed:
+            return  # head down: same drop-on-floor as the old notify path
+        for phase in ("inc", "dec"):
+            for (as_id, ttl), ent in pending.items():
+                oids = list(ent[phase])
+                if not oids:
+                    continue
+                fields: Dict[str, Any] = {phase: oids}
+                if as_id is not None:
+                    fields["as_id"] = as_id
+                if ttl and phase == "inc":
+                    fields["ttl"] = True
                 try:
-                    self.head.notify("obj_refs", inc=inc, dec=dec)
+                    head.notify("obj_refs", **fields)
                 except Exception:
                     pass
-
-        try:
-            self.loop.call_soon_threadsafe(_send)
-        except RuntimeError:
-            pass
 
     def _normalize_peer_addr(self, addr: str) -> str:
         """Remote clients may receive TCP duals bound to a wildcard host
@@ -1486,27 +1565,15 @@ class Worker:
 
     def _make_value_pin(self, oid: ObjectID):
         """Register a value-holder for an arena-backed object and return the
-        callback that releases it (runs from GC in any thread)."""
+        callback that releases it (runs from GC in any thread).  Pin and
+        unpin ride the debounced obj_refs coalescer: a flood of zero-copy
+        reads costs a handful of logical messages, not one per object."""
         pin_id = f"{self.client_id}#v"
         oid_b = oid.binary()
-
-        def _send(inc, dec):
-            def _notify():
-                if self.head is not None and not self.head.closed:
-                    try:
-                        self.head.notify("obj_refs", inc=inc, dec=dec, as_id=pin_id)
-                    except Exception:
-                        pass
-
-            try:
-                self.loop.call_soon_threadsafe(_notify)
-            except RuntimeError:
-                pass
-
-        _send([oid_b], [])
+        self._queue_refs([oid_b], [], as_id=pin_id)
 
         def _unpin():
-            _send([], [oid_b])
+            self._queue_refs([], [oid_b], as_id=pin_id)
 
         return _unpin
 
@@ -1554,7 +1621,7 @@ class Worker:
         pin_id = f"{self.client_id}#v"
 
         def _unpin():
-            self._notify_threadsafe("obj_refs", inc=[], dec=[oid_b], as_id=pin_id)
+            self._queue_refs([], [oid_b], as_id=pin_id)
 
         return _unpin
 
@@ -2010,7 +2077,7 @@ class Worker:
         nested objects to shm so borrowers can actually fetch them."""
         self._promote_nested(nested)
         token = f"t:{self.client_id}:{self._put_counter.next()}"
-        self._notify_threadsafe("obj_refs", inc=list(nested), as_id=token)
+        self._queue_refs(list(nested), [], as_id=token)
         return token
 
     def transit_done(self, token: str, roids: List[bytes],
@@ -2050,9 +2117,7 @@ class Worker:
             return {"v": blob}
         await self._promote_nested_async(nested)
         token = f"t:{self.client_id}:{self._put_counter.next()}"
-        self._notify_threadsafe(
-            "obj_refs", inc=list(nested), as_id=token, ttl=bool(ttl_pin)
-        )
+        self._queue_refs(list(nested), [], as_id=token, ttl=bool(ttl_pin))
         return {"v": blob, "t": token, "roids": nested}
 
     async def _build_arg(self, value: Any) -> dict:
@@ -2241,24 +2306,41 @@ class Worker:
             else:
                 self._store_results(oids, msg["results"], addr)
 
+        tmpl = self._task_spec_template(
+            ("task", fn_id, opts.get("num_returns", 1)),
+            lambda: {
+                "m": "push_task",
+                "fn_id": fn_id,
+                "owner": self.client_id,
+                "args": [],
+                "kwargs": {},
+                "num_returns": opts.get("num_returns", 1),
+                "retriable": opts.get("max_retries", self.config.default_max_retries) > 0,
+            },
+            retriable=opts.get("max_retries", self.config.default_max_retries) > 0,
+        )
         try:
-            conn.call_cb(
-                "push_task",
-                on_reply,
-                task_id=task_id.binary(),
-                fn_id=fn_id,
-                owner=self.client_id,
-                args=[],
-                kwargs={},
-                num_returns=opts.get("num_returns", 1),
-                retriable=opts.get("max_retries", self.config.default_max_retries) > 0,
-            )
+            conn.call_template("push_task", tmpl, on_reply, task_id.binary())
         except ConnectionError:
             self._inflight_tasks.pop(task_id.binary(), None)
             lease.inflight -= 1
             lease.dead = True
             return False
         return True
+
+    def _task_spec_template(self, key: tuple, fields_fn, retriable: bool) -> MsgTemplate:
+        """Cached pre-encoded spec for the argless fast paths: the constant
+        fields (function descriptor / actor method, options) are msgpack'd
+        once; per call only the request id and task id are encoded."""
+        key = key + (retriable,)
+        tmpl = self._spec_templates.get(key)
+        if tmpl is None:
+            if len(self._spec_templates) > 4096:
+                self._spec_templates.clear()  # runaway-fn_id backstop
+            tmpl = self._spec_templates[key] = MsgTemplate(
+                fields_fn(), ("i", "task_id")
+            )
+        return tmpl
 
     def _shape_of(self, opts) -> Dict[str, float]:
         shape = dict(opts.get("resources") or {})
@@ -2394,7 +2476,7 @@ class Worker:
                         if self.reference_counter.local_count(ObjectID(r)) == 0
                     ]
                     if dec:
-                        self._notify_threadsafe("obj_refs", inc=[], dec=dec)
+                        self._queue_refs([], dec)
                 continue
             if "e" in res:
                 import pickle
@@ -2540,19 +2622,22 @@ class Worker:
             else:
                 self._store_results(oids, msg["results"], addr)
 
+        tmpl = self._task_spec_template(
+            ("actor", aid, method, opts.get("num_returns", 1)),
+            lambda: {
+                "m": "actor_call",
+                "actor_id": aid,
+                "method": method,
+                "owner": self.client_id,
+                "args": [],
+                "kwargs": {},
+                "num_returns": opts.get("num_returns", 1),
+                "retriable": opts.get("max_task_retries", 0) > 0,
+            },
+            retriable=opts.get("max_task_retries", 0) > 0,
+        )
         try:
-            conn.call_cb(
-                "actor_call",
-                on_reply,
-                actor_id=aid,
-                method=method,
-                task_id=task_id.binary(),
-                owner=self.client_id,
-                args=[],
-                kwargs={},
-                num_returns=opts.get("num_returns", 1),
-                retriable=opts.get("max_task_retries", 0) > 0,
-            )
+            conn.call_template("actor_call", tmpl, on_reply, task_id.binary())
         except ConnectionError:
             return self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
         return None
@@ -2696,6 +2781,12 @@ class Worker:
                 pass
 
         async def _close_all():
+            # force out any debounce-window refcount updates before the
+            # connections close (the timer may not have fired yet)
+            try:
+                self._flush_ref_pending()
+            except Exception:
+                pass
             # cancel + await housekeeping first: a bare loop.stop() would
             # destroy it mid-await ("Task was destroyed but it is pending")
             task = getattr(self, "_housekeeping_task", None)

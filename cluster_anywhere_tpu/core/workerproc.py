@@ -27,7 +27,13 @@ from .config import CAConfig, set_config
 from .errors import TaskCancelledError, TaskError
 from .ids import ActorID, ObjectID, TaskID
 from .object_ref import ObjectRef
-from .protocol import Server, spawn_bg, write_frame
+from .protocol import MsgTemplate, Server, spawn_bg, write_frame, write_frame_body
+
+# completion replies on the fast path share one pre-encoded prefix; per reply
+# only the request id and the results payload are packed.  Batched with
+# whatever else the cork holds this tick, so a burst of completions travels
+# worker→submitter as a few envelope frames (amortized acks).
+_REPLY_TMPL = MsgTemplate({"ok": True}, ("i", "results"))
 from .worker import Worker, _device_spec, _is_device_value, set_global_worker
 
 
@@ -624,7 +630,7 @@ class WorkerProcess:
                     except Exception:
                         pass
                 if rid is not None:
-                    write_frame(writer, {"i": rid, "ok": True, "results": results})
+                    write_frame_body(writer, _REPLY_TMPL.render(rid, results))
                 self._record_event(task_id, ev_name, kind, t0, ok)
                 if self._exiting:
                     spawn_bg(self._graceful_exit())

@@ -408,7 +408,9 @@ def _apply_blocks_manual(blocks, x, cfg: TransformerConfig, mesh, manual_axes):
     batch_axis = "ep" if ep_manual else None
     x_spec = P(batch_axis, "sp" if sp_manual else None, None)
     aux_spec = P()
-    out, aux = jax.shard_map(
+    from ..parallel.compat import shard_map as _shard_map
+
+    out, aux = _shard_map(
         inner,
         mesh=mesh,
         in_specs=(block_specs, x_spec),

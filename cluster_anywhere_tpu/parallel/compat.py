@@ -1,0 +1,31 @@
+"""jax API compatibility shims.
+
+jax >= 0.6 exposes `jax.shard_map(..., axis_names=..., check_vma=...)`;
+older runtimes (some containers ship 0.4.x) only have
+`jax.experimental.shard_map.shard_map(..., auto=..., check_rep=...)`.
+One wrapper normalizes the new-style call onto whichever is installed so
+the parallel/ and models/ stacks run on both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None, check_vma=False):
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {"check_rep": check_vma}
+    if axis_names is not None:
+        # old API expresses partial-manual as the COMPLEMENT set
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

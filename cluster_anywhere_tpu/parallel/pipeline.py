@@ -95,8 +95,9 @@ def pipeline_apply(
 def pipeline_sharded(stage_fn, mesh, *, axis_name="pp", num_microbatches):
     """Wrap pipeline_apply in shard_map: stage_params must be stacked with a
     leading pp axis (params[i] = stage i); x replicated."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map
 
     def inner(stacked_params, x):
         my_params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)

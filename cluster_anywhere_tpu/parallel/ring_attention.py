@@ -167,8 +167,9 @@ def _ring_flash(q, k, v, axis_name, causal, scale, n, my_idx):
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True, use_flash=None):
     """Convenience wrapper: shard_map over the sp axis of `mesh` with
     [batch, seq, heads, dim] inputs sharded on seq."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map
 
     spec = P(None, axis_name, None, None)
     fn = functools.partial(

@@ -44,8 +44,9 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True, attn_
 
 
 def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=True):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map
 
     spec = P(None, axis_name, None, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name, causal=causal)

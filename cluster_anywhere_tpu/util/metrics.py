@@ -44,6 +44,42 @@ def _flush_loop():
         flush_once()
 
 
+# last-shipped WIRE_STATS values, so flush_once sends deltas (counter
+# semantics at the head aggregator)
+_wire_shipped: Dict[str, int] = {}
+_WIRE_DESCS = {
+    "frames_sent": "physical RPC frames written by this process",
+    "messages_sent": "logical RPC messages written by this process",
+    "batch_frames_sent": "frames that were batch envelopes (>1 message)",
+    "frames_recv": "physical RPC frames read by this process",
+    "messages_recv": "logical RPC messages read by this process",
+    "template_renders": "task-spec template fast-path encodes",
+    "refcount_flushes_suppressed": "obj_refs sends merged by the debouncer",
+}
+
+
+def _wire_records() -> List[dict]:
+    """Runtime wire counters (core/protocol.py WIRE_STATS) as ca_rpc_*
+    counter records — the observability path for the control-plane batching
+    layer (dashboard /metrics, `get_metrics_snapshot`, grafana)."""
+    from ..core.protocol import WIRE_STATS
+
+    out = []
+    tags = _tags_key(None)
+    for k, v in WIRE_STATS.items():
+        delta = v - _wire_shipped.get(k, 0)
+        if delta or k not in _wire_shipped:
+            # ship first-seen zeros too: the series exists from the first
+            # flush, so dashboards/tests can rely on its presence
+            _wire_shipped[k] = v
+            out.append(
+                {"name": f"ca_rpc_{k}", "type": "counter",
+                 "desc": _WIRE_DESCS.get(k, ""), "tags_key": tags,
+                 "value": float(delta)}
+            )
+    return out
+
+
 def flush_once():
     """Ship pending deltas to the head (called by the background flusher; also
     directly from tests for determinism)."""
@@ -57,6 +93,7 @@ def flush_once():
         metrics = list(_registry)
     for m in metrics:
         batch.extend(m._drain())
+    batch.extend(_wire_records())
     if not batch:
         return
 

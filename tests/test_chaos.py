@@ -102,6 +102,58 @@ def test_actor_restart_under_kill(fresh_cluster):
     assert pid2 is not None and pid2 != pid1
 
 
+def test_rpc_chaos_counts_logical_sends_inside_batch_envelopes(tmp_path):
+    """CA_TESTING_RPC_FAILURE="method=N" must fail exactly the first N
+    LOGICAL sends of `method` even when the survivors travel together inside
+    one `batch` envelope frame — the budget is charged per call/notify, not
+    per physical frame, so fault-injection tests keep their meaning under
+    message batching."""
+    import asyncio
+
+    from cluster_anywhere_tpu.core import protocol as P
+
+    async def run():
+        path = str(tmp_path / "chaos.sock")
+        got = []
+
+        async def handler(state, msg, reply, reply_err):
+            got.append(msg)
+            reply()
+
+        srv = P.Server(path, handler)
+        await srv.start()
+        conn = await P.connect_addr(path)
+        reset_rpc_chaos("blip=3")
+        batch_before = P.WIRE_STATS["batch_frames_sent"]
+        failed = 0
+        # one synchronous burst: everything that survives chaos is corked
+        # into a single envelope flushed on the next loop iteration
+        for i in range(10):
+            try:
+                conn.notify("blip", seq=i)
+            except ConnectionError:
+                failed += 1
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(got) < 7 and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert failed == 3, f"chaos failed {failed} logical sends, wanted 3"
+        assert [m["seq"] for m in got] == [3, 4, 5, 6, 7, 8, 9]
+        # the 7 survivors shared envelope frames (proves they were batched)
+        assert P.WIRE_STATS["batch_frames_sent"] > batch_before
+        # the budget is spent: later sends of the method go through
+        conn.notify("blip", seq=99)
+        while len(got) < 8 and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert got[-1]["seq"] == 99
+        await conn.close()
+        await srv.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        reset_rpc_chaos("")
+
+
 def test_rpc_chaos_cancel_notify_dropped(fresh_cluster):
     """A dropped cancel notify (dead connection injected) must not crash the
     owner or hang the caller: the running task completes normally (cancel is

@@ -187,6 +187,93 @@ def test_driver_tables_drain_after_refs_die(ca_cluster):
     )
 
 
+def test_refcount_debounce_released_once_under_churn(ca_cluster):
+    """A flood of handle churn (clone/drop storms, interleaved lifetimes)
+    rides the debounced obj_refs coalescer; every object must still be
+    released EXACTLY once — the arena drains fully (no leak) and values stay
+    readable while any handle is live (no double-free / premature free)."""
+    from cluster_anywhere_tpu.core.object_ref import ObjectRef
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    w = global_worker()
+    refs = [ca.put(np.full(200_000, float(i))) for i in range(16)]
+    # churn: waves of extra handles on every object, dropped immediately —
+    # each wave's inc/dec traffic coalesces in the debounce window
+    for _ in range(40):
+        clones = [ObjectRef(r.id, r.owner, w) for r in refs]
+        del clones
+    # interleaved drop of half the objects while reading the other half
+    for i, r in enumerate(refs[:8]):
+        assert ca.get(refs[8 + i])[0] == float(8 + i)  # still readable
+        del r
+    refs = refs[8:]
+    for i, r in enumerate(refs):
+        assert ca.get(r)[0] == float(8 + i)  # survived the churn intact
+    del refs, r  # the loop variable holds the last object too
+    import gc
+
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline and _driver_arena_allocated() > 0:
+        time.sleep(0.2)
+    assert _driver_arena_allocated() == 0  # every slice reclaimed once
+
+
+def test_refcount_coalescer_merges_and_cancels(ca_cluster):
+    """Unit-level contract of the obj_refs debouncer: updates queued within
+    one window merge into one send (suppressed counter), a dec→inc revival
+    cancels to a no-op, and an inc→dec pair ships both so the head still
+    sees the release.  Verified against the head's holder table."""
+    import asyncio
+
+    from cluster_anywhere_tpu.core import protocol
+    from cluster_anywhere_tpu.core.worker import global_worker
+    from cluster_anywhere_tpu.util import state
+
+    w = global_worker()
+    ref = ca.put(np.ones(200_000))  # shm-backed: registered at the head
+    oid_b = ref.id.binary()
+    base_suppressed = protocol.WIRE_STATS["refcount_flushes_suppressed"]
+
+    async def churn():
+        # 50 pin/unpin cycles for one synthetic holder, all in one window:
+        # first pair ships (inc then dec — the head must see the release),
+        # later pairs merge/cancel into it
+        for _ in range(50):
+            w._queue_refs_on_loop([oid_b], [], "test#pin", False)
+            w._queue_refs_on_loop([], [oid_b], "test#pin", False)
+
+    w.run_coro(churn())
+    assert (
+        protocol.WIRE_STATS["refcount_flushes_suppressed"] - base_suppressed >= 90
+    )
+    time.sleep(0.3)  # debounce timer + head processing
+
+    def holders():
+        for o in state.list_objects():
+            if o["object_id"] == ref.id.hex():
+                return o["num_holders"]
+        return None
+
+    # net effect of the churn is zero: only the driver's own handle remains
+    assert holders() == 1
+    # dec→inc cancellation: a revived pin within one window must leave the
+    # holder registered at the head
+    async def pin_then_revive():
+        w._queue_refs_on_loop([oid_b], [], "test#pin", False)
+        w._queue_refs_on_loop([], [oid_b], "test#pin", False)
+        w._queue_refs_on_loop([oid_b], [], "test#pin", False)
+
+    w.run_coro(pin_then_revive())
+    time.sleep(0.3)
+    assert holders() == 2  # driver + the revived synthetic pin
+    w.run_coro(churn())  # ends on an unpin-balanced window: pin released
+    time.sleep(0.3)
+    assert holders() == 1
+    assert ca.get(ref)[0] == 1.0  # object untouched throughout
+    del ref
+
+
 def test_view_survives_producer_sigkill(ca_cluster):
     """Crash-consistency of the arena sweep: a consumer holding a zero-copy
     view of a SIGKILLed producer's object keeps reading valid bytes — the

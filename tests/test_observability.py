@@ -172,6 +172,49 @@ def test_prometheus_text():
     assert "test_prom_gauge 3.5" in text
 
 
+def test_rpc_wire_counters_exposed():
+    """The control-plane batching counters (core/protocol.py WIRE_STATS)
+    flow through the metrics path as ca_rpc_* counters, and the head's own
+    wire counters ride the stats RPC (`ca status`).  Under a task burst the
+    envelope layer must show >1 logical message per physical frame."""
+    import cluster_anywhere_tpu as ca
+
+    @ca.remote
+    def noop():
+        return None
+
+    # two bursts: the second runs with the function exported, exercising the
+    # template fast path as well
+    ca.get([noop.remote() for _ in range(100)], timeout=60)
+    ca.get([noop.remote() for _ in range(200)], timeout=60)
+
+    snap = metrics.get_metrics_snapshot()
+    for name in (
+        "ca_rpc_frames_sent",
+        "ca_rpc_messages_sent",
+        "ca_rpc_batch_frames_sent",
+        "ca_rpc_frames_recv",
+        "ca_rpc_messages_recv",
+        "ca_rpc_template_renders",
+        "ca_rpc_refcount_flushes_suppressed",
+    ):
+        assert name in snap, f"{name} missing from metrics snapshot"
+        assert snap[name]["type"] == "counter"
+    frames = sum(snap["ca_rpc_frames_sent"]["data"].values())
+    msgs = sum(snap["ca_rpc_messages_sent"]["data"].values())
+    assert frames > 0
+    assert msgs / frames > 1.0, f"no batching: {msgs} msgs in {frames} frames"
+    assert sum(snap["ca_rpc_batch_frames_sent"]["data"].values()) > 0
+    assert sum(snap["ca_rpc_template_renders"]["data"].values()) > 0
+    # prometheus exposition renders them
+    text = metrics.render_prometheus(snap)
+    assert "# TYPE ca_rpc_frames_sent counter" in text
+    # the head's own counters surface through the stats RPC (`ca status`)
+    stats = ca.cluster_stats()
+    assert stats.get("rpc_messages_sent", 0) > 0
+    assert stats.get("rpc_frames_sent", 0) > 0
+
+
 def test_tracing_spans():
     tracing.enable()
 
