@@ -250,8 +250,14 @@ def cmd_memory(args):
 
 def cmd_timeline(args):
     ca = _connect(args)
-    events = ca.timeline(args.output)
-    print(f"wrote {len(events)} events to {args.output}")
+    events = ca.timeline(args.output, limit=args.limit)
+    n_flows = sum(1 for e in events if e.get("ph") == "s")
+    n_procs = sum(1 for e in events if e.get("name") == "process_name")
+    print(
+        f"wrote {len(events)} events ({n_procs} processes, {n_flows} "
+        f"submit→run flows) to {args.output}"
+    )
+    print("open in https://ui.perfetto.dev or chrome://tracing")
     ca.shutdown()
 
 
@@ -437,7 +443,12 @@ def main(argv=None):
     sp.add_argument("--limit", type=int, default=50)
     sp.set_defaults(fn=cmd_memory)
 
-    sp = sub.add_parser("timeline", help="export Chrome trace of task events")
+    sp = sub.add_parser(
+        "timeline",
+        help="export a Chrome-trace/Perfetto timeline of task lifecycles",
+    )
+    sp.add_argument("--limit", type=int, default=100_000,
+                    help="max task events to assemble")
     addr(sp)
     sp.add_argument("--output", "-o", default="timeline.json")
     sp.set_defaults(fn=cmd_timeline)

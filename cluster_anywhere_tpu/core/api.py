@@ -337,8 +337,10 @@ def cluster_stats() -> Dict[str, Any]:
     return global_worker().head_call("stats")["stats"]
 
 
-def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Chrome-trace events of task executions (see util.state.timeline)."""
+def timeline(filename: Optional[str] = None, *, limit: int = 100_000) -> List[dict]:
+    """Chrome-trace/Perfetto events of task lifecycles, with flow arrows
+    between submit and execute spans when tracing is enabled (see
+    util.state.timeline)."""
     from ..util.state import timeline as _timeline
 
-    return _timeline(filename)
+    return _timeline(filename, limit=limit)

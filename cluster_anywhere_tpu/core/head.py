@@ -22,6 +22,7 @@ deaths, and serves chunked object pulls from its node's shm namespace.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import os
 import signal
@@ -2113,9 +2114,20 @@ class Head:
         )
 
     async def _h_list_actors(self, state, msg, reply, reply_err):
-        reply(actors=[self._actor_info(a) for a in self.actors.values()])
+        # limit applied server-side: a 10k-actor table must not cross the
+        # wire to honor limit=10.  Explicit limit=0 means zero, not default.
+        limit = msg.get("limit")
+        limit = 10_000 if limit is None else limit
+        reply(
+            actors=[
+                self._actor_info(a)
+                for a in itertools.islice(self.actors.values(), limit)
+            ]
+        )
 
     async def _h_list_workers(self, state, msg, reply, reply_err):
+        limit = msg.get("limit")
+        limit = 10_000 if limit is None else limit
         reply(
             workers=[
                 {
@@ -2125,7 +2137,7 @@ class Head:
                     "actor_id": w.actor_id,
                     "node_id": w.node_id,
                 }
-                for w in self.workers.values()
+                for w in itertools.islice(self.workers.values(), limit)
             ]
         )
 
@@ -2134,12 +2146,25 @@ class Head:
 
     async def _h_list_task_events(self, state, msg, reply, reply_err):
         events = list(self.task_events)
+        if msg.get("terminal"):
+            # terminal-executions view: drop lifecycle phases and app spans
+            # BEFORE the limit, so limit=N means N executions even when
+            # tracing multiplies ring entries per task
+            events = [
+                e for e in events
+                if e.get("end") is not None
+                and e.get("state") in ("FINISHED", "FAILED")
+            ]
         name = msg.get("name")
         if name:
             events = [e for e in events if e.get("name") == name]
         st = msg.get("state")
         if st:
             events = [e for e in events if e.get("state") == st]
+        tid = msg.get("task_id")
+        if tid:
+            # trace assembly: all lifecycle phases of one task
+            events = [e for e in events if e.get("task_id") == tid]
         limit = msg.get("limit") or 10_000
         reply(events=events[-limit:])
 

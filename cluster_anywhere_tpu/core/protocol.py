@@ -23,6 +23,13 @@ into ONE physical frame — a `batch` envelope {"m": "batch", "b": [msg, ...]}
 transparently expand envelopes back into logical messages; chaos budgets and
 per-method stats count LOGICAL messages, never physical frames.
 
+Trace context: logical task/actor-call messages may carry a small optional
+`tr` field (TRACE_FIELD) — {"tid": trace id, "sid": parent span id} — minted
+at remote() submission when util/tracing is enabled.  Batch envelopes splice
+already-encoded whole message bodies, so the field survives corking/batching
+untouched; receivers read it off the logical message like any other field.
+Disabled tracing sends nothing (no field, no bytes).
+
 A deterministic fault-injection hook mirrors the reference's RPC chaos
 (src/ray/rpc/rpc_chaos.h): CA_TESTING_RPC_FAILURE="method=N,method2=M" makes
 the first N sends of `method` raise ConnectionError before the write.  The
@@ -46,6 +53,10 @@ from .config import get_config
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 31
+
+# optional trace-context field on logical task/actor-call messages (see
+# util/tracing.py); single definition so submit and execute sides agree
+TRACE_FIELD = "tr"
 
 # Per-process wire counters (control-plane amortization observability).
 # Plain ints in a module dict: incremented on hot paths, so no locks — the

@@ -38,11 +38,25 @@ from .function_manager import FunctionManager
 from .ids import ActorID, JobID, ObjectID, TaskID, _Counter
 from .object_ref import DeviceRef, ObjectRef
 from .object_store import MemoryStore, ShmObjectStore, _Entry
-from .protocol import WIRE_STATS, Connection, MsgTemplate, connect_addr, spawn_bg
+from .protocol import (
+    TRACE_FIELD,
+    WIRE_STATS,
+    Connection,
+    MsgTemplate,
+    connect_addr,
+    spawn_bg,
+)
 from .reference_counter import ReferenceCounter
 
 _global_worker: Optional["Worker"] = None
 _global_lock = threading.Lock()
+
+# set to the util.tracing module by tracing.enable() (None = tracing off).
+# Submission hot paths read it with one attribute load + branch, so the
+# disabled path adds no per-call allocations (acceptance constraint); a
+# direct top-level import would also be circular (util.state imports this
+# module at import time).
+TRACE_HOOK: Optional[Any] = None
 
 
 def global_worker() -> "Worker":
@@ -287,6 +301,12 @@ class LeasePool:
     def enqueue_fast(self, task_id, fn_id, opts, oids) -> None:
         """Queue an argless known-function task for callback-drained push
         (IO thread only).  Counts as demand so growth/pipelining see it."""
+        trace = opts.get("_trace")
+        if trace is not None and TRACE_HOOK is not None:
+            TRACE_HOOK.record_task_event(
+                task_id.hex(), None, "task", "QUEUED", trace=trace,
+                worker_id=self.worker.client_id, node_id=self.worker.node_id,
+            )
         self.inflight_total += 1
         self.backlog.append((task_id, fn_id, opts, oids))
         self._maybe_grow()
@@ -729,6 +749,30 @@ class Worker:
                 except Exception:
                     pass
             self.reference_counter.flush()
+            self._flush_task_events()
+
+    _TASK_EVENTS_CHUNK = 5000  # bounded notify frames after a long restage
+
+    def _flush_task_events(self):
+        """Ship buffered lifecycle/span events to the head's task_events ring
+        (IO loop only).  Events drained while the head is unreachable are
+        re-staged, not lost.  Sent in bounded chunks: a buffer that grew
+        toward the cap during a head outage must not become one giant frame
+        that stalls the IO loop right as the cluster recovers."""
+        from ..util import tracing
+
+        if self.head is None or self.head.closed:
+            return  # leave the buffer in place; no drain/restage churn
+        events = tracing.drain_events()
+        if not events:
+            return
+        chunk = self._TASK_EVENTS_CHUNK
+        for i in range(0, len(events), chunk):
+            try:
+                self.head.notify("task_events", events=events[i : i + chunk])
+            except Exception:
+                tracing.restage_events(events[i:])
+                return
 
     async def _reconnect_head(self) -> bool:
         """Redial and re-register with the head (gcs_client_reconnection
@@ -1119,6 +1163,13 @@ class Worker:
         st = StreamState(task_id)
         self._streams[task_id.binary()] = st
         fn_id, blob = self.fn_manager.export(fn)
+        if TRACE_HOOK is not None:
+            _tr = TRACE_HOOK.begin_task_trace(
+                task_id.hex(), getattr(fn, "__name__", "stream"), "task",
+                self.client_id, self.node_id,
+            )
+            if _tr is not None:
+                opts = dict(opts, _trace=_tr)
         self._pump_submit(
             lambda: self._submit_stream(task_id, st, fn_id, blob, args, kwargs, opts, None)
         )
@@ -1131,6 +1182,12 @@ class Worker:
         st = StreamState(task_id)
         self._streams[task_id.binary()] = st
         opts = dict(opts, method=method)
+        if TRACE_HOOK is not None:
+            _tr = TRACE_HOOK.begin_task_trace(
+                task_id.hex(), method, "actor_task", self.client_id, self.node_id
+            )
+            if _tr is not None:
+                opts["_trace"] = _tr
         self._pump_submit(
             lambda: self._submit_stream(
                 task_id, st, None, None, args, kwargs, opts, actor_id.hex()
@@ -1167,6 +1224,16 @@ class Worker:
                 num_returns="streaming",
                 timeout=None,
             )
+            trace = opts.get("_trace")
+            if trace is not None:
+                fields[TRACE_FIELD] = trace
+                if TRACE_HOOK is not None:
+                    TRACE_HOOK.record_task_event(
+                        task_id.hex(), None,
+                        "task" if actor_hex is None else "actor_task",
+                        "SCHEDULED", trace=trace, worker_id=self.client_id,
+                        node_id=self.node_id,
+                    )
             if actor_hex is None:
                 reply = await conn.call(
                     "push_task", fn_id=fn_id,
@@ -2206,6 +2273,13 @@ class Worker:
             opts["runtime_env"] = self._prepare_runtime_env(opts["runtime_env"])
         num_returns = opts.get("num_returns", 1)
         task_id = TaskID.for_normal_task(self.job_id)
+        if TRACE_HOOK is not None:
+            _tr = TRACE_HOOK.begin_task_trace(
+                task_id.hex(), getattr(fn, "__name__", "task"), "task",
+                self.client_id, self.node_id,
+            )
+            if _tr is not None:
+                opts = dict(opts, _trace=_tr)
         oids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
         for oid in oids:
             self.memory_store.mark_pending(oid)
@@ -2306,21 +2380,47 @@ class Worker:
             else:
                 self._store_results(oids, msg["results"], addr)
 
-        tmpl = self._task_spec_template(
-            ("task", fn_id, opts.get("num_returns", 1)),
-            lambda: {
+        trace = opts.get("_trace")
+        num_returns = opts.get("num_returns", 1)
+        retriable = opts.get("max_retries", self.config.default_max_retries) > 0
+
+        def spec_fields():
+            # one definition for both the template constants and the traced
+            # full-encode path — they must never drift apart
+            return {
                 "m": "push_task",
                 "fn_id": fn_id,
                 "owner": self.client_id,
                 "args": [],
                 "kwargs": {},
-                "num_returns": opts.get("num_returns", 1),
-                "retriable": opts.get("max_retries", self.config.default_max_retries) > 0,
-            },
-            retriable=opts.get("max_retries", self.config.default_max_retries) > 0,
-        )
+                "num_returns": num_returns,
+                "retriable": retriable,
+            }
+
         try:
-            conn.call_template("push_task", tmpl, on_reply, task_id.binary())
+            if trace is None:
+                tmpl = self._task_spec_template(
+                    ("task", fn_id, num_returns), spec_fields, retriable=retriable
+                )
+                conn.call_template("push_task", tmpl, on_reply, task_id.binary())
+            else:
+                # traced push: the pre-encoded template cannot carry a
+                # per-call field, so the spec is encoded in full with the
+                # trace context riding the same corked envelope
+                if TRACE_HOOK is not None:
+                    TRACE_HOOK.record_task_event(
+                        task_id.hex(), None, "task", "SCHEDULED", trace=trace,
+                        worker_id=self.client_id, node_id=self.node_id,
+                        target=lease.worker_id,
+                    )
+                fields = spec_fields()
+                del fields["m"]  # call_cb supplies the method
+                conn.call_cb(
+                    "push_task", on_reply,
+                    task_id=task_id.binary(),
+                    **fields,
+                    **{TRACE_FIELD: trace},
+                )
         except ConnectionError:
             self._inflight_tasks.pop(task_id.binary(), None)
             lease.inflight -= 1
@@ -2376,6 +2476,12 @@ class Worker:
             return
         retries = opts.get("max_retries", self.config.default_max_retries)
         pool = self._lease_pool(opts)
+        trace = opts.get("_trace")
+        if trace is not None and TRACE_HOOK is not None:
+            TRACE_HOOK.record_task_event(
+                task_id.hex(), None, "task", "QUEUED", trace=trace,
+                worker_id=self.client_id, node_id=self.node_id,
+            )
         while True:
             try:
                 lease = await pool.acquire()
@@ -2393,6 +2499,12 @@ class Worker:
             )
             try:
                 conn = await self.conn_to(lease.addr)
+                if trace is not None and TRACE_HOOK is not None:
+                    TRACE_HOOK.record_task_event(
+                        task_id.hex(), None, "task", "SCHEDULED", trace=trace,
+                        worker_id=self.client_id, node_id=self.node_id,
+                        target=lease.worker_id,
+                    )
                 # no RPC timeout here: the reply arrives only after the task
                 # finishes, which may legitimately take arbitrarily long;
                 # worker death is detected by the connection breaking.
@@ -2407,6 +2519,7 @@ class Worker:
                     runtime_env=opts.get("runtime_env"),
                     retriable=retries > 0,
                     timeout=None,
+                    **({TRACE_FIELD: trace} if trace is not None else {}),
                 )
             except ConnectionError as e:
                 dead = True
@@ -2576,6 +2689,12 @@ class Worker:
     def submit_actor_task(self, actor_id: ActorID, method: str, args, kwargs, opts) -> List[ObjectRef]:
         num_returns = opts.get("num_returns", 1)
         task_id = TaskID.for_actor_task(actor_id)
+        if TRACE_HOOK is not None:
+            _tr = TRACE_HOOK.begin_task_trace(
+                task_id.hex(), method, "actor_task", self.client_id, self.node_id,
+            )
+            if _tr is not None:
+                opts = dict(opts, _trace=_tr)
         oids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
         for oid in oids:
             self.memory_store.mark_pending(oid)
@@ -2622,22 +2741,47 @@ class Worker:
             else:
                 self._store_results(oids, msg["results"], addr)
 
-        tmpl = self._task_spec_template(
-            ("actor", aid, method, opts.get("num_returns", 1)),
-            lambda: {
+        trace = opts.get("_trace")
+        num_returns = opts.get("num_returns", 1)
+        retriable = opts.get("max_task_retries", 0) > 0
+
+        def spec_fields():
+            # shared by the template constants and the traced full encode
+            return {
                 "m": "actor_call",
                 "actor_id": aid,
                 "method": method,
                 "owner": self.client_id,
                 "args": [],
                 "kwargs": {},
-                "num_returns": opts.get("num_returns", 1),
-                "retriable": opts.get("max_task_retries", 0) > 0,
-            },
-            retriable=opts.get("max_task_retries", 0) > 0,
-        )
+                "num_returns": num_returns,
+                "retriable": retriable,
+            }
+
         try:
-            conn.call_template("actor_call", tmpl, on_reply, task_id.binary())
+            if trace is None:
+                tmpl = self._task_spec_template(
+                    ("actor", aid, method, num_returns), spec_fields,
+                    retriable=retriable,
+                )
+                conn.call_template("actor_call", tmpl, on_reply, task_id.binary())
+            else:
+                # traced call: full spec with the trace context (the template
+                # cannot carry a per-call field)
+                if TRACE_HOOK is not None:
+                    TRACE_HOOK.record_task_event(
+                        task_id.hex(), None, "actor_task", "SCHEDULED",
+                        trace=trace, worker_id=self.client_id,
+                        node_id=self.node_id, target=aid,
+                    )
+                fields = spec_fields()
+                del fields["m"]  # call_cb supplies the method
+                conn.call_cb(
+                    "actor_call", on_reply,
+                    task_id=task_id.binary(),
+                    **fields,
+                    **{TRACE_FIELD: trace},
+                )
         except ConnectionError:
             return self._submit_actor_task(actor_id, method, args, kwargs, opts, task_id, oids)
         return None
@@ -2652,10 +2796,17 @@ class Worker:
         attempts = 1 + max(0, opts.get("max_task_retries", 0))
         last_err: Optional[BaseException] = None
         refresh = False
+        trace = opts.get("_trace")
         for _ in range(attempts + 1):
             try:
                 addr = await self._actor_addr(aid, refresh=refresh)
                 conn = await self.conn_to(addr)
+                if trace is not None and TRACE_HOOK is not None:
+                    TRACE_HOOK.record_task_event(
+                        task_id.hex(), None, "actor_task", "SCHEDULED",
+                        trace=trace, worker_id=self.client_id,
+                        node_id=self.node_id, target=aid,
+                    )
                 self._inflight_tasks[task_id.binary()] = self._normalize_peer_addr(addr)
                 try:
                     reply = await conn.call(
@@ -2669,6 +2820,7 @@ class Worker:
                         num_returns=opts.get("num_returns", 1),
                         retriable=opts.get("max_task_retries", 0) > 0,
                         timeout=None,
+                        **({TRACE_FIELD: trace} if trace is not None else {}),
                     )
                 finally:
                     self._inflight_tasks.pop(task_id.binary(), None)
@@ -2785,6 +2937,11 @@ class Worker:
             # connections close (the timer may not have fired yet)
             try:
                 self._flush_ref_pending()
+            except Exception:
+                pass
+            # last lifecycle events out before the head connection closes
+            try:
+                self._flush_task_events()
             except Exception:
                 pass
             # cancel + await housekeeping first: a bare loop.stop() would

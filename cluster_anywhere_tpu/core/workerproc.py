@@ -27,7 +27,14 @@ from .config import CAConfig, set_config
 from .errors import TaskCancelledError, TaskError
 from .ids import ActorID, ObjectID, TaskID
 from .object_ref import ObjectRef
-from .protocol import MsgTemplate, Server, spawn_bg, write_frame, write_frame_body
+from .protocol import (
+    TRACE_FIELD,
+    MsgTemplate,
+    Server,
+    spawn_bg,
+    write_frame,
+    write_frame_body,
+)
 
 # completion replies on the fast path share one pre-encoded prefix; per reply
 # only the request id and the results payload are packed.  Batched with
@@ -35,6 +42,10 @@ from .protocol import MsgTemplate, Server, spawn_bg, write_frame, write_frame_bo
 # worker→submitter as a few envelope frames (amortized acks).
 _REPLY_TMPL = MsgTemplate({"ok": True}, ("i", "results"))
 from .worker import Worker, _device_spec, _is_device_value, set_global_worker
+
+# imported after .worker so the util package's own core imports resolve
+# against a fully-initialized module
+from ..util import tracing
 
 
 class ActorContext:
@@ -95,9 +106,6 @@ class WorkerProcess:
         # async actor-method tasks in flight: task_id -> asyncio.Task
         # (cancellation for coroutines is task.cancel(), not async exc)
         self._async_running: Dict[bytes, Any] = {}
-        # task events buffered here, flushed to the head by the heartbeat loop
-        # (analogue of core_worker/task_event_buffer.h -> GcsTaskManager)
-        self._task_events: List[dict] = []
 
     # ----------------------------------------------------------- args/results
     def _resolve_arg(self, spec: dict) -> Any:
@@ -216,6 +224,19 @@ class WorkerProcess:
         wrong task (cancel raced the pool thread finishing its target and
         starting us): re-run once — same at-least-once semantics as a
         worker-death retry."""
+        tr = msg.get(TRACE_FIELD)
+        token = None
+        if tr is not None:
+            # install the submitter's trace context as ambient for this
+            # executor thread: nested remote() calls and tracing.span()
+            # blocks inside user code chain into the same trace
+            token = tracing.push_execution(tr)
+            self._record_running(
+                task_id,
+                msg.get("method") or getattr(fn, "__name__", "task"),
+                "actor_task" if actor_id else "task",
+                tr,
+            )
         try:
             return self._exec_sync_inner(fn, msg, task_id, actor_id)
         except TaskCancelledError:
@@ -247,6 +268,8 @@ class WorkerProcess:
                     pass
             raise
         finally:
+            if token is not None:
+                tracing.pop_execution(token)
             if self._cancel_requested or self._precancelled:
                 # backstop for the delivery race: retract any async
                 # exception still pending on THIS thread before it returns
@@ -340,20 +363,31 @@ class WorkerProcess:
             # trailing clear is the backstop)
             ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
 
-    def _record_event(self, task_id: bytes, name: str, kind: str, t0: float, ok: bool):
+    def _record_event(
+        self, task_id: bytes, name: str, kind: str, t0: float, ok: bool,
+        trace: Optional[dict] = None,
+    ):
         import time as _time
 
-        self._task_events.append(
-            {
-                "task_id": task_id.hex(),
-                "name": name,
-                "type": kind,
-                "worker_id": self.worker_id,
-                "actor_id": self.actor.actor_id if self.actor else None,
-                "state": "FINISHED" if ok else "FAILED",
-                "start": t0,
-                "end": _time.time(),
-            }
+        tracing.record_task_event(
+            task_id.hex(), name, kind,
+            "FINISHED" if ok else "FAILED",
+            trace=trace,
+            worker_id=self.worker_id,
+            node_id=self.worker.node_id if self.worker is not None else None,
+            actor_id=self.actor.actor_id if self.actor else None,
+            start=t0,
+            end=_time.time(),
+        )
+
+    def _record_running(self, task_id: bytes, name: Optional[str], kind: str, tr: dict):
+        """Lifecycle RUNNING phase (only for traced tasks: `tr` came over
+        the wire, so tracing was enabled at the submitter)."""
+        tracing.record_task_event(
+            task_id.hex(), name, kind, "RUNNING",
+            trace=tr,
+            worker_id=self.worker_id,
+            node_id=self.worker.node_id if self.worker is not None else None,
         )
 
     async def _execute(self, msg, is_actor_call: bool) -> List[dict]:
@@ -362,6 +396,7 @@ class WorkerProcess:
         num_returns = msg.get("num_returns", 1)
         task_id = msg.get("task_id") or os.urandom(16)
         t0 = _time.time()
+        tr = msg.get(TRACE_FIELD)
         ev_name = msg.get("method") if is_actor_call else None
         try:
             if is_actor_call:
@@ -384,8 +419,20 @@ class WorkerProcess:
                     )
                     sem = self._semaphore_for(method)
                     async with sem if sem is not None else contextlib.nullcontext():
-                        # tracked so ca.cancel() can asyncio-cancel it
-                        coro_task = asyncio.ensure_future(method(*args, **kwargs))
+                        # tracked so ca.cancel() can asyncio-cancel it.  The
+                        # ambient trace context is installed around task
+                        # creation only: coroutines snapshot it then, so the
+                        # method body (and anything it submits) is traced
+                        # without leaking context onto the shared loop
+                        token = None
+                        if tr is not None:
+                            token = tracing.push_execution(tr)
+                            self._record_running(task_id, ev_name, "actor_task", tr)
+                        try:
+                            coro_task = asyncio.ensure_future(method(*args, **kwargs))
+                        finally:
+                            if token is not None:
+                                tracing.pop_execution(token)
                         self._async_running[task_id] = coro_task
                         if task_id in self._precancelled:
                             # cancel landed while args resolved / semaphore
@@ -409,7 +456,7 @@ class WorkerProcess:
                         value,
                         msg.get("owner", ""),
                     )
-                    self._record_event(task_id, ev_name, "actor_task", t0, True)
+                    self._record_event(task_id, ev_name, "actor_task", t0, True, trace=tr)
                     return out
                 sem = self._semaphore_for(method)
                 async with sem if sem is not None else contextlib.nullcontext():
@@ -417,7 +464,7 @@ class WorkerProcess:
                         self._executor_for(method),
                         self._exec_sync, method, msg, task_id, msg["actor_id"],
                     )
-                self._record_event(task_id, ev_name, "actor_task", t0, True)
+                self._record_event(task_id, ev_name, "actor_task", t0, True, trace=tr)
                 return out
             fn = self.worker.fn_manager.get(msg["fn_id"])
             if fn is None:
@@ -427,7 +474,7 @@ class WorkerProcess:
             out = await self.loop.run_in_executor(
                 self.executor, self._exec_sync, fn, msg, task_id, None
             )
-            self._record_event(task_id, ev_name, "task", t0, True)
+            self._record_event(task_id, ev_name, "task", t0, True, trace=tr)
             return out
         except SystemExit:
             self._exiting = True
@@ -444,6 +491,7 @@ class WorkerProcess:
                 "actor_task" if is_actor_call else "task",
                 t0,
                 False,
+                trace=tr,
             )
             return self._error_results(num_returns, e)
 
@@ -464,6 +512,13 @@ class WorkerProcess:
         self._running_tasks[task_id] = threading.get_ident()
         t0 = _time.time()
         idx = 0
+        tr = msg.get(TRACE_FIELD)
+        token = None
+        if tr is not None:
+            token = tracing.push_execution(tr)
+            self._record_running(
+                task_id, getattr(fn, "__name__", "stream"), "task", tr
+            )
         try:
             args, kwargs = self._resolve_args(msg["args"], msg.get("kwargs"))
             w = self.worker
@@ -494,10 +549,16 @@ class WorkerProcess:
                     idx += 1
             finally:
                 w.current_task_id = None
-            self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, True)
+            self._record_event(
+                task_id, getattr(fn, "__name__", "stream"), "task", t0, True,
+                trace=tr,
+            )
             return {"results": [], "stream_end": True, "count": idx}
         except TaskCancelledError as e:
-            self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, False)
+            self._record_event(
+                task_id, getattr(fn, "__name__", "stream"), "task", t0, False,
+                trace=tr,
+            )
             if task_id not in self._cancel_requested:
                 # stray delivery (cancel aimed at a task this thread just
                 # finished): a stream cannot re-run mid-way, so surface an
@@ -513,10 +574,15 @@ class WorkerProcess:
             err = self._error_results(1, e)[0]["e"]
             return {"results": [], "stream_end": True, "count": idx, "stream_error": err}
         except BaseException as e:
-            self._record_event(task_id, getattr(fn, "__name__", "stream"), "task", t0, False)
+            self._record_event(
+                task_id, getattr(fn, "__name__", "stream"), "task", t0, False,
+                trace=tr,
+            )
             err = self._error_results(1, e)[0]["e"]
             return {"results": [], "stream_end": True, "count": idx, "stream_error": err}
         finally:
+            if token is not None:
+                tracing.pop_execution(token)
             self._streams.pop(task_id, None)
             self._running_tasks.pop(task_id, None)
             if self._cancel_requested or self._precancelled:
@@ -631,7 +697,7 @@ class WorkerProcess:
                         pass
                 if rid is not None:
                     write_frame_body(writer, _REPLY_TMPL.render(rid, results))
-                self._record_event(task_id, ev_name, kind, t0, ok)
+                self._record_event(task_id, ev_name, kind, t0, ok, trace=msg.get(TRACE_FIELD))
                 if self._exiting:
                     spawn_bg(self._graceful_exit())
 
@@ -786,9 +852,6 @@ class WorkerProcess:
             await asyncio.sleep(min(period, 1.0))
             try:
                 self.worker.head.notify("heartbeat", client_id=self.worker_id)
-                if self._task_events:
-                    batch, self._task_events = self._task_events, []
-                    self.worker.head.notify("task_events", events=batch)
             except Exception:
                 pass
 

@@ -44,6 +44,8 @@ th { color: #9aa5b1; font-weight: 600; }
 .bar { position: absolute; height: 12px; border-radius: 2px; min-width: 2px; }
 .bar.FINISHED { background: #2ea04366; border: 1px solid #7ee787; }
 .bar.FAILED { background: #da363366; border: 1px solid #ff7b72; }
+.bar.PENDING { background: #6e768166; border: 1px solid #9aa5b1; }
+.bar.SCHED { background: #e3b34144; border: 1px solid #e3b341; }
 #tlaxis { font-size: 10px; color: #9aa5b1; }
 </style></head><body>
 <h1>cluster_anywhere_tpu</h1>
@@ -65,33 +67,59 @@ function esc(s) {
     .replace(/"/g, "&quot;");
 }
 function timeline(events) {
-  // chrome-trace-style lanes: one per worker, bars = task spans, newest
-  // window only (the events endpoint already rings)
+  // chrome-trace-style lanes: one per worker, bars = task spans.  With
+  // tracing enabled the ring also carries lifecycle phase events
+  // (SUBMITTED/QUEUED/SCHEDULED/RUNNING without start/end); those prepend
+  // grey (pending at the submitter) and yellow (scheduled -> running)
+  // segments before each green/red execute bar.
   const el = document.getElementById("tl");
-  const done = events.filter(t => t.end && t.start);
-  if (!done.length) { el.style.height = "20px"; el.innerHTML = ""; return; }
-  const t0 = Math.min(...done.map(t => t.start));
-  const t1 = Math.max(...done.map(t => t.end));
+  const byTask = {};
+  events.forEach(e => {
+    if (!e.task_id) return;
+    (byTask[e.task_id] = byTask[e.task_id] || []).push(e);
+  });
+  const segs = [];
+  let nSpans = 0;
+  for (const evs of Object.values(byTask)) {
+    const term = evs.find(e => e.end && e.start);
+    if (!term) continue;
+    nSpans++;
+    const ph = {};
+    evs.forEach(e => { if (!e.end && e.ts) ph[e.state] = e.ts; });
+    const title = term.name + " (" + term.type + ")";
+    const runStart = ph.RUNNING || term.start;
+    if (ph.SUBMITTED && ph.SUBMITTED < runStart) {
+      const schedAt = ph.SCHEDULED || runStart;
+      segs.push({w: term.worker_id, s: ph.SUBMITTED, e: schedAt,
+                 cls: "PENDING", title: title + " pending"});
+      if (schedAt < runStart)
+        segs.push({w: term.worker_id, s: schedAt, e: runStart,
+                   cls: "SCHED", title: title + " scheduled"});
+    }
+    segs.push({w: term.worker_id, s: term.start, e: term.end, cls: term.state,
+               title: title + " " + ((term.end - term.start) * 1000).toFixed(1) + " ms"});
+  }
+  if (!segs.length) { el.style.height = "20px"; el.innerHTML = ""; return; }
+  const t0 = Math.min(...segs.map(t => t.s));
+  const t1 = Math.max(...segs.map(t => t.e));
   const span = Math.max(t1 - t0, 1e-6);
-  const lanes = [...new Set(done.map(t => t.worker_id))];
+  const lanes = [...new Set(segs.map(t => t.w))];
   const W = el.clientWidth || 900, LH = 16, PAD = 70;
   el.style.height = (lanes.length * LH + 4) + "px";
   let html = "";
   lanes.forEach((w, i) => {
     html += '<div class="lane-label" style="top:' + (i * LH + 2) + 'px">' + esc(w) + "</div>";
   });
-  done.forEach(t => {
-    const lane = lanes.indexOf(t.worker_id);
-    const x = PAD + (t.start - t0) / span * (W - PAD - 8);
-    const w = Math.max((t.end - t.start) / span * (W - PAD - 8), 2);
-    const ms = ((t.end - t.start) * 1000).toFixed(1);
-    html += '<div class="bar ' + esc(t.state) + '" style="left:' + x + "px;top:" +
-      (lane * LH + 2) + "px;width:" + w + 'px" title="' + esc(t.name) + " (" +
-      esc(t.type) + ") " + ms + ' ms"></div>';
+  segs.forEach(t => {
+    const lane = lanes.indexOf(t.w);
+    const x = PAD + (t.s - t0) / span * (W - PAD - 8);
+    const w = Math.max((t.e - t.s) / span * (W - PAD - 8), 2);
+    html += '<div class="bar ' + esc(t.cls) + '" style="left:' + x + "px;top:" +
+      (lane * LH + 2) + "px;width:" + w + 'px" title="' + esc(t.title) + '"></div>';
   });
   el.innerHTML = html;
   document.getElementById("tlaxis").textContent =
-    "window " + (span).toFixed(2) + "s, " + done.length + " spans";
+    "window " + (span).toFixed(2) + "s, " + nSpans + " spans";
 }
 async function refresh() {
   const s = await (await fetch("/api/summary")).json();
@@ -123,10 +151,11 @@ async function refresh() {
   document.getElementById("pgs").innerHTML = row(["pg", "strategy", "state", "bundle nodes"], "th") +
     pgs.slice(0, 30).map(p => row([p.pg_id.slice(0, 12), p.strategy, p.state,
       esc((p.bundle_nodes||[]).join(" "))])).join("");
-  const tasks = await (await fetch("/api/tasks?limit=200")).json();
+  const tasks = await (await fetch("/api/tasks?limit=600")).json();
   timeline(tasks);
+  const done = tasks.filter(t => t.task_id && t.end && t.start && t.state != "SPAN");
   document.getElementById("tasks").innerHTML = row(["name", "type", "state", "worker", "ms"], "th") +
-    tasks.slice(-30).reverse().map(t => row([esc(t.name), t.type, t.state, t.worker_id,
+    done.slice(-30).reverse().map(t => row([esc(t.name), t.type, t.state, t.worker_id,
       ((t.end - t.start) * 1000).toFixed(1)])).join("");
 }
 refresh(); setInterval(refresh, 2000);

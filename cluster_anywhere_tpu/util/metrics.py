@@ -9,10 +9,12 @@ ships deltas to the head, which aggregates across the cluster. Snapshot via
 
 from __future__ import annotations
 
+import bisect
+import functools
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
@@ -80,6 +82,23 @@ def _wire_records() -> List[dict]:
     return out
 
 
+# drained-but-unsent records: a send that fails after the drain (head closed
+# or unreachable in the window between drain and notify) re-stages its batch
+# here instead of losing the deltas; the next flush ships them first so
+# counter order is preserved at the head aggregator
+_restage_lock = threading.Lock()
+_restaged: List[dict] = []
+
+# samplers run at the top of every flush (e.g. jax device-memory gauges);
+# registered via register_flush_hook
+_flush_hooks: List[Callable[[], None]] = []
+
+
+def register_flush_hook(fn: Callable[[], None]) -> None:
+    """Register a sampler called at the start of every metrics flush."""
+    _flush_hooks.append(fn)
+
+
 def flush_once():
     """Ship pending deltas to the head (called by the background flusher; also
     directly from tests for determinism)."""
@@ -88,7 +107,16 @@ def flush_once():
     w = try_global_worker()
     if w is None or w.head is None or w.head.closed:
         return
+    for hook in list(_flush_hooks):
+        try:
+            hook()
+        except Exception:
+            pass
     batch = []
+    with _restage_lock:
+        if _restaged:
+            batch.extend(_restaged)
+            _restaged.clear()
     with _registry_lock:
         metrics = list(_registry)
     for m in metrics:
@@ -101,12 +129,16 @@ def flush_once():
         try:
             w.head.notify("metrics_report", metrics=batch)
         except Exception:
-            pass
+            # head died between drain and send: the deltas are already out of
+            # the metric objects — re-stage them or they are lost for good
+            with _restage_lock:
+                _restaged.extend(batch)
 
     try:
         w.loop.call_soon_threadsafe(_send)
     except RuntimeError:
-        pass
+        with _restage_lock:
+            _restaged.extend(batch)
 
 
 class Metric:
@@ -228,6 +260,9 @@ class Histogram(Metric):
         self.bounds = list(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
         if sorted(self.bounds) != self.bounds:
             raise ValueError("histogram boundaries must be sorted")
+        # bound once: observe() is the hot path, so the bucket lookup is a
+        # single pre-bound call (no per-observation import or attribute walk)
+        self._bucket_index = functools.partial(bisect.bisect_left, self.bounds)
         self._pending: Dict[str, dict] = {}
         self._register()
 
@@ -235,16 +270,15 @@ class Histogram(Metric):
         self._lock = other._lock
         self._pending = other._pending
         self.bounds = other.bounds
+        self._bucket_index = other._bucket_index
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        import bisect
-
         key = _tags_key(self._merged(tags))
         with self._lock:
             cur = self._pending.setdefault(
                 key, {"buckets": [0] * (len(self.bounds) + 1), "sum": 0.0, "count": 0}
             )
-            cur["buckets"][bisect.bisect_left(self.bounds, value)] += 1
+            cur["buckets"][self._bucket_index(value)] += 1
             cur["sum"] += value
             cur["count"] += 1
 
@@ -274,20 +308,36 @@ def prometheus_text() -> str:
     return render_prometheus(get_metrics_snapshot())
 
 
+def _escape_label_value(v: Any) -> str:
+    """Prometheus exposition label-value escaping: backslash, double quote
+    and newline must be escaped or the line is unparseable (label values
+    carry arbitrary user tags — routes, device names, exception text)."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(v: Any) -> str:
+    """HELP text escaping (backslash and newline per the exposition spec)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def render_prometheus(snap: Dict[str, dict]) -> str:
     """Render a metrics snapshot dict (head-side table or RPC copy) to the
     Prometheus exposition format."""
     lines: List[str] = []
     for name, rec in sorted(snap.items()):
         if rec.get("desc"):
-            lines.append(f"# HELP {name} {rec['desc']}")
+            lines.append(f"# HELP {name} {_escape_help(rec['desc'])}")
         ptype = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}[
             rec["type"]
         ]
         lines.append(f"# TYPE {name} {ptype}")
         for key, val in rec["data"].items():
             tags = dict(json.loads(key))
-            label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+            label = ",".join(
+                f'{k}="{_escape_label_value(v)}"' for k, v in sorted(tags.items())
+            )
             if rec["type"] in ("counter", "gauge"):
                 lines.append(f"{name}{{{label}}} {val}" if label else f"{name} {val}")
             else:

@@ -26,8 +26,11 @@ def list_tasks(
     filters: Optional[List[tuple]] = None,
     limit: int = 10_000,
 ) -> List[Dict[str, Any]]:
-    """Finished/failed task executions (the head keeps a 50k ring buffer)."""
-    kw: Dict[str, Any] = {"limit": limit}
+    """Finished/failed task executions (the head keeps a 50k ring buffer).
+    Lifecycle phase events (SUBMITTED/QUEUED/SCHEDULED/RUNNING, recorded
+    when tracing is enabled) share the same ring; this view keeps only the
+    terminal executions — `task_lifecycle()`/`timeline()` read the phases."""
+    kw: Dict[str, Any] = {"limit": limit, "terminal": True}
     for f in filters or []:
         key, op, value = f
         if op != "=":
@@ -37,6 +40,10 @@ def list_tasks(
     events = _head("list_task_events", **kw)["events"]
     out = []
     for e in events:
+        # belt over the server-side `terminal` filter (phase/span events
+        # share the ring and also carry no end / a SPAN state)
+        if e.get("end") is None or e.get("state") not in ("FINISHED", "FAILED"):
+            continue
         out.append(
             {
                 "task_id": e["task_id"],
@@ -45,6 +52,7 @@ def list_tasks(
                 "state": e["state"],
                 "worker_id": e["worker_id"],
                 "actor_id": e.get("actor_id"),
+                "trace_id": (e.get("trace") or {}).get("tid"),
                 "start_time_ms": e["start"] * 1000,
                 "end_time_ms": e["end"] * 1000,
                 "duration_ms": (e["end"] - e["start"]) * 1000,
@@ -53,12 +61,22 @@ def list_tasks(
     return out
 
 
+def task_lifecycle(task_id: str) -> List[Dict[str, Any]]:
+    """Every recorded lifecycle event of one task (hex id), oldest first:
+    SUBMITTED → [QUEUED] → SCHEDULED → RUNNING → FINISHED/FAILED, each with
+    process/node attribution and its trace context."""
+    events = _head("list_task_events", task_id=task_id, limit=50_000)["events"]
+    events.sort(key=_event_ts)
+    return events
+
+
 def list_actors(*, limit: int = 10_000) -> List[Dict[str, Any]]:
-    return _head("list_actors")["actors"][:limit]
+    # limit is pushed server-side (the head slices its table before replying)
+    return _head("list_actors", limit=limit)["actors"]
 
 
 def list_workers(*, limit: int = 10_000) -> List[Dict[str, Any]]:
-    return _head("list_workers")["workers"][:limit]
+    return _head("list_workers", limit=limit)["workers"]
 
 
 def list_nodes() -> List[Dict[str, Any]]:
@@ -117,29 +135,185 @@ def summarize_objects() -> Dict[str, Any]:
 
 # ------------------------------------------------------------------ timeline
 
+_PHASE_ORDER = {
+    "SUBMITTED": 0, "QUEUED": 1, "SCHEDULED": 2, "RUNNING": 3,
+    "FINISHED": 4, "FAILED": 4,
+}
 
-def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Chrome-trace (chrome://tracing / perfetto) events of task executions
-    (analogue of `ray timeline`, reference scripts/scripts.py timeline)."""
-    tasks = list_tasks()
-    events = []
-    for t in tasks:
+
+def _event_ts(e: Dict[str, Any]) -> float:
+    ts = e.get("ts")
+    if ts is None:
+        ts = e.get("start") or 0.0
+    return ts
+
+
+class _Lanes:
+    """Greedy interval packing: overlapping slices of one process get
+    separate Chrome-trace tid rows; non-overlapping ones reuse rows."""
+
+    def __init__(self):
+        self._rows: Dict[Any, List[float]] = {}
+
+    def assign(self, pid: Any, start: float, end: float) -> int:
+        rows = self._rows.setdefault(pid, [])
+        for i, busy_until in enumerate(rows):
+            if busy_until <= start:
+                rows[i] = end
+                return i + 2  # row 1 is the execute lane
+        rows.append(end)
+        return len(rows) + 1
+
+
+def timeline(
+    filename: Optional[str] = None, *, limit: int = 100_000
+) -> List[Dict[str, Any]]:
+    """Assemble the head's task-event ring into Chrome-trace / Perfetto JSON
+    (analogue of `ray timeline`).
+
+    Execute spans land on each worker process's lane (tid 1); with tracing
+    enabled, the driver-side lifecycle phases (submit → queued → scheduled)
+    appear as slices on the submitting process with `s`→`f` flow arrows
+    connecting the submit span to the execute span across processes, and
+    `tracing.span()` / jax-compile app spans render as nested slices.  All
+    durations are microseconds; `ts` is wall-clock.  The output is a bare
+    event array — loadable by chrome://tracing and Perfetto alike."""
+    raw = _head("list_task_events", limit=limit)["events"]
+    pids: Dict[Any, int] = {}
+
+    def pid_of(proc: Any) -> int:
+        proc = proc or "?"
+        if proc not in pids:
+            pids[proc] = len(pids) + 1
+        return pids[proc]
+
+    lanes = _Lanes()
+    events: List[Dict[str, Any]] = []
+    by_task: Dict[str, List[dict]] = defaultdict(list)
+    spans: List[dict] = []
+    for e in raw:
+        if e.get("state") == "SPAN":
+            spans.append(e)
+        elif e.get("task_id"):
+            by_task[e["task_id"]].append(e)
+
+    for task_id, evs in by_task.items():
+        evs.sort(key=lambda e: (_event_ts(e), _PHASE_ORDER.get(e.get("state"), 9)))
+        name = next((e.get("name") for e in evs if e.get("name")), "task")
+        kind = next((e.get("type") for e in evs if e.get("type")), "task")
+        trace = next((e.get("trace") for e in evs if e.get("trace")), None)
+        trace_id = (trace or {}).get("tid")
+        term = next((e for e in evs if e.get("end") is not None), None)
+        phases = {
+            e["state"]: e
+            for e in evs
+            if e.get("end") is None and e.get("state") in _PHASE_ORDER
+        }
+        args = {"task_id": task_id, "trace_id": trace_id}
+
+        exec_pid = None
+        if term is not None:
+            exec_pid = pid_of(term.get("worker_id"))
+            events.append(
+                {
+                    "name": name,
+                    "cat": kind,
+                    "ph": "X",
+                    "ts": term["start"] * 1e6,
+                    "dur": max((term["end"] - term["start"]) * 1e6, 1.0),
+                    "pid": exec_pid,
+                    "tid": 1,
+                    "args": {
+                        **args,
+                        "state": term.get("state"),
+                        "actor_id": term.get("actor_id"),
+                        "node_id": term.get("node_id"),
+                        "running_ts": (phases.get("RUNNING") or {}).get("ts"),
+                    },
+                }
+            )
+
+        sub = phases.get("SUBMITTED")
+        if sub is None:
+            continue
+        drv_pid = pid_of(sub.get("worker_id"))
+        run_ts = (phases.get("RUNNING") or {}).get("ts") or (
+            term["start"] if term else None
+        )
+        task_end = (term["end"] if term else None) or run_ts
+        # driver-side phase slices: submit → [queued →] scheduled, one lane
+        # per concurrently-inflight task
+        points = [
+            (p, phases[p]["ts"])
+            for p in ("SUBMITTED", "QUEUED", "SCHEDULED")
+            if p in phases
+        ]
+        if run_ts is not None:
+            points.append(("RUNNING", run_ts))
+        lane_end = task_end or points[-1][1]
+        lane = lanes.assign(drv_pid, sub["ts"], lane_end)
+        seg_label = {"SUBMITTED": "submit", "QUEUED": "queued", "SCHEDULED": "sched"}
+        for (p, t0), (_, t1) in zip(points, points[1:]):
+            events.append(
+                {
+                    "name": f"{name} [{seg_label[p]}]",
+                    "cat": "lifecycle",
+                    "ph": "X",
+                    "ts": t0 * 1e6,
+                    "dur": max((t1 - t0) * 1e6, 1.0),
+                    "pid": drv_pid,
+                    "tid": lane,
+                    "args": {**args, "phase": p,
+                             "target": phases[p].get("target") if p in phases else None},
+                }
+            )
+        # causal flow arrow: submit span → execute span (cross-process)
+        if term is not None and exec_pid is not None:
+            sched = phases.get("SCHEDULED") or sub
+            flow = {
+                "name": "submit→run",
+                "cat": "task_flow",
+                "id": task_id,
+                "args": args,
+            }
+            events.append(
+                {**flow, "ph": "s", "ts": sched["ts"] * 1e6, "pid": drv_pid, "tid": lane}
+            )
+            events.append(
+                {**flow, "ph": "f", "bp": "e", "ts": term["start"] * 1e6,
+                 "pid": exec_pid, "tid": 1}
+            )
+
+    # app spans (tracing.span blocks, jax compile spans)
+    for e in spans:
+        if e.get("start") is None or e.get("end") is None:
+            continue
+        pid = pid_of(e.get("worker_id"))
+        lane = lanes.assign(pid, e["start"], e["end"])
         events.append(
             {
-                "name": t["name"],
-                "cat": t["type"].lower(),
+                "name": e.get("name") or "span",
+                "cat": e.get("type") or "span",
                 "ph": "X",
-                "ts": t["start_time_ms"] * 1000,  # chrome trace wants us
-                "dur": t["duration_ms"] * 1000,
-                "pid": "cluster",
-                "tid": t["worker_id"],
-                "args": {
-                    "task_id": t["task_id"],
-                    "state": t["state"],
-                    "actor_id": t["actor_id"],
-                },
+                "ts": e["start"] * 1e6,
+                "dur": max((e["end"] - e["start"]) * 1e6, 1.0),
+                "pid": pid,
+                "tid": lane,
+                "args": {"trace": e.get("trace"), "node_id": e.get("node_id")},
             }
         )
+
+    # process-name metadata so Perfetto shows client ids, not bare pids
+    for proc, pid in pids.items():
+        events.append(
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": str(proc)}}
+        )
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+             "args": {"name": "execute"}}
+        )
+    events.sort(key=lambda e: e.get("ts", 0))
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)
@@ -164,6 +338,7 @@ def get_log(worker_id: Optional[str] = None, tail: int = 200) -> str:
 
 __all__ = [
     "list_tasks",
+    "task_lifecycle",
     "list_actors",
     "list_workers",
     "list_nodes",

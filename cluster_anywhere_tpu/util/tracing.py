@@ -1,37 +1,211 @@
-"""Lightweight distributed tracing (analogue of the reference's
-python/ray/util/tracing/tracing_helper.py, which monkey-patches remote calls
-to emit OpenTelemetry spans).
+"""Cluster-wide distributed tracing (analogue of the reference's
+python/ray/util/tracing/tracing_helper.py, which propagates OpenTelemetry
+context through every task/actor submission, plus the per-task state machine
+GcsTaskManager exports as a Chrome timeline).
 
-`enable()` patches RemoteFunction._remote and ActorMethod._remote so every
-submission records a client-side span (submit -> first result ready) into the
-metrics pipeline as a histogram, and execution-side spans already flow through
-the head's task-event buffer (util.state.timeline). `span("name")` is a
-context manager for custom app spans, recorded the same way.
+Three planes, one buffer:
+
+* **Trace context.**  `enable()` turns on trace generation: every `remote()`
+  submission mints a span under the ambient trace context (a fresh trace id
+  at the driver, the executing task's context inside a worker) and the
+  context rides the RPC as a small optional ``tr`` field on the logical
+  message (`core/protocol.TRACE_FIELD`) — batch-envelope splicing carries
+  whole message bodies, so the field survives corking untouched.  Workers
+  install the received context as ambient for the executing thread/coroutine,
+  so nested submissions and `span()` blocks chain into one trace.
+
+* **Task lifecycle events.**  Submission-side (SUBMITTED / QUEUED /
+  SCHEDULED, recorded by `core/worker.py`) and execution-side (RUNNING /
+  FINISHED / FAILED, recorded by `core/workerproc.py`) phases land in this
+  module's per-process buffer via `record_task_event()` and ship to the
+  head's 50k `task_events` ring on the existing ``task_events`` notify path
+  (drained by every Worker's housekeeping loop).  Terminal events always
+  flow (tracing off or on); the richer phases and the ``tr`` wire field are
+  gated on `enable()` so the disabled submit fast path pays one branch.
+
+* **Export.**  `util/state.timeline()` / `ca timeline` assemble the ring
+  into Chrome-trace/Perfetto JSON with causal flow arrows between the
+  submit and execute spans; `span("name")` records nested app spans into
+  the same buffer (and a `ca_trace_span_seconds` histogram).
+
+JAX hooks: `enable_jax_profiling()` (called automatically by `enable()`
+when jax is already imported) observes backend compile durations into a
+`ca_jax_compile_seconds` histogram + SPAN events, and samples per-device
+memory into `ca_device_memory_bytes` gauges at each metrics flush.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import os
+import sys
 import threading
 import time
-from typing import Optional
+from typing import Any, Dict, List, Optional
 
 from . import metrics
 
 _enabled = False
+_patched = False
 _patch_lock = threading.Lock()
 _submit_hist: Optional[metrics.Histogram] = None
 _span_hist: Optional[metrics.Histogram] = None
 
+# ambient trace context for the current thread/coroutine:
+# {"tid": trace id, "sid": span id[, "psid": parent span id]}
+_ctx: "contextvars.ContextVar[Optional[Dict[str, str]]]" = contextvars.ContextVar(
+    "ca_trace_ctx", default=None
+)
 
+# ------------------------------------------------------------- event buffer
+# Per-process lifecycle/span event buffer, drained by Worker._housekeeping
+# onto the head's `task_events` ring.  Appends come from user threads,
+# executor threads and the IO loop alike; a plain lock keeps it simple (the
+# hot disabled path never reaches here).
+_events_lock = threading.Lock()
+_events: List[dict] = []
+_EVENTS_CAP = 100_000  # headless processes (no flusher) must not grow forever
+
+# lazily bound core.worker.try_global_worker (a top-level import would be
+# circular: util.state imports core.worker at import time)
+_try_global_worker = None
+
+
+def _current_worker():
+    global _try_global_worker
+    if _try_global_worker is None:
+        from ..core.worker import try_global_worker
+
+        _try_global_worker = try_global_worker
+    return _try_global_worker()
+
+
+def record_task_event(
+    task_id: str,
+    name: Optional[str],
+    kind: str,
+    state: str,
+    *,
+    trace: Optional[Dict[str, str]] = None,
+    worker_id: Optional[str] = None,
+    node_id: Optional[str] = None,
+    ts: Optional[float] = None,
+    **extra: Any,
+) -> None:
+    """Buffer one lifecycle event (thread-safe).  Terminal events pass
+    start=/end= through `extra` and keep the legacy schema the state API
+    reads; phase events carry only `ts`."""
+    ev: Dict[str, Any] = {
+        "task_id": task_id,
+        "name": name,
+        "type": kind,
+        "state": state,
+        "ts": time.time() if ts is None else ts,
+        "worker_id": worker_id,
+        "node_id": node_id,
+    }
+    if trace:
+        ev["trace"] = trace
+    if extra:
+        ev.update(extra)
+    with _events_lock:
+        _events.append(ev)
+        if len(_events) > _EVENTS_CAP:
+            del _events[: _EVENTS_CAP // 2]
+
+
+def drain_events() -> List[dict]:
+    """Take the buffered events (called by the housekeeping flusher)."""
+    global _events
+    if not _events:
+        return []
+    with _events_lock:
+        out, _events = _events, []
+    return out
+
+
+def restage_events(evs: List[dict]) -> None:
+    """Put drained events back (head unreachable at send time)."""
+    if not evs:
+        return
+    with _events_lock:
+        _events[:0] = evs
+
+
+# ------------------------------------------------------------ trace context
 def is_enabled() -> bool:
     return _enabled
 
 
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(4).hex()
+
+
+def current() -> Optional[Dict[str, str]]:
+    """The ambient trace context of this thread/coroutine (None = no trace)."""
+    return _ctx.get()
+
+
+def begin_task_trace(
+    task_id: str, name: str, kind: str, worker_id: str, node_id: str
+) -> Optional[Dict[str, str]]:
+    """Mint the submit span for a task submission under the ambient trace
+    (a fresh trace at the root) and record its SUBMITTED event.  Returns the
+    wire context: {"tid", "sid"} — the executing side parents on "sid".
+
+    Returns None when there is nothing to trace: a worker process armed only
+    by an incoming traced task (hook set, tracing not locally enabled) must
+    not mint fresh root traces for unrelated submissions."""
+    parent = _ctx.get()
+    if parent is None:
+        if not _enabled:
+            return None
+        ctx = {"tid": new_trace_id(), "sid": new_span_id()}
+    else:
+        ctx = {"tid": parent["tid"], "sid": new_span_id(), "psid": parent["sid"]}
+    record_task_event(
+        task_id, name, kind, "SUBMITTED",
+        trace=ctx, worker_id=worker_id, node_id=node_id,
+    )
+    return {"tid": ctx["tid"], "sid": ctx["sid"]}
+
+
+def _ensure_hook() -> None:
+    """Arm the submission-side hook in this process.  Workers never call
+    enable(); receiving a traced task is the signal that this process's
+    nested submissions must propagate context."""
+    from ..core import worker as worker_mod
+
+    if worker_mod.TRACE_HOOK is None:
+        worker_mod.TRACE_HOOK = sys.modules[__name__]
+
+
+def push_execution(tr: Dict[str, str]):
+    """Install a received wire context as the ambient context of the
+    executing thread/coroutine (the execute span parents on the submit
+    span).  Returns a token for `pop_execution`."""
+    _ensure_hook()
+    ctx = {"tid": tr["tid"], "sid": new_span_id(), "psid": tr["sid"]}
+    return _ctx.set(ctx)
+
+
+def pop_execution(token) -> None:
+    _ctx.reset(token)
+
+
+# ------------------------------------------------------------------ enable
 def enable():
-    """Idempotently patch task/actor submission to record spans."""
-    global _enabled, _submit_hist, _span_hist
+    """Idempotently enable tracing: trace-context generation + propagation,
+    lifecycle phase events, submit-latency/span histograms, and (when jax is
+    already loaded) the JAX profiling hooks."""
+    global _enabled, _patched, _submit_hist, _span_hist
     with _patch_lock:
+        already_patched, _patched = _patched, True
         if _enabled:
             return
         _enabled = True
@@ -44,43 +218,178 @@ def enable():
             "ca_trace_span_seconds", "custom app spans", tag_keys=("name",)
         )
 
-        from ..core import actor as actor_mod
-        from ..core import remote_function as rf_mod
+    # submission-side trace hook: core/worker.py checks this module ref with
+    # one attribute load + branch per submission (no call, no allocation on
+    # the disabled path)
+    from ..core import worker as worker_mod
 
-        orig_task = rf_mod.RemoteFunction._remote
+    worker_mod.TRACE_HOOK = sys.modules[__name__]
 
-        def traced_task(self, args, kwargs, opts):
-            t0 = time.perf_counter()
-            try:
-                return orig_task(self, args, kwargs, opts)
-            finally:
-                _submit_hist.observe(
-                    time.perf_counter() - t0,
-                    {"kind": "task", "name": getattr(self._function, "__name__", "?")},
-                )
+    if "jax" in sys.modules:
+        enable_jax_profiling()
 
-        rf_mod.RemoteFunction._remote = traced_task
+    if already_patched:
+        return
 
-        orig_actor = actor_mod.ActorHandle._submit
+    from ..core import actor as actor_mod
+    from ..core import remote_function as rf_mod
 
-        def traced_actor(self, method, args, kwargs, opts):
-            t0 = time.perf_counter()
-            try:
-                return orig_actor(self, method, args, kwargs, opts)
-            finally:
-                _submit_hist.observe(
-                    time.perf_counter() - t0, {"kind": "actor", "name": method}
-                )
+    orig_task = rf_mod.RemoteFunction._remote
 
-        actor_mod.ActorHandle._submit = traced_actor
+    def traced_task(self, args, kwargs, opts):
+        if not _enabled:
+            return orig_task(self, args, kwargs, opts)
+        t0 = time.perf_counter()
+        try:
+            return orig_task(self, args, kwargs, opts)
+        finally:
+            _submit_hist.observe(
+                time.perf_counter() - t0,
+                {"kind": "task", "name": getattr(self._function, "__name__", "?")},
+            )
+
+    rf_mod.RemoteFunction._remote = traced_task
+
+    orig_actor = actor_mod.ActorHandle._submit
+
+    def traced_actor(self, method, args, kwargs, opts):
+        if not _enabled:
+            return orig_actor(self, method, args, kwargs, opts)
+        t0 = time.perf_counter()
+        try:
+            return orig_actor(self, method, args, kwargs, opts)
+        finally:
+            _submit_hist.observe(
+                time.perf_counter() - t0, {"kind": "actor", "name": method}
+            )
+
+    actor_mod.ActorHandle._submit = traced_actor
 
 
+def disable():
+    """Turn tracing back off (the monkeypatches stay installed but inert)."""
+    global _enabled
+    _enabled = False
+    from ..core import worker as worker_mod
+
+    worker_mod.TRACE_HOOK = None
+
+
+# -------------------------------------------------------------------- spans
 @contextlib.contextmanager
 def span(name: str):
-    """Record a custom application span into the metrics pipeline."""
-    t0 = time.perf_counter()
+    """Record a custom application span.  Attaches to the ambient trace
+    context (the executing task's trace inside a worker; spans nest), lands
+    in the lifecycle event buffer for `timeline()` assembly, and observes
+    the ca_trace_span_seconds histogram.
+
+    Active when tracing is locally enabled OR the span runs inside a traced
+    execution (worker processes never call enable(); the ambient context is
+    the signal there).  An inactive span installs NO context — otherwise a
+    disabled-tracing span block would make every nested span/remote() look
+    traced and leak events onto the wire."""
+    parent = _ctx.get()
+    active = _enabled or parent is not None
+    ctx = token = None
+    if active:
+        if parent is None:
+            ctx = {"tid": new_trace_id(), "sid": new_span_id()}
+        else:
+            ctx = {"tid": parent["tid"], "sid": new_span_id(), "psid": parent["sid"]}
+        token = _ctx.set(ctx)
+    t0 = time.time()
+    p0 = time.perf_counter()
     try:
-        yield
+        yield ctx
     finally:
-        if _span_hist is not None:
-            _span_hist.observe(time.perf_counter() - t0, {"name": name})
+        if token is not None:
+            _ctx.reset(token)
+        dur = time.perf_counter() - p0
+        # inactive spans touch nothing — after disable() the histogram must
+        # stop mutating too, not just the event stream
+        if active and _span_hist is not None:
+            _span_hist.observe(dur, {"name": name})
+        if active:
+            w = _current_worker()
+            record_task_event(
+                "", name, "span", "SPAN",
+                trace=ctx,
+                worker_id=w.client_id if w is not None else None,
+                node_id=w.node_id if w is not None else None,
+                start=t0,
+                end=t0 + dur,
+            )
+
+
+# ---------------------------------------------------------------- JAX hooks
+_jax_hooked = False
+
+
+def enable_jax_profiling() -> bool:
+    """Surface device-side cost in the same pipeline: a
+    `ca_jax_compile_seconds` histogram (+ SPAN timeline events while tracing
+    is enabled) fed by jax.monitoring's compile-duration events, and
+    `ca_device_memory_bytes` gauges sampled at each metrics flush.  Returns
+    False when jax (or its monitoring API) is unavailable — callers treat
+    that as "nothing to profile", never an error."""
+    global _jax_hooked
+    if _jax_hooked:
+        return True
+    try:
+        import jax
+        from jax import monitoring
+    except Exception:
+        return False
+
+    compile_hist = metrics.Histogram(
+        "ca_jax_compile_seconds",
+        "jit/pjit backend compilation time",
+        tag_keys=("event",),
+    )
+
+    def _on_duration(event: str, duration: float, **kw):
+        if "compile" not in event:
+            return
+        try:
+            compile_hist.observe(duration, {"event": event})
+        except Exception:
+            return
+        if _enabled:
+            w = _current_worker()
+            now = time.time()
+            record_task_event(
+                "", f"jax:{event.rsplit('/', 1)[-1]}", "jax", "SPAN",
+                worker_id=w.client_id if w is not None else None,
+                node_id=w.node_id if w is not None else None,
+                start=now - duration,
+                end=now,
+            )
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+
+    mem_gauge = metrics.Gauge(
+        "ca_device_memory_bytes",
+        "per-device memory stats from the jax backend",
+        tag_keys=("device", "kind"),
+    )
+
+    def _sample_device_memory():
+        try:
+            devices = jax.local_devices()
+        except Exception:
+            return
+        for d in devices:
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                continue
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if key in stats:
+                    mem_gauge.set(float(stats[key]), {"device": str(d), "kind": key})
+
+    metrics.register_flush_hook(_sample_device_memory)
+    _jax_hooked = True
+    return True

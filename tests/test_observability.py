@@ -217,21 +217,28 @@ def test_rpc_wire_counters_exposed():
 
 def test_tracing_spans():
     tracing.enable()
+    try:
+        @ca.remote
+        def traced3():
+            return 1
 
-    @ca.remote
-    def traced3():
-        return 1
-
-    ca.get(traced3.remote())
-    with tracing.span("my_block"):
-        time.sleep(0.01)
-    snap = metrics.get_metrics_snapshot()
-    sub = snap.get("ca_trace_submit_latency_seconds")
-    assert sub is not None and any(
-        '"task"' in k or "task" in k for k in sub["data"].keys()
-    )
-    spans = snap.get("ca_trace_span_seconds")
-    assert spans is not None and sum(v["count"] for v in spans["data"].values()) >= 1
+        ca.get(traced3.remote())
+        with tracing.span("my_block"):
+            time.sleep(0.01)
+        snap = metrics.get_metrics_snapshot()
+        sub = snap.get("ca_trace_submit_latency_seconds")
+        assert sub is not None and any(
+            '"task"' in k or "task" in k for k in sub["data"].keys()
+        )
+        spans = snap.get("ca_trace_span_seconds")
+        assert spans is not None and sum(
+            v["count"] for v in spans["data"].values()
+        ) >= 1
+    finally:
+        # tracing now gates lifecycle-event recording and trace propagation
+        # too — leaving it on would change behavior for every later test
+        # module in this process
+        tracing.disable()
 
 
 def test_get_log():
