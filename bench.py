@@ -182,7 +182,11 @@ def bench_model():
     Times are synced by reading the loss back to host (block_until_ready does
     not force completion through the axon tunnel).  Both attention paths are
     timed (A/B) so a slower kernel can never silently become the dispatch
-    default; the headline is the better of the two."""
+    default; the headline is the better of the two.
+
+    Returns None on success, else a short skip-reason string that the driver
+    records into the BENCH json (a silently missing model row looked
+    identical to "never attempted")."""
     try:
         import jax
 
@@ -321,7 +325,10 @@ def bench_model():
             except Exception as e:  # MoE bench is supplementary
                 log(f"moe bench skipped: {type(e).__name__}: {e}")
     except Exception as e:
-        log(f"model bench skipped: {type(e).__name__}: {e}")
+        reason = f"{type(e).__name__}: {e}"
+        log(f"model bench skipped: {reason}")
+        return reason
+    return None
 
 
 def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
@@ -364,19 +371,21 @@ def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
 def main():
     _, best_actor, _ = bench_core()
     if _device_probe_ok():
-        bench_model()
+        model_skip = bench_model()
     else:
-        log("model bench skipped: accelerator runtime unreachable (probe hung)")
-    print(
-        json.dumps(
-            {
-                "metric": "actor_calls_async_per_s",
-                "value": round(best_actor, 1),
-                "unit": "calls/s",
-                "vs_baseline": round(best_actor / BASELINE_ACTOR_ASYNC, 3),
-            }
-        )
-    )
+        model_skip = "accelerator runtime unreachable (probe hung)"
+        log(f"model bench skipped: {model_skip}")
+    out = {
+        "metric": "actor_calls_async_per_s",
+        "value": round(best_actor, 1),
+        "unit": "calls/s",
+        "vs_baseline": round(best_actor / BASELINE_ACTOR_ASYNC, 3),
+    }
+    if model_skip is not None:
+        # the skip reason travels in the json, not just stderr: a missing
+        # model row must be distinguishable from a never-attempted one
+        out["model_skipped_reason"] = model_skip
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -203,6 +203,24 @@ def cmd_status(args):
         print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g} available")
     for k, v in sorted(stats.items()):
         print(f"  {k}: {v}")
+    # lease plane: delegated vs used block capacity per node and pool, so an
+    # exhausted block (every local grant denied -> head fallback) is
+    # diagnosable without the dashboard
+    nodes = ca.nodes()
+    blocks = [
+        (n["node_id"], p, b)
+        for n in nodes
+        if n["alive"]
+        for p, b in (n.get("lease_blocks") or {}).items()
+    ]
+    if blocks:
+        print("== lease plane (per-node delegated blocks) ==")
+        for nid, pool, b in blocks:
+            print(
+                f"  {nid}/{pool}: {b.get('used', 0)}/{b.get('size', 0)} used/"
+                f"delegated (granted {b.get('granted', 0)}, "
+                f"denied {b.get('denied', 0)})"
+            )
     ca.shutdown()
 
 
@@ -363,6 +381,12 @@ def cmd_microbenchmark(args):
 
         head_saturation(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "lease_plane", False):
+        # owns its own multi-node clusters (local-grant vs head-grant A/B)
+        from .microbenchmark import run_lease_plane
+
+        run_lease_plane(quick=getattr(args, "quick", False))
+        return
 
     import cluster_anywhere_tpu as ca
 
@@ -506,6 +530,10 @@ def main(argv=None):
     sp.add_argument(
         "--collective", action="store_true",
         help="p2p host allreduce bandwidth + head-traffic proof",
+    )
+    sp.add_argument(
+        "--lease-plane", dest="lease_plane", action="store_true",
+        help="node-local vs head lease granting tasks/s + head-RPC proof",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

@@ -42,6 +42,16 @@ class CAConfig:
     max_inflight_per_lease: int = 16  # pipelined task pushes per leased worker
     worker_prestart: bool = True
     scheduler_spread_threshold: float = 0.5  # hybrid policy: pack below, spread above
+    # --- lease plane (node-local granting; raylet LocalTaskManager analogue) ---
+    # the head delegates bounded per-pool lease capacity ("lease blocks") to
+    # node agents; submitters dial agents directly for the hot unit-shape
+    # lease class, keeping per-task traffic off the head
+    lease_delegation: bool = True
+    # max delegated workers per (node, pool); 0 = auto (the node's CPU count)
+    lease_block_max: int = 0
+    # submitter-side lease-directory cache TTL (one lease_dir RPC per pool
+    # per TTL while growing, zero in steady state)
+    lease_dir_ttl_s: float = 3.0
 
     # --- multi-node ---
     head_host: str = "127.0.0.1"  # TCP bind host for the head (cross-host: 0.0.0.0)

@@ -22,6 +22,7 @@ class FunctionManager:
         self._by_id: Dict[bytes, Any] = {}  # fn_id -> callable / class
         self._exported: set = set()  # fn_ids known to be in head KV
         self._blob_cache: Dict[int, Tuple[bytes, bytes]] = {}  # id(obj) -> (fn_id, blob)
+        self._blob_by_id: Dict[bytes, bytes] = {}  # fn_id -> blob (exporter side)
 
     def export(self, obj: Any) -> Tuple[bytes, Optional[bytes]]:
         """Returns (fn_id, blob_to_upload_or_None_if_already_exported)."""
@@ -35,10 +36,20 @@ class FunctionManager:
         fn_id = hashlib.sha256(blob).digest()[:16]
         with self._lock:
             self._blob_cache[key] = (fn_id, blob)
+            self._blob_by_id[fn_id] = blob
             self._by_id[fn_id] = obj
             if fn_id in self._exported:
                 return fn_id, None
             return fn_id, blob
+
+    def blob_for(self, fn_id: bytes) -> Optional[bytes]:
+        """The exported blob of a function THIS process exported (None for
+        functions learned by id only).  Lets a submitter inline the
+        definition into a task push while the head — the normal blob
+        directory — is down (lease-plane grants keep flowing through head
+        restarts, so pushes must not depend on head-served blobs)."""
+        with self._lock:
+            return self._blob_by_id.get(fn_id)
 
     def mark_exported(self, fn_id: bytes):
         with self._lock:

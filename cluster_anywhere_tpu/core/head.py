@@ -40,6 +40,13 @@ from .protocol import Connection, Server, connect_addr, spawn_bg, write_frame
 
 LOCAL_NODE = "n0"
 
+# Lease plane: pools whose unit-shape lease class is delegatable to node
+# agents, and the resource shape ONE delegated slot backs.  Only the hot
+# default class ({"CPU": 1}, no PG, no strategy) moves off the head; PG
+# leases, custom shapes, and placement strategies always grant centrally so
+# every bundle-charging / policy invariant stays in one place.
+LEASE_UNIT_SHAPES = {"cpu": {"CPU": 1.0}}
+
 # --------------------------------------------------------------------------
 # state records
 # --------------------------------------------------------------------------
@@ -61,6 +68,12 @@ class NodeRec:
     mem_pressured: bool = False  # agent-reported memory pressure (monitor)
     load: Dict[str, float] = field(default_factory=dict)  # heartbeat telemetry
     labels: Dict[str, str] = field(default_factory=dict)  # static node labels
+    # lease plane: workers whose unit-shape lease capacity is delegated to
+    # this node's agent (pool -> set of wids).  Their shape is pre-charged
+    # against avail, so agent-side grants need no head accounting.
+    delegated: Dict[str, set] = field(default_factory=dict)
+    # agent-reported block occupancy/counters, disseminated via heartbeats
+    lease_used: Dict[str, dict] = field(default_factory=dict)
 
     @property
     def is_local(self) -> bool:
@@ -154,6 +167,13 @@ class LeaseReq:
     bundle_index: int = -1
     strategy: Optional[dict] = None
     remote: bool = False  # requester is a remote client: hand out TCP addrs
+    # expiry deadline for lease-plane escalation probes: a submitter that can
+    # also be served by agents' delegated blocks marks its head request with a
+    # ttl; the head answers {"expired": True} past the deadline instead of
+    # holding it pending — so delegatable-class overflow never pins central
+    # capacity reclamation (the submitter re-probes the agents and
+    # re-subscribes).  None = classic request, held until grantable.
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -252,7 +272,21 @@ class Head:
             "nodes_died": 0,
             "objects_transferred": 0,
             "oom_kills": 0,
+            "lease_blocks_delegated": 0,  # worker-slots handed to agents
+            "lease_blocks_returned": 0,  # slots revoked/returned to the head
         }
+        self._last_deleg_reclaim = 0.0  # debounce for block revocations
+        # (node_id, wid) -> pool: block workers an agent reported that the
+        # head didn't know yet (snapshotless restart, agent registered before
+        # its workers).  Their re-registration adopts them straight into the
+        # delegated state instead of the central idle pool — without this the
+        # same worker would be grantable by BOTH planes.
+        self._pending_block_adopt: Dict[Tuple[str, str], str] = {}
+        # last time CENTRAL-only work (no-ttl leases, PGs) was queued:
+        # delegation holds off until demand has been quiet for a beat, so
+        # wave-shaped central floods (SPREAD bursts) don't lose capacity to
+        # the lease blocks between waves
+        self._last_central_demand = 0.0
         # per-method RPC counters (saturation diagnostics: the owner-based
         # directory and p2p collectives exist to keep hot-path traffic OFF
         # this loop — these counters are how tests/benchmarks prove it)
@@ -358,6 +392,10 @@ class Head:
                     "node_id": n.node_id, "addr": n.addr, "total": n.total,
                     "avail": n.avail, "index": n.index, "state": n.state,
                     "pid": n.pid, "labels": n.labels,
+                    # delegated lease blocks survive a head restart: avail
+                    # already carries their unit charges, so membership must
+                    # be restored with it or the accounting desyncs
+                    "delegated": {p: sorted(w) for p, w in n.delegated.items() if w},
                 }
                 for n in self.nodes.values()
             ],
@@ -444,6 +482,9 @@ class Head:
                 index=n["index"], state=n["state"], pid=n["pid"],
                 labels=n.get("labels") or {},
             )
+            rec.delegated = {
+                p: set(w) for p, w in (n.get("delegated") or {}).items()
+            }
             rec.max_workers = int(rec.total.get("CPU", 4)) * 4 + 4
             rec.last_heartbeat = now  # grace: agents get time to reconnect
             self.nodes[rec.node_id] = rec
@@ -499,6 +540,12 @@ class Head:
             if self.pending_leases:
                 self._last_reclaim_nudge = 0.0  # bypass the debounce
                 self._nudge_lease_holders(requester="")
+                self._expire_lease_requests()
+            if self._needs_reclaim():
+                # central work starved while capacity sits in agents' lease
+                # blocks: revoke the unleased slots (reclaim arbiter role)
+                self._last_central_demand = time.monotonic()
+                self._reclaim_delegations()
             if self._dirty:
                 self._dirty = False
                 try:
@@ -814,6 +861,9 @@ class Head:
                 else:
                     self.pending_leases.append(req)
         self._ensure_pool()
+        # whatever idle capacity central work didn't claim flows out to the
+        # agents' lease blocks (node-local granting)
+        self._maybe_delegate()
 
     def _release_lease(self, lease_id: str, worker_ok: bool = True):
         wid = self.leases.pop(lease_id, None)
@@ -842,6 +892,213 @@ class Head:
                     if node is not None and node.state == "alive":
                         node.idle[rec.pool].append(wid)
         self._service_queue()
+
+    # ---------------------------------------------------------- lease plane
+    def _lease_block_cap(self, node: NodeRec) -> int:
+        cap = self.config.lease_block_max
+        return cap if cap > 0 else int(node.total.get("CPU", 0))
+
+    def _maybe_delegate(self):
+        """Delegate idle agent-node workers into lease blocks (the head ->
+        raylet capacity split).  Runs only when no central work is queued:
+        pending leases/PGs get first claim on fresh idle workers, which also
+        keeps delegation and revocation from ping-ponging."""
+        if not self.config.lease_delegation:
+            return
+        if self._needs_reclaim():
+            # the queued work needs CENTRAL capacity; ttl-marked escalation
+            # probes don't block delegation — their submitters poll the
+            # agents, so the capacity serves them faster delegated
+            self._last_central_demand = time.monotonic()
+            return
+        if time.monotonic() - self._last_central_demand < 0.5:
+            # central demand was queued moments ago (wave-shaped floods):
+            # freshly idle workers serve the next wave centrally instead of
+            # vanishing into blocks the next wave can't see
+            return
+        for node in self.nodes.values():
+            if (
+                node.is_local
+                or node.state != "alive"
+                or node.conn is None
+                or node.conn.closed
+            ):
+                continue
+            cap = self._lease_block_cap(node)
+            for pool, unit in LEASE_UNIT_SHAPES.items():
+                idle = node.idle.get(pool)
+                if not idle:
+                    continue
+                delegated = node.delegated.setdefault(pool, set())
+                grant: List[dict] = []
+                while (
+                    idle
+                    and len(delegated) < cap
+                    and scheduling.fits(node.avail, unit)
+                ):
+                    wid = idle.popleft()
+                    rec = self.workers.get(wid)
+                    if rec is None or rec.state != "idle":
+                        continue
+                    # charge the slot's unit shape NOW: central scheduling
+                    # can never over-commit capacity an agent may grant
+                    self._take(node.avail, unit)
+                    rec.state = "delegated"
+                    delegated.add(wid)
+                    grant.append({"wid": wid, "addr": rec.addr})
+                if grant:
+                    try:
+                        node.conn.notify("lease_block", pool=pool, workers=grant)
+                        self.stats["lease_blocks_delegated"] += len(grant)
+                        self._dirty = True
+                    except Exception:
+                        # push failed: undo — the agent never saw the block
+                        for g in grant:
+                            self._undelegate_wid(node, pool, g["wid"])
+
+    def _undelegate_wid(self, node: NodeRec, pool: str, wid: str, dead: bool = False):
+        """Take one worker slot back from a node's block accounting: credit
+        the unit charge and (for live workers) rejoin the idle pool."""
+        if wid not in node.delegated.get(pool, ()):
+            return
+        node.delegated[pool].discard(wid)
+        if node.state == "alive":
+            self._give(node.avail, LEASE_UNIT_SHAPES[pool])
+        rec = self.workers.get(wid)
+        if not dead and rec is not None and rec.state == "delegated":
+            rec.state = "idle"
+            if node.state == "alive" and wid not in node.idle[rec.pool]:
+                node.idle[rec.pool].append(wid)
+
+    def _expire_lease_requests(self):
+        """Answer lease-plane escalation probes past their ttl with
+        {"expired": True}: the submitter re-probes the agents' blocks and
+        re-subscribes here.  Without expiry, one saturated-burst overflow
+        request would sit pending forever and force block revocation —
+        re-centralizing the exact traffic the lease plane exists to move."""
+        now = time.monotonic()
+        if not any(
+            r.deadline is not None and r.deadline < now for r in self.pending_leases
+        ):
+            return
+        keep: deque = deque()
+        for r in self.pending_leases:
+            if r.deadline is not None and r.deadline < now:
+                r.reply(expired=True)
+            else:
+                keep.append(r)
+        self.pending_leases = keep
+
+    def _needs_reclaim(self) -> bool:
+        """Should delegated capacity be pulled back?  Only for work the head
+        ALONE can serve: pending PGs and classic (no-ttl) lease requests —
+        PG-charged, strategy-constrained, custom-shaped, or remote-client
+        leases.  ttl-marked requests are lease-plane escalation probes: their
+        submitters are already polling the agents, so revoking for them would
+        just re-centralize the hot class under load."""
+        if self.pending_pgs:
+            return True
+        return any(r.deadline is None for r in self.pending_leases)
+
+    def _reclaim_delegations(self):
+        """Central work is queued while capacity sits delegated: ask agents
+        to return their UNLEASED slots (the head is the reclaim arbiter).
+        Debounced; runs from the 0.25s persist tick so transient queue blips
+        during normal churn never thrash the blocks."""
+        now = time.monotonic()
+        if now - self._last_deleg_reclaim < 0.25:
+            return
+        self._last_deleg_reclaim = now
+        for node in self.nodes.values():
+            if node.state != "alive" or node.conn is None or node.conn.closed:
+                continue
+            for pool, wids in node.delegated.items():
+                if wids:
+                    try:
+                        node.conn.notify("lease_block_revoke", pool=pool, n=len(wids))
+                    except Exception:
+                        pass
+
+    async def _h_lease_block_return(self, state, msg, reply, reply_err):
+        """Agent returned unleased block slots (revocation reply or agent-
+        initiated shed): credit the charges, rejoin the idle pools, and let
+        the queued central work grab the capacity."""
+        node = self.nodes.get(msg.get("node_id", state.get("node_id")))
+        if node is None:
+            return
+        pool = msg.get("pool", "cpu")
+        n = 0
+        for wid in msg.get("wids") or ():
+            if wid in node.delegated.get(pool, ()):
+                self._undelegate_wid(node, pool, wid)
+                n += 1
+        if n:
+            self.stats["lease_blocks_returned"] += n
+            self._service_queue()
+
+    def _placeable_with_delegated(self, a: ActorRec) -> bool:
+        """Would the actor place if every delegated-but-unleased slot came
+        back?  Credits each block's full unit capacity to a hypothetical
+        view — optimistic (leased slots won't return), so it gates a bounded
+        reclaim-and-wait, never an unconditional one."""
+        views = []
+        for n in self._alive_nodes():
+            avail = dict(n.avail)
+            for pool, wids in n.delegated.items():
+                for k, v in LEASE_UNIT_SHAPES[pool].items():
+                    avail[k] = avail.get(k, 0.0) + v * len(wids)
+            views.append(
+                scheduling.NodeView(n.node_id, n.total, avail, n.index, labels=n.labels)
+            )
+        return (
+            scheduling.pick_node(
+                views, a.resources, a.strategy, self.config.scheduler_spread_threshold
+            )
+            is not None
+        )
+
+    def _reconcile_lease_blocks(self, node: NodeRec, blocks: Dict[str, dict]):
+        """Adopt the agent's authoritative view of its delegated blocks (sent
+        with every agent (re)registration).  After a head kill -9 + restart
+        the snapshot may trail reality — grants and delegations made while
+        the head was down — so the block membership reconciles both ways:
+        workers the agent holds become `delegated` here (charged), workers
+        the head thought delegated but the agent no longer holds go back to
+        the idle pool (credited)."""
+        for key in [k for k in self._pending_block_adopt if k[0] == node.node_id]:
+            del self._pending_block_adopt[key]  # superseded by this snapshot
+        for pool, unit in LEASE_UNIT_SHAPES.items():
+            agent_wids = set((blocks.get(pool) or {}).get("wids") or ())
+            head_wids = set(node.delegated.get(pool, ()))
+            for wid in agent_wids - head_wids:
+                rec = self.workers.get(wid)
+                if rec is None:
+                    # snapshotless restart, agent registered before this
+                    # worker: adopt it into the block when IT re-registers
+                    # (joining the idle pool instead would make one worker
+                    # grantable by both planes)
+                    self._pending_block_adopt[(node.node_id, wid)] = pool
+                    continue
+                if rec.state == "leased" and rec.lease_id:
+                    # snapshot-stale central lease (returned pre-crash, after
+                    # the last snapshot): the agent's newer block membership
+                    # wins — retire the lease record first, then adopt, or a
+                    # later release would rejoin the worker to the idle pool
+                    # while the agent still grants it (dual-plane worker)
+                    self._release_lease(rec.lease_id, worker_ok=True)
+                if rec.state not in ("idle", "delegated", "starting"):
+                    continue  # dead here: worker_exit settles it agent-side
+                try:
+                    node.idle[pool].remove(wid)
+                except ValueError:
+                    pass
+                if rec.state != "delegated":
+                    self._take(node.avail, unit)
+                rec.state = "delegated"
+                node.delegated.setdefault(pool, set()).add(wid)
+            for wid in head_wids - agent_wids:
+                self._undelegate_wid(node, pool, wid)
+        self._dirty = True
 
     # --------------------------------------------------------------- actors
     async def _place_actor(self, a: ActorRec):
@@ -876,6 +1133,23 @@ class Head:
                 self._node_views(), a.resources, a.strategy,
                 self.config.scheduler_spread_threshold,
             )
+            if view is None and self._placeable_with_delegated(a):
+                # the capacity exists but is parked in agents' lease blocks:
+                # reclaim (the head is the arbiter) and wait for the slots
+                # to come back instead of failing a valid actor
+                deadline = time.monotonic() + 10.0
+                while view is None and time.monotonic() < deadline:
+                    # re-stamped EVERY round: a lease_block_return landing
+                    # after the quiet period would otherwise be re-delegated
+                    # by its own _service_queue before this coroutine wakes
+                    self._last_central_demand = time.monotonic()
+                    self._last_deleg_reclaim = 0.0  # bypass the debounce
+                    self._reclaim_delegations()
+                    await asyncio.sleep(0.25)
+                    view = scheduling.pick_node(
+                        self._node_views(), a.resources, a.strategy,
+                        self.config.scheduler_spread_threshold,
+                    )
             ok = view is not None
             if ok:
                 node = self.nodes[view.node_id]
@@ -970,10 +1244,24 @@ class Head:
                 shape = self._lease_shapes.get(rec.lease_id)
             elif rec.actor_id and rec.actor_id in self.actors:
                 shape = self.actors[rec.actor_id].resources
+            elif prev_state == "delegated":
+                # agent-granted lease blocked in get(): the blocked release
+                # was the slot's unit charge (_blocked_shape_node) — take it
+                # back here or the delegated credit below over-credits the
+                # node by one unit per blocked-death
+                shape = LEASE_UNIT_SHAPES.get(rec.pool)
             cpus = (shape or {}).get("CPU", 0.0)
             if cpus and node is not None and node.state == "alive":
                 self._take(node.avail, {"CPU": cpus})
             rec.blocked = False
+        if prev_state == "delegated":
+            # the slot's unit charge returns to the node (the agent reaps the
+            # process itself and shrinks its block; any outstanding local
+            # grant dies with the worker — submitters see the broken
+            # connection and retry on a fresh lease)
+            node2 = self.nodes.get(rec.node_id)
+            if node2 is not None:
+                self._undelegate_wid(node2, rec.pool, rec.worker_id, dead=True)
         if rec.lease_id:
             self._release_lease(rec.lease_id, worker_ok=False)
         if rec.actor_id:
@@ -1033,6 +1321,9 @@ class Head:
         if node.conn is not None:
             await node.conn.close()
             node.conn = None
+        node.lease_used = {}  # stale agent-reported occupancy
+        for key in [k for k in self._pending_block_adopt if k[0] == node.node_id]:
+            del self._pending_block_adopt[key]
         # fence the agent: close its registration connection so an agent
         # declared dead by heartbeat timeout tears itself down (kills its
         # workers, sweeps its shm namespace) instead of zombieing on
@@ -1145,7 +1436,7 @@ class Head:
         {
             "heartbeat", "node_heartbeat", "kv_get", "kv_keys", "get_function",
             "obj_locate", "pull_chunk", "nodes", "cluster_resources", "stats",
-            "client_addr",
+            "client_addr", "lease_dir",
             "list_actors", "list_workers", "list_task_events", "list_objects",
             "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
             "get_actor", "subscribe", "publish", "task_events", "metrics_report",
@@ -1216,11 +1507,23 @@ class Head:
             elif rec.state in ("starting", "idle"):
                 # leased workers reconnecting after a head restart keep their
                 # lease; only fresh/idle ones (re)join the pool
-                rec.state = "idle"
                 node = self.nodes.get(rec.node_id)
-                if node is not None and node.state == "alive":
-                    if client_id not in node.idle[rec.pool]:
-                        node.idle[rec.pool].append(client_id)
+                pool_adopt = self._pending_block_adopt.pop(
+                    (rec.node_id, client_id), None
+                )
+                if pool_adopt is not None and node is not None and node.state == "alive":
+                    # the node's agent already holds this worker in a lease
+                    # block (reported at its re-registration, before the
+                    # worker re-registered here): adopt it as delegated —
+                    # NOT idle — or both planes would grant it
+                    self._take(node.avail, LEASE_UNIT_SHAPES[pool_adopt])
+                    rec.state = "delegated"
+                    node.delegated.setdefault(pool_adopt, set()).add(client_id)
+                else:
+                    rec.state = "idle"
+                    if node is not None and node.state == "alive":
+                        if client_id not in node.idle[rec.pool]:
+                            node.idle[rec.pool].append(client_id)
             fut = self._register_waiters.pop(client_id, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
@@ -1248,6 +1551,9 @@ class Head:
                     reply_err(ConnectionError(f"head cannot reach agent at {existing.addr}"))
                     return
                 self._log_event("node_readopted", node_id=node_id)
+                # local grants kept flowing while the head was down; adopt
+                # the agent's authoritative block state before scheduling
+                self._reconcile_lease_blocks(existing, msg.get("lease_blocks") or {})
                 reply(node_id=node_id, session=self.session_name, head_tcp=self.tcp_addr)
                 self._service_queue()
                 return
@@ -1276,6 +1582,10 @@ class Head:
             # a failure, not a silent capacity loss
             reply_err(ConnectionError(f"head cannot reach agent at {node.addr}"))
             return
+        if msg.get("lease_blocks"):
+            # agent outlived a snapshotless head restart: its blocks are the
+            # only record of the delegation
+            self._reconcile_lease_blocks(node, msg["lease_blocks"])
         self._pub("nodes", {"node_id": node_id, "alive": True, "resources": node.total})
         reply(node_id=node_id, session=self.session_name, head_tcp=self.tcp_addr)
         self._service_queue()
@@ -1288,6 +1598,10 @@ class Head:
                 node.mem_pressured = bool(msg["mem_pressured"])
             if "load" in msg:
                 node.load = msg["load"]
+            if "lease_stats" in msg:
+                # agent-side block occupancy (delegated vs used) for
+                # `ca status` / /api/nodes / lease_dir freshness
+                node.lease_used = msg["lease_stats"] or {}
 
     async def _h_worker_exit(self, state, msg, reply, reply_err):
         """Node agent reports one of its worker processes exited."""
@@ -1301,6 +1615,7 @@ class Head:
             rec.last_heartbeat = time.monotonic()
 
     async def _h_request_lease(self, state, msg, reply, reply_err):
+        ttl = msg.get("ttl")
         req = LeaseReq(
             shape=msg.get("shape") or {"CPU": 1.0},
             reply=reply,
@@ -1310,9 +1625,12 @@ class Head:
             bundle_index=msg.get("bundle_index", -1),
             strategy=msg.get("strategy"),
             remote=bool(state.get("remote")),
+            deadline=(time.monotonic() + float(ttl)) if ttl else None,
         )
         if not self._try_grant(req):
             self.pending_leases.append(req)
+            if req.deadline is None:
+                self._last_central_demand = time.monotonic()
             self._ensure_pool()
             self._nudge_lease_holders(req.client)
 
@@ -1366,6 +1684,11 @@ class Head:
             shape = self._lease_shapes.get(rec.lease_id)
         elif rec.actor_id and rec.actor_id in self.actors:
             shape = self.actors[rec.actor_id].resources
+        elif rec.state == "delegated":
+            # agent-granted lease: the head holds no per-lease record, but
+            # the slot's unit charge is known — blocked-in-get() workers
+            # release it so nested tasks can run (deadlock avoidance)
+            shape = LEASE_UNIT_SHAPES.get(rec.pool)
         return shape, self.nodes.get(rec.node_id)
 
     async def _h_worker_blocked(self, state, msg, reply, reply_err):
@@ -2064,6 +2387,45 @@ class Head:
         )
 
     # introspection ---------------------------------------------------------
+    def _node_lease_blocks(self, n: NodeRec) -> Dict[str, dict]:
+        """Merged delegated/used view of one node's lease blocks: size is the
+        head's authoritative delegation count, used/counters come from the
+        agent's latest heartbeat."""
+        out: Dict[str, dict] = {}
+        for pool, wids in n.delegated.items():
+            if not wids and pool not in n.lease_used:
+                continue
+            hb = n.lease_used.get(pool) or {}
+            out[pool] = {
+                "size": len(wids),
+                "used": int(hb.get("used", 0)),
+                "granted": int(hb.get("granted", 0)),
+                "denied": int(hb.get("denied", 0)),
+            }
+        return out
+
+    async def _h_lease_dir(self, state, msg, reply, reply_err):
+        """Submitter-side lease directory: which agents hold delegated lease
+        blocks, at what occupancy.  Read once per pool per TTL while a pool
+        grows (cached client-side) — NOT per lease and never per task, so
+        steady-state floods put zero load here."""
+        nodes = []
+        for n in self._alive_nodes():
+            if n.is_local or n.conn is None:
+                continue
+            # only pools with live slots: a fully-revoked block (size 0)
+            # would make every submitter probe the agent, get denied, and
+            # eagerly re-fetch this directory — MORE head traffic than the
+            # central path, the opposite of the plane's purpose
+            blocks = {
+                p: b
+                for p, b in self._node_lease_blocks(n).items()
+                if b["size"] > 0
+            }
+            if blocks:
+                nodes.append({"node_id": n.node_id, "addr": n.addr, "pools": blocks})
+        reply(nodes=nodes, delegation=self.config.lease_delegation)
+
     async def _h_nodes(self, state, msg, reply, reply_err):
         from .nodeagent import node_load_sample
 
@@ -2078,6 +2440,7 @@ class Head:
                     "labels": n.labels,
                     "load": n.load if not n.is_local else node_load_sample(),
                     "is_head_node": n.is_local,
+                    "lease_blocks": self._node_lease_blocks(n),
                     "n_workers": sum(
                         1
                         for w in self.workers.values()
@@ -2097,11 +2460,32 @@ class Head:
         # amortization end-to-end: rpc_messages_* / rpc_frames_* > 1 means
         # batch envelopes are doing their job (shown by `ca status`)
         wire = {f"rpc_{k}": v for k, v in wire_stats().items()}
+        # lease-plane aggregates: delegated slots and the agents' lifetime
+        # local-grant counters (heartbeat-fed) vs this head's central grants
+        # — `ca status` shows regressions without the dashboard
+        lease_local_granted = 0
+        lease_local_used = 0
+        lease_delegated = 0
+        for n in self._alive_nodes():
+            for pool, wids in n.delegated.items():
+                lease_delegated += len(wids)
+            seen_granted = {
+                pool: int((hb or {}).get("granted", 0))
+                for pool, hb in n.lease_used.items()
+            }
+            lease_local_granted += sum(seen_granted.values())
+            lease_local_used += sum(
+                int((hb or {}).get("used", 0)) for hb in n.lease_used.values()
+            )
         reply(
             rpc_counts=dict(self.rpc_counts),
             stats=dict(
                 self.stats,
                 **wire,
+                lease_delegated_slots=lease_delegated,
+                lease_local_used=lease_local_used,
+                lease_local_granted=lease_local_granted,
+                lease_head_granted=self.stats["leases_granted"],
                 pending_leases=len(self.pending_leases),
                 idle_workers=sum(
                     len(d) for n in self._alive_nodes() for d in n.idle.values()
@@ -2424,13 +2808,22 @@ class Head:
                     "idle",
                     "leased",
                     "actor",
+                    # block workers are valid victims too: on an agent node
+                    # in steady state EVERY pool worker is delegated, and
+                    # excluding them would leave memory pressure with no
+                    # candidate at all.  The head can't see whether a local
+                    # lease is running on one, so it is treated like a
+                    # leased worker (retriable: the submitter's retry budget
+                    # absorbs the kill; the agent reaps and shrinks the
+                    # block).
+                    "delegated",
                 ):
                     continue
                 a = self.actors.get(rec.actor_id) if rec.actor_id else None
                 cands.append(mm.Candidate(
                     worker=rec,
                     is_idle=rec.state == "idle",
-                    retriable=rec.state == "leased"
+                    retriable=rec.state in ("leased", "delegated")
                     or (a is not None and a.can_restart),
                     busy_since=rec.busy_since,
                 ))

@@ -1,18 +1,27 @@
 """Node agent: the per-node daemon (raylet analogue, src/ray/raylet/
 node_manager.h) for every node other than the head's own.
 
-Responsibilities, mirroring the reference raylet minus local scheduling
-(which stays centralized at the head for this control-plane scale):
+Responsibilities, mirroring the reference raylet:
 - register the node (its resources) with the head over TCP and heartbeat;
 - spawn/kill/monitor this node's worker processes on head request
   (worker_pool.h role) and report their deaths;
+- grant worker leases NODE-LOCALLY out of head-delegated "lease blocks"
+  (the LocalTaskManager/raylet-grant analogue, see LeaseGranter below);
 - serve chunked reads of this node's shm objects for node-to-node transfer
   (object_manager.h push analogue);
 - sweep departed clients' arena files and clean the node's shm namespace on
   shutdown.
 
-The agent deliberately has no role on the task hot path: drivers/workers push
-tasks directly to leased workers, exactly as on the head node.
+Lease plane: the head remains the global placement policy (node choice,
+spillover, PG bundle charging, fairness) but delegates bounded per-pool
+lease capacity to each agent as lease blocks — specific registered idle
+workers whose unit resource shape the head pre-charges against the node.
+Submitters dial this agent directly (`lease_grant`/`lease_release`) for the
+hot unit-shape lease class, so steady-state task floods never touch the
+head's loop; exhausted blocks and every other lease class fall back to the
+head, which also revokes delegated capacity on demand and reclaims it
+wholesale when an agent dies.  Task pushes still go driver->worker directly;
+the agent is only on the lease path, never the task path.
 """
 
 from __future__ import annotations
@@ -51,6 +60,123 @@ def node_load_sample() -> Dict[str, float]:
     return out
 
 
+class LeaseGranter:
+    """Node-local lease granting over head-delegated lease blocks (the
+    LocalTaskManager analogue of src/ray/raylet/local_task_manager.h).
+
+    The head delegates specific idle workers (wid + dialable address) per
+    pool; their unit resource shape was charged against the node centrally
+    at delegation time, so granting here requires no further accounting —
+    a grant is a dictionary move.  Lease liveness is connection liveness:
+    each lease remembers the granting client's connection state, and the
+    agent releases every lease of a departed connection (mirroring the
+    head's client-disconnect lease sweep).  Worker death (reaped by the
+    agent) frees the slot and shrinks the block.
+    """
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        # pool -> wid -> {"addr": str, "lease": Optional[str]}
+        self.workers: Dict[str, Dict[str, dict]] = {}
+        # lease_id -> (pool, wid, granting conn-state dict)
+        self.leases: Dict[str, tuple] = {}
+        # per-pool lifetime counters (attribution must stay per pool: the
+        # head sums them across pools for ca status / lease_plane())
+        self.counters: Dict[str, Dict[str, int]] = {}
+        self._seq = 0
+
+    def _pool_counters(self, pool: str) -> Dict[str, int]:
+        return self.counters.setdefault(
+            pool, {"granted": 0, "denied": 0, "released": 0, "revoked": 0}
+        )
+
+    def add_workers(self, pool: str, workers) -> int:
+        """Absorb a lease_block delegation; duplicate wids are idempotent
+        (re-delegation after head-restart reconciliation)."""
+        slot = self.workers.setdefault(pool, {})
+        added = 0
+        for w in workers or ():
+            if w["wid"] not in slot:
+                slot[w["wid"]] = {"addr": w["addr"], "lease": None}
+                added += 1
+        return added
+
+    def grant(self, pool: str, conn_state) -> Optional[dict]:
+        """Grant one unit-shape lease from the pool's block, or None when
+        the block is exhausted (the submitter falls back to the head)."""
+        for wid, ent in self.workers.get(pool, {}).items():
+            if ent["lease"] is None:
+                self._seq += 1
+                lease_id = f"L{self.node_id}:{self._seq}:{os.urandom(3).hex()}"
+                ent["lease"] = lease_id
+                self.leases[lease_id] = (pool, wid, conn_state)
+                self._pool_counters(pool)["granted"] += 1
+                return {"lease_id": lease_id, "worker_id": wid, "addr": ent["addr"]}
+        self._pool_counters(pool)["denied"] += 1
+        return None
+
+    def release(self, lease_id: str) -> None:
+        rec = self.leases.pop(lease_id, None)
+        if rec is None:
+            return  # idempotent: worker-exit or disconnect already freed it
+        pool, wid, _ = rec
+        ent = self.workers.get(pool, {}).get(wid)
+        if ent is not None and ent["lease"] == lease_id:
+            ent["lease"] = None
+        self._pool_counters(pool)["released"] += 1
+
+    def release_for_conn(self, conn_state) -> int:
+        """A granting client's connection closed: its leases are dead (the
+        agent-side analogue of the head's disconnect lease sweep)."""
+        gone = [lid for lid, (_, _, st) in self.leases.items() if st is conn_state]
+        for lid in gone:
+            self.release(lid)
+        return len(gone)
+
+    def on_worker_exit(self, wid: str) -> None:
+        for pool, slot in self.workers.items():
+            ent = slot.pop(wid, None)
+            if ent is not None:
+                if ent["lease"] is not None:
+                    self.leases.pop(ent["lease"], None)
+                return
+
+    def revoke(self, pool: str, n: int) -> list:
+        """Give back up to n UNLEASED workers (head revocation / fairness
+        reclaim); outstanding grants keep their workers."""
+        out = []
+        slot = self.workers.get(pool, {})
+        for wid in list(slot):
+            if len(out) >= n:
+                break
+            if slot[wid]["lease"] is None:
+                del slot[wid]
+                out.append(wid)
+        self._pool_counters(pool)["revoked"] += len(out)
+        return out
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-pool block occupancy + lifetime counters, shipped to the head
+        with every heartbeat (the existing dissemination path)."""
+        out = {}
+        for pool, slot in self.workers.items():
+            used = sum(1 for e in slot.values() if e["lease"] is not None)
+            out[pool] = {"size": len(slot), "used": used, **self._pool_counters(pool)}
+        return out
+
+    def block_snapshot(self) -> Dict[str, dict]:
+        """What a (re)registration reports so a restarted head re-adopts the
+        delegated blocks instead of double-granting the same workers."""
+        return {
+            pool: {
+                "wids": list(slot),
+                "used": sum(1 for e in slot.values() if e["lease"] is not None),
+            }
+            for pool, slot in self.workers.items()
+            if slot
+        }
+
+
 class NodeAgent:
     def __init__(self):
         self.session_dir = os.environ["CA_SESSION_DIR"]
@@ -72,7 +198,12 @@ class NodeAgent:
         os.makedirs(self.node_dir, exist_ok=True)
         self.shm_ns_dir = os.path.join("/dev/shm", self.session_name, self.node_id)
         os.makedirs(self.shm_ns_dir, exist_ok=True)
-        self.server = Server([self.serve_addr_spec], self._handle)
+        self.server = Server(
+            [self.serve_addr_spec], self._handle, on_disconnect=self._on_client_gone
+        )
+        # node-local lease granting over head-delegated blocks (raylet
+        # LocalTaskManager analogue)
+        self.granter = LeaseGranter(self.node_id)
         # chip pinning for this node's TPU workers (same policy as the head's
         # local node; the agent owns spawns here, so it owns the allocator)
         from .accelerators import ChipAllocator
@@ -131,9 +262,45 @@ class NodeAgent:
                 pass
 
     # --------------------------------------------------------------- handler
+    async def _on_client_gone(self, state):
+        # a submitter's connection died: its locally-granted leases are dead
+        # (lease liveness IS connection liveness on the local plane)
+        self.granter.release_for_conn(state)
+
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
-        if m == "spawn_worker":
+        if m == "lease_grant":
+            # node-local grant (hot path): a dict move, no head round-trip.
+            # An exhausted block replies granted=False — the submitter falls
+            # back to the head, which may revoke/re-balance capacity.
+            g = self.granter.grant(msg.get("pool", "cpu"), state)
+            if g is None:
+                reply(granted=False)
+            else:
+                reply(granted=True, **g)
+        elif m == "lease_release":
+            for lid in msg.get("lease_ids") or ():
+                self.granter.release(lid)
+            reply()
+        elif m == "lease_block":
+            # head delegation push: absorb the block's workers
+            self.granter.add_workers(msg.get("pool", "cpu"), msg.get("workers"))
+            reply()
+        elif m == "lease_block_revoke":
+            # head wants capacity back (pending central work / fairness):
+            # return unleased workers; outstanding grants keep theirs
+            pool = msg.get("pool", "cpu")
+            wids = self.granter.revoke(pool, int(msg.get("n", 1 << 30)))
+            if wids:
+                try:
+                    self.head.notify(
+                        "lease_block_return",
+                        node_id=self.node_id, pool=pool, wids=wids,
+                    )
+                except Exception:
+                    pass  # head gone: re-register reconciles the block
+            reply(wids=wids)
+        elif m == "spawn_worker":
             self._spawn_worker(msg["wid"], msg.get("purpose", "pool"), msg.get("pool", "cpu"))
             reply()
         elif m == "kill_worker":
@@ -192,6 +359,10 @@ class NodeAgent:
                 hb = {"node_id": self.node_id, "load": node_load_sample()}
                 if self.mem_monitor is not None:
                     hb["mem_pressured"] = self.mem_monitor.is_pressured()
+                # delegated/used block occupancy rides the heartbeat (the
+                # same dissemination path as load): the head's `ca status`,
+                # /api/nodes, and revocation sizing read it
+                hb["lease_stats"] = self.granter.stats()
                 self.head.notify("node_heartbeat", **hb)
             except Exception:
                 pass
@@ -200,6 +371,9 @@ class NodeAgent:
             for wid, proc in list(self.procs.items()):
                 if proc.poll() is not None:
                     del self.procs[wid]
+                    # free the lease slot first: a delegated worker's death
+                    # shrinks the block and kills its outstanding grant
+                    self.granter.on_worker_exit(wid)
                     if self.chip_alloc is not None:
                         self.chip_alloc.release(self._worker_chips.pop(wid, None))
                     try:
@@ -226,6 +400,7 @@ class NodeAgent:
             resources=self.resources,
             labels=self.labels,
             pid=os.getpid(),
+            lease_blocks=self.granter.block_snapshot(),
         )
         # readiness marker for the cluster fixture
         ready = os.path.join(self.node_dir, "agent.ready")
@@ -271,6 +446,10 @@ class NodeAgent:
                     resources=self.resources,
                     labels=self.labels,
                     pid=os.getpid(),
+                    # local grants kept flowing while the head was down; the
+                    # block snapshot lets the restarted head re-adopt the
+                    # delegation (and reconcile grants made in the outage)
+                    lease_blocks=self.granter.block_snapshot(),
                     timeout=5,
                 )
                 self.head = conn

@@ -23,6 +23,19 @@ into ONE physical frame — a `batch` envelope {"m": "batch", "b": [msg, ...]}
 transparently expand envelopes back into logical messages; chaos budgets and
 per-method stats count LOGICAL messages, never physical frames.
 
+Lease plane: the node-local lease granting subsystem rides this same frame
+protocol and its batch envelopes.  Head -> agent: `lease_block` (delegate
+workers into a block), `lease_block_revoke` (reclaim unleased slots).
+Agent -> head: `lease_block_return` (returned slots), plus per-pool
+`lease_stats` piggybacked on `node_heartbeat`.  Submitter -> agent:
+`lease_grant` / `lease_release` — the hot lease class, which therefore
+never crosses the head's loop in steady state.  Submitter -> head:
+`request_lease` may carry `ttl` (escalation probe; the head replies
+{"expired": true} past it), and `push_task` may carry `fn_blob` (function
+definition inlined while the head — the blob directory — is down).  All of
+these are ordinary logical messages: they cork, batch, and charge chaos
+budgets exactly like every other method.
+
 Trace context: logical task/actor-call messages may carry a small optional
 `tr` field (TRACE_FIELD) — {"tid": trace id, "sid": parent span id} — minted
 at remote() submission when util/tracing is enabled.  Batch envelopes splice

@@ -58,6 +58,25 @@ _global_lock = threading.Lock()
 # module at import time).
 TRACE_HOOK: Optional[Any] = None
 
+# Lease-plane counters (same plain-int discipline as protocol.WIRE_STATS:
+# loop-owned increments, flusher-only reads).  local_* = grants/releases
+# served by node agents out of delegated lease blocks; head_* = central
+# grants; fallbacks = local attempts that fell through to the head (agent
+# exhausted/unreachable).  Shipped as ca_lease_* counters by util/metrics.
+LEASE_STATS: Dict[str, int] = {
+    "local_grants": 0,
+    "local_denied": 0,
+    "local_released": 0,
+    "head_grants": 0,
+    "head_released": 0,
+    "fallbacks": 0,
+}
+
+
+def lease_stats() -> Dict[str, int]:
+    """Snapshot of this process's lease-plane counters."""
+    return dict(LEASE_STATS)
+
 
 def global_worker() -> "Worker":
     if _global_worker is None:
@@ -121,6 +140,10 @@ class _Lease:
     inflight: int = 0
     dead: bool = False
     last_idle: float = field(default_factory=time.monotonic)
+    # which plane granted this lease: a node agent's address (local grant
+    # out of a delegated lease block) or None for the head.  Releases go
+    # back to the granter.
+    granter: Optional[str] = None
 
 
 class LeasePool:
@@ -163,6 +186,11 @@ class LeasePool:
         # past it.  Expires when the head stops re-nudging (contention over).
         self.contended_cap: Optional[int] = None
         self.contended_until = 0.0
+        # every lease block denied us while we already hold capacity: the
+        # cluster is saturated for this class — rate-limit further growth
+        # attempts so a long flood pipelines instead of re-probing
+        # agents/head on every release (the pipelining regime absorbs it)
+        self._growth_backoff_until = 0.0
 
     def _pick(self) -> Optional[_Lease]:
         best = None
@@ -209,6 +237,8 @@ class LeasePool:
         if self.requests_outstanding >= self._MAX_OUTSTANDING:
             return False
         live = sum(1 for l in self.leases if not l.dead)
+        if live > 0 and time.monotonic() < self._growth_backoff_until:
+            return False  # saturated lease plane: pipeline, don't re-probe
         limit = min(self.max_leases, self.inflight_total)
         cap = self._fair_cap()
         if cap is not None:
@@ -236,14 +266,83 @@ class LeasePool:
             return False
         return self.requests_outstanding >= self._MAX_OUTSTANDING
 
+    def _delegatable(self) -> bool:
+        """Is this pool's lease class grantable node-locally?  Only the hot
+        default class qualifies ({"CPU": 1}, no PG, no strategy): PG bundle
+        charging and placement policy stay centralized at the head, and
+        remote (client-mode) drivers need the head's TCP address mapping."""
+        return (
+            self.pg is None
+            and self.strategy is None
+            and self.shape == {"CPU": 1.0}
+            and not self.worker.client_mode
+            and self.worker.config.lease_delegation
+        )
+
+    def _adopt_lease(self, lease: "_Lease"):
+        self.leases.append(lease)
+        self.requests_outstanding -= 1
+        self._drain_backlog()
+        self._wake(self.max_inflight)
+
+    # head-side ttl on lease-plane escalation probes: a delegatable-class
+    # request queued at the head expires after this long and the coroutine
+    # re-probes the agents — so overflow requests never pin central state
+    # (the head only revokes lease blocks for no-ttl pendings)
+    _HEAD_PROBE_TTL_S = 2.0
+
     async def _request_lease(self):
+        # lease plane: try the node agents' delegated blocks first — a grant
+        # there is one direct agent RPC, zero head traffic (the raylet-grant
+        # split; the head stays the fallback granter)
+        delegatable = self._delegatable()
+        lease_plane = False  # delegated blocks exist somewhere
+        if delegatable:
+            lease, lease_plane = await self.worker.local_lease_grant("cpu")
+            if lease is not None:
+                LEASE_STATS["local_grants"] += 1
+                self._adopt_lease(lease)
+                return
+            if lease_plane:
+                # the plane exists but denied us — head fallback is an
+                # ESCALATION PROBE, not the primary path
+                LEASE_STATS["fallbacks"] += 1
+                if any(not l.dead for l in self.leases):
+                    # blocks exhausted while we already hold capacity:
+                    # saturated.  Back off growth so a long flood pipelines
+                    # on what it has instead of re-probing agents + head on
+                    # every release.  A pool with NO leases never backs off
+                    # — it must reach the head for its first grant.
+                    self._growth_backoff_until = time.monotonic() + 0.25
+                if self.requests_outstanding > 1:
+                    # another of this pool's requests is already subscribed
+                    # at the head; a second adds nothing the agents' churn
+                    # won't deliver first — abandon this growth attempt
+                    self.requests_outstanding -= 1
+                    return
         kw = {}
         if self.pg is not None:
             kw = {"pg_id": self.pg[0], "bundle_index": self.pg[1]}
         if self.strategy is not None:
             kw["strategy"] = self.strategy
+        if lease_plane:
+            # without agents in play this stays a classic held-until-granted
+            # request: single-node clusters keep their full pending queue
+            # (the autoscaler's demand signal) and growth concurrency
+            kw["ttl"] = self._HEAD_PROBE_TTL_S
         attempts = 0
+        retry_local = False
         while True:
+            if delegatable and retry_local:
+                # between head (re)subscriptions — expiry or restart window —
+                # probe the agents: the lease plane keeps granting while the
+                # control plane is down or saturated
+                lease, lease_plane = await self.worker.local_lease_grant("cpu")
+                if lease is not None:
+                    LEASE_STATS["local_grants"] += 1
+                    self._adopt_lease(lease)
+                    return
+            retry_local = True
             try:
                 reply = await self.worker.head.call(
                     "request_lease", shape=self.shape, timeout=None, **kw
@@ -265,11 +364,14 @@ class LeasePool:
                 self.requests_outstanding -= 1
                 self._fail_waiters(e)
                 return
-            lease = _Lease(reply["lease_id"], reply["worker_id"], reply["addr"])
-            self.leases.append(lease)
-            self.requests_outstanding -= 1
-            self._drain_backlog()
-            self._wake(self.max_inflight)
+            if reply.get("expired"):
+                # at-capacity probe came back empty (not an error): re-probe
+                # the agents, then re-subscribe — waiting for capacity is
+                # legitimate indefinitely, exactly like a pending request
+                await asyncio.sleep(0.1)
+                continue
+            LEASE_STATS["head_grants"] += 1
+            self._adopt_lease(_Lease(reply["lease_id"], reply["worker_id"], reply["addr"]))
             return
 
     def _wake(self, n: int = 1):
@@ -358,10 +460,9 @@ class LeasePool:
                 await self.worker.conn_to(lease.addr)
             except Exception:
                 lease.dead = True
-                try:
-                    self.worker.head.notify("return_lease", lease_ids=[lease.lease_id])
-                except Exception:
-                    pass  # head unreachable: its worker-death path reclaims
+                # granter-aware give-back (head or agent); unreachable
+                # granters reclaim via their own worker-death/disconnect paths
+                self.worker.return_leases([lease])
             finally:
                 self._dialing.discard(lease.addr)
                 self._drain_backlog()
@@ -399,15 +500,10 @@ class LeasePool:
             return
         lease.dead = True
         self.leases = [l for l in self.leases if not l.dead]
-        w = self.worker
-        if w.head is not None and not w.head.closed:
-            try:
-                w.head.notify("return_lease", lease_ids=[lease.lease_id])
-            except Exception:
-                pass
+        self.worker.return_leases([lease])
 
-    def reap_idle(self, now: float, timeout: float) -> List[str]:
-        """Return lease_ids to give back to the head."""
+    def reap_idle(self, now: float, timeout: float) -> List[_Lease]:
+        """Leases to give back to their granter (head or node agent)."""
         out = []
         keep = []
         for l in self.leases:
@@ -420,13 +516,13 @@ class LeasePool:
                 and not self.backlog
             ):
                 l.dead = True
-                out.append(l.lease_id)
+                out.append(l)
             else:
                 keep.append(l)
         self.leases = [l for l in self.leases if not l.dead]
         return out
 
-    def reap_contended(self) -> List[str]:
+    def reap_contended(self) -> List[_Lease]:
         """Another client's lease request is pending at the head: give back
         every idle lease this pool does not need for its own current demand
         (contended-cluster fairness; the 1s reap_idle horizon is for the
@@ -447,7 +543,7 @@ class LeasePool:
                 continue
             l.dead = True
             live -= 1
-            out.append(l.lease_id)
+            out.append(l)
         if out:
             self.leases = [l for l in self.leases if not l.dead]
         return out
@@ -581,6 +677,20 @@ class Worker:
         # pre-encoded task-spec templates for the argless fast paths, keyed by
         # the spec's constant fields (fn/actor+method, num_returns, retriable)
         self._spec_templates: Dict[tuple, MsgTemplate] = {}
+        # lease-plane directory cache: (fetched_at, entries|None).  Entries
+        # survive head outages (stale beats nothing: agents keep granting
+        # while the control plane restarts); refreshed at most once per
+        # lease_dir_ttl_s and only while a pool is growing.
+        self._lease_dir_cache: Tuple[float, Optional[list]] = (0.0, None)
+        # fn_ids whose blob was already inlined per worker connection during
+        # a head outage: one delivery per (conn, fn) — the worker caches the
+        # definition, so repeating the blob on every push of a flood would
+        # just multiply frame size (weak-keyed: dies with the connection)
+        import weakref
+
+        self._conn_fn_sent: "weakref.WeakKeyDictionary[Connection, set]" = (
+            weakref.WeakKeyDictionary()
+        )
         self._stopped = False
         self._head_fenced = False  # head refused re-registration: must exit
         self._external_loop = loop is not None
@@ -714,11 +824,7 @@ class Worker:
                     pool.contended_cap = int(cap)
                     pool.contended_until = time.monotonic() + 1.0
                 to_return.extend(pool.reap_contended())
-            if to_return and self.head is not None and not self.head.closed:
-                try:
-                    self.head.notify("return_lease", lease_ids=to_return)
-                except Exception:
-                    pass
+            self.return_leases(to_return)
 
     async def _housekeeping(self):
         period = 0.25
@@ -743,11 +849,7 @@ class Worker:
             to_return = []
             for pool in self._lease_pools.values():
                 to_return.extend(pool.reap_idle(now, self.config.lease_idle_timeout_s))
-            if to_return and self.head and not self.head.closed:
-                try:
-                    self.head.notify("return_lease", lease_ids=to_return)
-                except Exception:
-                    pass
+            self.return_leases(to_return)
             self.reference_counter.flush()
             self._flush_task_events()
 
@@ -806,6 +908,112 @@ class Worker:
             return False
         self.head = conn
         return True
+
+    # ----------------------------------------------------------- lease plane
+    async def _lease_directory(self) -> list:
+        """Where are the delegated lease blocks?  One head RPC per TTL while
+        pools grow; zero in steady state (leases are reused/pipelined).  The
+        cached directory is intentionally kept through head outages and RPC
+        failures — the agents it names keep granting regardless."""
+        ts, entries = self._lease_dir_cache
+        now = time.monotonic()
+        if entries is not None and now - ts < self.config.lease_dir_ttl_s:
+            return entries
+        if self.head is None or self.head.closed:
+            return entries or []
+        try:
+            r = await self.head.call("lease_dir", timeout=5)
+            entries = (r.get("nodes") or []) if r.get("delegation", True) else []
+        except Exception:
+            entries = entries or []  # keep stale; back off one TTL either way
+        self._lease_dir_cache = (now, entries)
+        return entries
+
+    async def local_lease_grant(self, pool: str) -> Tuple[Optional[_Lease], bool]:
+        """Ask node agents for a lease out of their delegated blocks (IO
+        loop).  Returns (lease, lease_plane_active): tries agents
+        most-free-first; a denial (exhausted block) or unreachable agent
+        falls through to the next, then to (None, True) — the caller falls
+        back to the head.  (None, False) means NO delegated blocks exist
+        (single-node cluster, delegation off): the caller must behave
+        exactly like the classic central path — no probe ttl, no growth
+        capping — or head-only topologies lose demand signal and
+        concurrency."""
+        entries = await self._lease_directory()
+        if not entries:
+            return None, False
+        from . import scheduling
+
+        denied = False
+        for ent in scheduling.rank_delegation(entries, pool):
+            try:
+                conn = await self.conn_to(ent["addr"])
+                r = await conn.call("lease_grant", pool=pool, timeout=5)
+            except Exception:
+                continue  # agent gone: the head's node-death path reclaims
+            blk = (ent.get("pools") or {}).get(pool)
+            if r.get("granted"):
+                if blk is not None:  # optimistic: steer the next grant away
+                    blk["used"] = blk.get("used", 0) + 1
+                return _Lease(
+                    r["lease_id"], r["worker_id"], r["addr"], granter=ent["addr"]
+                ), True
+            denied = True
+            if blk is not None:
+                blk["used"] = blk.get("size", 0)
+        if denied:
+            LEASE_STATS["local_denied"] += 1
+            # the cached occupancy lied (all blocks full): refresh eagerly on
+            # the next growth attempt instead of waiting out the TTL
+            self._lease_dir_cache = (0.0, self._lease_dir_cache[1])
+        return None, True
+
+    def _fn_blob_for_push(self, conn: Connection, fn_id: bytes) -> Optional[bytes]:
+        """Function blob to inline into a push, or None.  Only while the head
+        (the normal blob directory) is down, and only ONCE per (connection,
+        fn): the worker caches the definition after the first delivery, and
+        concurrent pushes that race the first load fall into the worker's
+        fetch-retry loop, which rechecks its local cache."""
+        if self.head is not None and not self.head.closed:
+            return None
+        sent = self._conn_fn_sent.get(conn)
+        if sent is None:
+            sent = set()
+            self._conn_fn_sent[conn] = sent
+        if fn_id in sent:
+            return None
+        blob = self.fn_manager.blob_for(fn_id)
+        if blob is not None:
+            sent.add(fn_id)
+        return blob
+
+    def return_leases(self, leases: List[_Lease]) -> None:
+        """Give leases back to their granters, grouped per plane: head
+        leases ride one return_lease notify; agent-granted leases go back to
+        their agent as lease_release.  A granter we can no longer reach
+        needs nothing — both planes sweep leases on client disconnect and
+        worker death (IO loop only)."""
+        if not leases:
+            return
+        by_granter: Dict[Optional[str], List[str]] = {}
+        for l in leases:
+            by_granter.setdefault(l.granter, []).append(l.lease_id)
+        for granter, lids in by_granter.items():
+            if granter is None:
+                if self.head is not None and not self.head.closed:
+                    try:
+                        self.head.notify("return_lease", lease_ids=lids)
+                        LEASE_STATS["head_released"] += len(lids)
+                    except Exception:
+                        pass
+            else:
+                conn = self._conns.get(self._normalize_peer_addr(granter))
+                if conn is not None and not conn.closed:
+                    try:
+                        conn.notify("lease_release", lease_ids=lids)
+                        LEASE_STATS["local_released"] += len(lids)
+                    except Exception:
+                        pass
 
     def _flush_refs(self, inc: List[bytes], dec: List[bytes]):
         self._queue_refs(inc, dec)
@@ -2383,6 +2591,10 @@ class Worker:
         trace = opts.get("_trace")
         num_returns = opts.get("num_returns", 1)
         retriable = opts.get("max_retries", self.config.default_max_retries) > 0
+        # head down (restart window): inline the function definition — the
+        # lease plane keeps granting, so a push must not strand its worker
+        # on a head blob fetch it cannot make (once per conn+fn)
+        fn_blob = self._fn_blob_for_push(conn, fn_id)
 
         def spec_fields():
             # one definition for both the template constants and the traced
@@ -2398,16 +2610,16 @@ class Worker:
             }
 
         try:
-            if trace is None:
+            if trace is None and fn_blob is None:
                 tmpl = self._task_spec_template(
                     ("task", fn_id, num_returns), spec_fields, retriable=retriable
                 )
                 conn.call_template("push_task", tmpl, on_reply, task_id.binary())
             else:
-                # traced push: the pre-encoded template cannot carry a
-                # per-call field, so the spec is encoded in full with the
-                # trace context riding the same corked envelope
-                if TRACE_HOOK is not None:
+                # traced or blob-inlined push: the pre-encoded template
+                # cannot carry a per-call field, so the spec is encoded in
+                # full, riding the same corked envelope
+                if trace is not None and TRACE_HOOK is not None:
                     TRACE_HOOK.record_task_event(
                         task_id.hex(), None, "task", "SCHEDULED", trace=trace,
                         worker_id=self.client_id, node_id=self.node_id,
@@ -2415,11 +2627,14 @@ class Worker:
                     )
                 fields = spec_fields()
                 del fields["m"]  # call_cb supplies the method
+                if fn_blob is not None:
+                    fields["fn_blob"] = fn_blob
+                if trace is not None:
+                    fields[TRACE_FIELD] = trace
                 conn.call_cb(
                     "push_task", on_reply,
                     task_id=task_id.binary(),
                     **fields,
-                    **{TRACE_FIELD: trace},
                 )
         except ConnectionError:
             self._inflight_tasks.pop(task_id.binary(), None)
@@ -2505,6 +2720,13 @@ class Worker:
                         worker_id=self.client_id, node_id=self.node_id,
                         target=lease.worker_id,
                     )
+                # head down: inline the function definition (see _push_fast)
+                extra = {}
+                fn_blob = self._fn_blob_for_push(conn, fn_id)
+                if fn_blob is not None:
+                    extra["fn_blob"] = fn_blob
+                if trace is not None:
+                    extra[TRACE_FIELD] = trace
                 # no RPC timeout here: the reply arrives only after the task
                 # finishes, which may legitimately take arbitrarily long;
                 # worker death is detected by the connection breaking.
@@ -2519,7 +2741,7 @@ class Worker:
                     runtime_env=opts.get("runtime_env"),
                     retriable=retries > 0,
                     timeout=None,
-                    **({TRACE_FIELD: trace} if trace is not None else {}),
+                    **extra,
                 )
             except ConnectionError as e:
                 dead = True

@@ -468,8 +468,12 @@ class WorkerProcess:
                 return out
             fn = self.worker.fn_manager.get(msg["fn_id"])
             if fn is None:
-                reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
-                fn = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
+                if msg.get("fn_blob") is not None:
+                    # definition inlined by a submitter that saw the head
+                    # down — no head dependency on this push at all
+                    fn = self.worker.fn_manager.load(msg["fn_id"], msg["fn_blob"])
+                else:
+                    fn = await self._fetch_function(msg["fn_id"])
             ev_name = getattr(fn, "__name__", "task")
             out = await self.loop.run_in_executor(
                 self.executor, self._exec_sync, fn, msg, task_id, None
@@ -762,6 +766,29 @@ class WorkerProcess:
         else:
             reply_err(ValueError(f"unknown worker method {m}"))
 
+    async def _fetch_function(self, fn_id):
+        """Fetch + load a function blob from the head, riding through a head
+        restart: the task asking for it was legitimately pushed (lease-plane
+        grants keep flowing while the control plane is down), so a transient
+        head outage must not turn it into a spurious TaskError.  The
+        housekeeping loop redials; this retries until the push timeout."""
+        deadline = self.loop.time() + self.worker.config.push_timeout_s
+        while True:
+            # a concurrent push may have inlined the definition (submitters
+            # ship fn_blob once per connection during head outages) — the
+            # local cache beats another head round-trip
+            fn = self.worker.fn_manager.get(fn_id)
+            if fn is not None:
+                return fn
+            try:
+                reply = await self.worker.head.call("get_function", fn_id=fn_id)
+                break
+            except ConnectionError:
+                if self.loop.time() > deadline:
+                    raise
+                await asyncio.sleep(0.5)
+        return self.worker.fn_manager.load(fn_id, reply["blob"])
+
     async def _resolve_callable(self, msg, is_actor_call: bool):
         """Resolve the task function / actor method for the streaming path.
         Returns the callable, or a terminal-reply dict on failure."""
@@ -772,8 +799,10 @@ class WorkerProcess:
                 return getattr(self.actor.instance, msg["method"])
             fn = self.worker.fn_manager.get(msg["fn_id"])
             if fn is None:
-                reply = await self.worker.head.call("get_function", fn_id=msg["fn_id"])
-                fn = self.worker.fn_manager.load(msg["fn_id"], reply["blob"])
+                if msg.get("fn_blob") is not None:
+                    fn = self.worker.fn_manager.load(msg["fn_id"], msg["fn_blob"])
+                else:
+                    fn = await self._fetch_function(msg["fn_id"])
             return fn
         except BaseException as e:
             err = self._error_results(1, e)[0]["e"]

@@ -129,9 +129,11 @@ async function refresh() {
     " &nbsp; actors " + s.stats.n_actors + " &nbsp; objects " + s.stats.n_objects +
     " &nbsp; pending leases " + s.stats.pending_leases;
   const nodes = await (await fetch("/api/nodes")).json();
-  document.getElementById("nodes").innerHTML = row(["node", "alive", "head", "CPU avail/total", "workers", "labels"], "th") +
+  document.getElementById("nodes").innerHTML = row(["node", "alive", "head", "CPU avail/total", "workers", "leases used/delegated", "labels"], "th") +
     nodes.map(n => row([n.node_id, n.alive ? "<span class=ok>yes</span>" : "<span class=bad>DEAD</span>",
       n.is_head_node ? "*" : "", (n.available.CPU||0) + "/" + (n.resources.CPU||0), n.n_workers,
+      esc(Object.entries(n.lease_blocks||{})
+        .map(([p, b]) => p + " " + b.used + "/" + b.size).join(" ") || "-"),
       esc(Object.entries(n.labels||{}).filter(([k]) => k != "ca.io/node-id")
         .map(([k, v]) => k.replace("ca.io/", "") + "=" + v).join(" "))])).join("");
   const actors = await (await fetch("/api/actors")).json();
@@ -262,6 +264,9 @@ class Dashboard:
                         "available": n.avail,
                         "load": n.load,
                         "labels": n.labels,
+                        # delegated vs used lease-block capacity per pool:
+                        # an exhausted block is diagnosable at a glance
+                        "lease_blocks": h._node_lease_blocks(n),
                         "n_workers": sum(
                             1
                             for w in h.workers.values()

@@ -60,26 +60,53 @@ _WIRE_DESCS = {
 }
 
 
+_lease_shipped: Dict[str, int] = {}
+_LEASE_DESCS = {
+    "local_grants": "leases granted node-locally by agents (lease blocks)",
+    "local_denied": "local grant attempts denied everywhere (blocks full)",
+    "local_released": "leases released back to their granting agent",
+    "head_grants": "leases granted centrally by the head",
+    "head_released": "leases returned to the head",
+    "fallbacks": "local grant attempts that fell back to the head",
+}
+
+
+def _counter_deltas(
+    prefix: str, stats: Dict[str, int], shipped: Dict[str, int], descs: Dict[str, str]
+) -> List[dict]:
+    """Delta-ship a module counter dict as `<prefix><key>` counter records
+    (counter semantics at the head aggregator; first-seen zeros included so
+    the series exists from the first flush)."""
+    out = []
+    tags = _tags_key(None)
+    for k, v in stats.items():
+        delta = v - shipped.get(k, 0)
+        if delta or k not in shipped:
+            shipped[k] = v
+            out.append(
+                {"name": f"{prefix}{k}", "type": "counter",
+                 "desc": descs.get(k, ""), "tags_key": tags,
+                 "value": float(delta)}
+            )
+    return out
+
+
 def _wire_records() -> List[dict]:
     """Runtime wire counters (core/protocol.py WIRE_STATS) as ca_rpc_*
     counter records — the observability path for the control-plane batching
     layer (dashboard /metrics, `get_metrics_snapshot`, grafana)."""
     from ..core.protocol import WIRE_STATS
 
-    out = []
-    tags = _tags_key(None)
-    for k, v in WIRE_STATS.items():
-        delta = v - _wire_shipped.get(k, 0)
-        if delta or k not in _wire_shipped:
-            # ship first-seen zeros too: the series exists from the first
-            # flush, so dashboards/tests can rely on its presence
-            _wire_shipped[k] = v
-            out.append(
-                {"name": f"ca_rpc_{k}", "type": "counter",
-                 "desc": _WIRE_DESCS.get(k, ""), "tags_key": tags,
-                 "value": float(delta)}
-            )
-    return out
+    return _counter_deltas("ca_rpc_", WIRE_STATS, _wire_shipped, _WIRE_DESCS)
+
+
+def _lease_records() -> List[dict]:
+    """Lease-plane counters (core/worker.py LEASE_STATS) as ca_lease_*
+    records: local (agent-granted) vs head (central) grants/releases — the
+    series that proves the hot lease class stays off the head."""
+    from ..core.worker import LEASE_STATS
+
+    return _counter_deltas("ca_lease_", LEASE_STATS, _lease_shipped, _LEASE_DESCS)
 
 
 # drained-but-unsent records: a send that fails after the drain (head closed
@@ -122,6 +149,7 @@ def flush_once():
     for m in metrics:
         batch.extend(m._drain())
     batch.extend(_wire_records())
+    batch.extend(_lease_records())
     if not batch:
         return
 

@@ -133,6 +133,27 @@ def summarize_objects() -> Dict[str, Any]:
     }
 
 
+def lease_plane() -> Dict[str, Any]:
+    """Delegated vs used lease capacity per node and per pool, plus the
+    local-vs-head grant counters — the one-call diagnosis for an exhausted
+    lease block or a pool silently falling back to head grants."""
+    stats = _head("stats")["stats"]
+    nodes = {
+        n["node_id"]: n.get("lease_blocks") or {}
+        for n in list_nodes()
+        if n["alive"] and not n.get("is_head_node")
+    }
+    return {
+        "nodes": nodes,
+        "delegated_slots": stats.get("lease_delegated_slots", 0),
+        "local_used": stats.get("lease_local_used", 0),
+        "local_granted": stats.get("lease_local_granted", 0),
+        "head_granted": stats.get("lease_head_granted", 0),
+        "blocks_delegated": stats.get("lease_blocks_delegated", 0),
+        "blocks_returned": stats.get("lease_blocks_returned", 0),
+    }
+
+
 # ------------------------------------------------------------------ timeline
 
 _PHASE_ORDER = {
@@ -347,6 +368,7 @@ __all__ = [
     "summarize_tasks",
     "summarize_actors",
     "summarize_objects",
+    "lease_plane",
     "timeline",
     "get_log",
 ]

@@ -4,10 +4,53 @@ Run on the real chip (no JAX_PLATFORMS override):
     python scripts/perf_probe.py [variant ...]
 Variants: jnp8 flash8 jnp16 flash16 jnp16r jnp32r attnmicro
 Default: all step variants.
+
+A hard watchdog (CA_PROBE_TIMEOUT seconds, default 900) SIGKILLs the whole
+process group if the accelerator runtime wedges: a hung device tunnel makes
+jax.devices()/compilation block forever in C++ where no Python exception or
+signal handler can reach, and the runtime forks helper processes that would
+otherwise survive the probe and keep the device wedged for the next run
+(BENCH_r05 "probe hung").  killpg is the only reliable way out.
 """
 
+import os
+import signal
 import sys
+import threading
 import time
+
+
+def _arm_watchdog():
+    timeout_s = float(os.environ.get("CA_PROBE_TIMEOUT", "900"))
+    if timeout_s <= 0:
+        return
+    # own process group, so the watchdog's killpg takes the accelerator
+    # runtime's forked helpers down with us (and nothing else)
+    if os.getpid() != os.getpgid(0):
+        try:
+            os.setpgid(0, 0)
+        except OSError:
+            pass
+
+    def _fire():
+        print(
+            f"[perf_probe] watchdog: no completion within {timeout_s:.0f}s — "
+            "killing process group (wedged accelerator runtime)",
+            file=sys.stderr,
+            flush=True,
+        )
+        try:
+            os.killpg(os.getpgid(0), signal.SIGKILL)
+        except OSError:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    t = threading.Timer(timeout_s, _fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+_WATCHDOG = _arm_watchdog()
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +145,8 @@ def main():
     print(f"devices: {jax.devices()}", flush=True)
     for n in names:
         VARIANTS[n]()
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()  # clean exit: don't let the timer outlive main
 
 
 if __name__ == "__main__":

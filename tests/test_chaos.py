@@ -154,6 +154,99 @@ def test_rpc_chaos_counts_logical_sends_inside_batch_envelopes(tmp_path):
         reset_rpc_chaos("")
 
 
+def test_lease_grant_chaos_falls_back_to_head():
+    """CA_TESTING_RPC_FAILURE on `lease_grant` (the node-local lease RPC):
+    injected failures on the agent dial must fall the submitter back to head
+    grants without losing tasks — the lease plane is an optimization, never
+    a liveness dependency."""
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_resources={"CPU": 0})
+    c.add_node(num_cpus=2)
+    c.connect()
+    try:
+
+        @ca.remote
+        def one():
+            return 1
+
+        assert ca.get([one.remote() for _ in range(20)], timeout=120) == [1] * 20
+        time.sleep(1.5)  # idle-return -> the head delegates the block
+        reset_rpc_chaos("lease_grant=3")
+        assert ca.get([one.remote() for _ in range(60)], timeout=120) == [1] * 60
+    finally:
+        reset_rpc_chaos("")
+        c.shutdown()
+
+
+def test_agent_kill_reclaims_block_without_pg_leak():
+    """Kill a node agent while its lease block has outstanding local grants:
+    in-flight tasks retry onto surviving capacity, the head reclaims the
+    dead agent's delegated slots, and placement-group bundle accounting —
+    which local grants never touch by design — comes out exactly balanced."""
+    import signal as _signal
+
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+    from cluster_anywhere_tpu.core.placement import (
+        placement_group,
+        remove_placement_group,
+    )
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    c = Cluster(head_resources={"CPU": 2})
+    c.add_node(num_cpus=2)
+    c.connect()
+    try:
+        c.wait_for_nodes(2)
+        w = global_worker()
+
+        @ca.remote(max_retries=5)
+        def work(i):
+            time.sleep(0.02)
+            return i
+
+        # a PG charged on the head node, with a lease held inside it
+        pg = placement_group([{"CPU": 1}])
+        assert pg.wait(30)
+        pg_ref = work.options(
+            placement_group=pg, placement_group_bundle_index=0
+        ).remote(7)
+
+        assert ca.get([work.remote(i) for i in range(20)], timeout=120) == list(
+            range(20)
+        )
+        # wait out the idle-return so node1's workers are delegated
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (
+                w.head_call("stats")["stats"].get("lease_delegated_slots", 0)
+                >= 1
+            ):
+                break
+            time.sleep(0.2)
+        refs = [work.remote(i) for i in range(60)]
+        time.sleep(0.2)  # let pushes land on node1's local leases
+        c.remove_node("node1")  # SIGKILL mid-flood
+        assert ca.get(refs, timeout=180) == list(range(60))
+        assert ca.get(pg_ref, timeout=60) == 7
+        remove_placement_group(pg)
+        # accounting balanced: once the retries drain and leases idle-return,
+        # every CPU the head still owns is available again — a leaked PG
+        # bundle charge or un-reclaimed delegated slot would show here
+        deadline = time.monotonic() + 30
+        avail = total = None
+        while time.monotonic() < deadline:
+            total = ca.cluster_resources().get("CPU", 0)
+            avail = ca.available_resources().get("CPU", 0)
+            if total == 2 and avail == total:
+                break
+            time.sleep(0.3)
+        assert total == 2, f"dead node capacity not dropped: {total}"
+        assert avail == total, f"leaked charge: {avail}/{total} CPU available"
+    finally:
+        c.shutdown()
+
+
 def test_rpc_chaos_cancel_notify_dropped(fresh_cluster):
     """A dropped cancel notify (dead connection injected) must not crash the
     owner or hang the caller: the running task completes normally (cancel is
