@@ -117,8 +117,26 @@ def bench_core():
         f"memcpy ceiling {ceiling:.2f} -> put at {best_put/ceiling:.0%} of ceiling)"
     )
 
+    # log-plane counter deltas for the BENCH json: the cluster started fresh
+    # in this process, so the head's cluster-wide aggregates ARE the run's
+    # deltas (capture volume + drops prove the plane stayed out of the way)
+    logplane = {}
+    try:
+        stats = ca.cluster_stats()
+        logplane = {
+            k: stats.get(k, 0)
+            for k in (
+                "ca_log_lines_total", "ca_log_bytes_total",
+                "ca_log_dropped_total", "log_lines_shipped",
+                "log_lines_dropped",
+            )
+        }
+        log(f"logplane counters: {logplane}")
+    except Exception:
+        pass
+
     ca.shutdown()
-    return best_tasks, best_actor, sync_rate
+    return best_tasks, best_actor, sync_rate, logplane
 
 
 class _MemcpyProbe:
@@ -369,7 +387,7 @@ def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
 
 
 def main():
-    _, best_actor, _ = bench_core()
+    _, best_actor, _, logplane = bench_core()
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -381,6 +399,8 @@ def main():
         "unit": "calls/s",
         "vs_baseline": round(best_actor / BASELINE_ACTOR_ASYNC, 3),
     }
+    if logplane:
+        out["logplane"] = logplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

@@ -18,7 +18,10 @@ import time
 def _connect(args):
     import cluster_anywhere_tpu as ca
 
-    ca.init(address=getattr(args, "address", None) or "auto")
+    # no log-stream subscription for one-shot CLI commands: live worker
+    # echoes would interleave with (and for `ca logs --follow`, duplicate)
+    # the command's own output
+    ca.init(address=getattr(args, "address", None) or "auto", log_to_driver=False)
     return ca
 
 
@@ -184,7 +187,7 @@ def cmd_stop(args):
     from cluster_anywhere_tpu.core.worker import global_worker
 
     try:
-        ca.init(address=getattr(args, "address", None) or "auto")
+        ca.init(address=getattr(args, "address", None) or "auto", log_to_driver=False)
     except ConnectionError as e:
         print(e)
         return
@@ -310,11 +313,47 @@ def cmd_list(args):
 
 
 def cmd_logs(args):
+    """`ca logs [<worker|task|actor|node|head>] [--tail N] [--follow]` —
+    reads/tails wherever the log lives: the head proxies cross-node reads
+    through the owning node's agent (no shared filesystem needed)."""
     ca = _connect(args)
-    from cluster_anywhere_tpu.util import state
+    from cluster_anywhere_tpu.core.worker import global_worker
 
-    print(state.get_log(args.worker_id, tail=args.tail))
-    ca.shutdown()
+    w = global_worker()
+    failed = False
+    try:
+        try:
+            reply = w.head_call("log_fetch", id=args.worker_id, tail=args.tail)
+        except (FileNotFoundError, RuntimeError, ConnectionError) as e:
+            print(f"ca logs: {e}", file=sys.stderr)
+            failed = True
+            return
+        if reply["data"]:
+            print(reply["data"])
+        if not args.follow:
+            return
+        off = reply["off"]
+        try:
+            while True:
+                time.sleep(0.3)
+                try:
+                    reply = w.head_call("log_fetch", id=args.worker_id, off=off)
+                except FileNotFoundError:
+                    continue  # rotated away: keep polling from the new file
+                except (RuntimeError, ConnectionError) as e:
+                    print(f"ca logs: {e}", file=sys.stderr)
+                    failed = True
+                    return
+                if reply["data"]:
+                    sys.stdout.write(reply["data"])
+                    sys.stdout.flush()
+                off = reply["off"]
+        except KeyboardInterrupt:
+            pass
+    finally:
+        ca.shutdown()
+        if failed:
+            sys.exit(1)
 
 
 def cmd_metrics(args):
@@ -490,10 +529,19 @@ def main(argv=None):
     )
     sp.set_defaults(fn=cmd_list)
 
-    sp = sub.add_parser("logs", help="read head/worker logs")
+    sp = sub.add_parser(
+        "logs", help="read/tail head/worker/task/actor logs across nodes"
+    )
     addr(sp)
-    sp.add_argument("worker_id", nargs="?", default=None)
+    sp.add_argument(
+        "worker_id", nargs="?", default=None,
+        help="worker/task/actor/node id, or 'head' (default)",
+    )
     sp.add_argument("--tail", type=int, default=200)
+    sp.add_argument(
+        "--follow", "-f", action="store_true",
+        help="keep streaming new lines (Ctrl-C to stop)",
+    )
     sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("metrics", help="Prometheus metrics snapshot")

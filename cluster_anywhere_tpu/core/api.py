@@ -120,7 +120,11 @@ def init(
     """Start a local cluster (head + worker pool) and connect this process as
     the driver — or, with `address=` ("auto" or a session dir), connect to an
     already-running cluster as an additional driver.
-    Mirrors ray.init (python/ray/_private/worker.py:1275)."""
+    Mirrors ray.init (python/ray/_private/worker.py:1275).
+
+    Config overrides pass as keywords, e.g. `init(log_to_driver=False)` to
+    opt this driver out of the cluster log stream (worker prints echoed with
+    task/worker/node attribution — see util/logplane.py)."""
     global _head_proc, _session_dir
     if is_initialized():
         raise RuntimeError("already initialized; call shutdown() first")

@@ -77,6 +77,11 @@ class CAConfig:
     # --- misc ---
     session_dir_root: str = "/tmp/ca_tpu"
     log_to_driver: bool = True
+    # --- log plane (util/logplane.py; raylet log-monitor analogue) ---
+    log_capture: bool = True  # structured stdout/stderr capture in spawned procs
+    log_rotate_bytes: int = 1024 * 1024  # per-process JSONL cap before .1 rollover
+    log_ship_interval_s: float = 0.25  # agent/head tail-and-ship period
+    log_ship_batch: int = 500  # max records per shipped log_batch
     event_buffer_flush_period_s: float = 1.0
     metrics_report_period_s: float = 5.0
     # deterministic RPC fault injection, modeled on the reference's

@@ -314,6 +314,25 @@ class Head:
         # aggregated user metrics (MetricsAgent analogue)
         self.task_events: deque = deque(maxlen=50_000)
         self.metrics: Dict[str, dict] = {}  # name -> {type, desc, data{tags_key: ...}}
+        # log plane: drivers subscribed to the cluster log stream (log_sub);
+        # agents' log_batch notifies and the local-node tailer fan out here.
+        # Bounded by drop-not-backpressure: a subscriber whose socket buffer
+        # is full loses the batch (counted), workers never block on logs.
+        self._log_subs: Dict[str, Any] = {}  # client_id -> writer
+        self.stats["log_lines_shipped"] = 0
+        self.stats["log_lines_dropped"] = 0
+        if config.log_capture:
+            # the head captures its own output the same way workers do
+            # (nodes/n0/head.jsonl rides the local tail loop)
+            try:
+                from ..util.logplane import install_capture
+
+                install_capture(
+                    session_dir, LOCAL_NODE, "head",
+                    max_bytes=config.log_rotate_bytes,
+                )
+            except Exception:
+                pass
         # structured lifecycle event log (util/event.h analogue): JSONL file
         self._event_log = open(os.path.join(session_dir, "events.jsonl"), "a", buffering=1)
         # transit tokens acked by the receiver BEFORE the sender's pin landed
@@ -1440,6 +1459,7 @@ class Head:
             "list_actors", "list_workers", "list_task_events", "list_objects",
             "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
             "get_actor", "subscribe", "publish", "task_events", "metrics_report",
+            "log_sub", "log_batch", "log_fetch",
         }
     )
 
@@ -1856,6 +1876,160 @@ class Head:
 
     async def _h_publish(self, state, msg, reply, reply_err):
         self._pub(msg["ch"], msg.get("data"))
+
+    # log plane -------------------------------------------------------------
+    async def _h_log_sub(self, state, msg, reply, reply_err):
+        """Driver (un)subscribes to the cluster log stream.  Sent as a
+        notify right after register when log_to_driver is on."""
+        cid = state.get("client_id") or f"anon-{id(state)}"
+        if msg.get("on", True):
+            self._log_subs[cid] = state["writer"]
+        else:
+            self._log_subs.pop(cid, None)
+        reply()
+
+    async def _h_log_batch(self, state, msg, reply, reply_err):
+        """A node agent shipped a batch of captured records: fan out to
+        subscribed drivers (the GCS-pubsub leg of the log monitor path)."""
+        self._forward_logs(msg.get("records") or [])
+
+    def _forward_logs(self, records) -> None:
+        if not records or not self._log_subs:
+            return
+        dead = []
+        delivered = False
+        for cid, writer in self._log_subs.items():
+            try:
+                buf = writer.transport.get_write_buffer_size()
+            except Exception:
+                buf = 0
+            if buf > (4 << 20):
+                # bounded buffers, not backpressure: a stalled subscriber
+                # loses this batch rather than stalling capture or workers
+                self.stats["log_lines_dropped"] += len(records)
+                continue
+            try:
+                write_frame(writer, {"m": "log_batch", "records": records})
+                delivered = True
+            except Exception:
+                dead.append(cid)
+        for cid in dead:
+            self._log_subs.pop(cid, None)
+        if delivered:
+            self.stats["log_lines_shipped"] += len(records)
+
+    async def _log_tail_loop(self):
+        """Tail the head node's own capture files (n0 workers + the head
+        itself) and forward — the local-node twin of the agents' ship loop."""
+        from ..util.logplane import LogTailer, node_log_dir
+
+        tailer = LogTailer(
+            node_log_dir(self.session_dir, LOCAL_NODE),
+            max_records=self.config.log_ship_batch,
+        )
+        period = max(self.config.log_ship_interval_s, 0.05)
+        while not self._shutdown.is_set():
+            await asyncio.sleep(period)
+            if not self._log_subs:
+                continue  # offsets hold; a late subscriber gets the backlog
+            try:
+                records = tailer.poll()
+            except Exception:
+                continue
+            if records:
+                self._forward_logs(records)
+
+    def _resolve_log_target(self, ident) -> Tuple[str, str]:
+        """Resolve a worker/actor/task/node id (or "head"/None) to
+        (node_id, file base name) for the query plane."""
+        if not ident or ident == "head":
+            return (LOCAL_NODE, "head")
+        if ident in self.nodes:
+            return (ident, "head" if ident == LOCAL_NODE else "agent")
+        rec = self.workers.get(ident)
+        if rec is None:
+            a = self.actors.get(ident)
+            if a is not None and a.worker_id:
+                rec = self.workers.get(a.worker_id)
+        if rec is None:
+            # task id: newest attribution wins (retries may have moved it)
+            for e in reversed(self.task_events):
+                if e.get("task_id") == ident and e.get("worker_id"):
+                    rec = self.workers.get(e["worker_id"])
+                    break
+        if rec is None:
+            raise FileNotFoundError(
+                f"no log found for {ident!r}: not a known worker/actor/task/"
+                "node id (try `ca list workers`)"
+            )
+        return (rec.node_id, rec.worker_id)
+
+    async def _log_fetch_data(self, ident, tail: int = 200, off=None,
+                              structured: bool = False) -> dict:
+        """Read/tail a log wherever it lives: local files directly, other
+        nodes through their agent's log_read RPC (no shared filesystem)."""
+        from ..util.logplane import node_log_dir, tail_file
+
+        node_id, name = self._resolve_log_target(ident)
+        if node_id == LOCAL_NODE:
+            if structured:
+                path = os.path.join(
+                    node_log_dir(self.session_dir, LOCAL_NODE), f"{name}.jsonl"
+                )
+            else:
+                # raw fd-redirect logs: head.log and head-spawned workers
+                # live at the session root
+                path = os.path.join(self.session_dir, f"{name}.log")
+            try:
+                data, new_off = tail_file(path, tail=tail, off=off)
+            except (FileNotFoundError, OSError):
+                raise FileNotFoundError(
+                    f"no log for {ident!r} yet (expected at {path})"
+                )
+            return {"data": data, "off": new_off, "node_id": node_id}
+        node = self.nodes.get(node_id)
+        if node is None or node.state != "alive" or node.conn is None or node.conn.closed:
+            # RuntimeError, not ConnectionError: a pickled ConnectionError
+            # would look like "head down" to head_call's reconnect retry loop
+            raise RuntimeError(
+                f"node {node_id!r} (owner of {ident!r}) is unreachable"
+            )
+        try:
+            r = await node.conn.call(
+                "log_read", name=name, tail=tail, off=off,
+                structured=structured, timeout=10,
+            )
+        except (ConnectionError, asyncio.TimeoutError):
+            raise RuntimeError(
+                f"node {node_id!r} (owner of {ident!r}) stopped answering"
+            )
+        return {"data": r["data"], "off": r["off"], "node_id": node_id}
+
+    def _log_counter_totals(self) -> Dict[str, int]:
+        """Cluster-wide ca_log_* capture counters summed from the metrics
+        table (shared by `ca status` stats and the dashboard /api/logplane)."""
+        out = {}
+        for mname in (
+            "ca_log_lines_total", "ca_log_bytes_total", "ca_log_dropped_total"
+        ):
+            rec = self.metrics.get(mname)
+            out[mname] = (
+                int(sum(rec["data"].values())) if rec and rec.get("data") else 0
+            )
+        return out
+
+    async def _h_log_fetch(self, state, msg, reply, reply_err):
+        try:
+            out = await self._log_fetch_data(
+                msg.get("id"),
+                tail=int(msg.get("tail") or 200),
+                off=msg.get("off"),
+                structured=bool(msg.get("structured")),
+            )
+        except (FileNotFoundError, RuntimeError, ValueError) as e:
+            reply_err(e)
+            return
+        reply(**out)
 
     # objects --------------------------------------------------------------
     # ---- remote-client object upload (Ray-Client analogue data path) ----
@@ -2477,11 +2651,16 @@ class Head:
             lease_local_used += sum(
                 int((hb or {}).get("used", 0)) for hb in n.lease_used.values()
             )
+        # log-plane counters: cluster-wide ca_log_* aggregates (capture-side,
+        # flushed by every worker) next to this head's own shipped/dropped
+        # stats — `ca status` shows both
+        log_counters = self._log_counter_totals()
         reply(
             rpc_counts=dict(self.rpc_counts),
             stats=dict(
                 self.stats,
                 **wire,
+                **log_counters,
                 lease_delegated_slots=lease_delegated,
                 lease_local_used=lease_local_used,
                 lease_local_granted=lease_local_granted,
@@ -2663,6 +2842,7 @@ class Head:
             return
         self._clients.pop(cid, None)
         self.client_addrs.pop(cid, None)  # p2p dials now fall back to head
+        self._log_subs.pop(cid, None)  # departed drivers stop receiving logs
         if state.get("role") == "agent":
             node = self.nodes.get(state.get("node_id"))
             if node is not None:
@@ -2878,6 +3058,7 @@ class Head:
             self._log_event("dashboard_failed", error=repr(e))
         monitor = asyncio.ensure_future(self._monitor_loop())
         persister = asyncio.ensure_future(self._persist_loop())
+        log_tail = asyncio.ensure_future(self._log_tail_loop())
         # readiness marker for the driver — atomic rename: a reader must
         # never observe the file existing but empty (the pid parse treats
         # that as a dead cluster and refuses to connect)
@@ -2888,6 +3069,7 @@ class Head:
         await self._shutdown.wait()
         monitor.cancel()
         persister.cancel()
+        log_tail.cancel()
         if self.dashboard is not None:
             await self.dashboard.stop()
         await self._teardown()

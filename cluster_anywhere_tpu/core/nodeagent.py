@@ -196,6 +196,16 @@ class NodeAgent:
         self.serve_addr_spec = os.environ.get("CA_AGENT_SERVE", "tcp:127.0.0.1:0")
         self.node_dir = os.path.join(self.session_dir, "nodes", self.node_id)
         os.makedirs(self.node_dir, exist_ok=True)
+        if self.config.log_capture:
+            # the agent captures its own output the same way its workers do:
+            # agent.jsonl rides the same tail-and-ship loop, so agent prints
+            # reach subscribed drivers prefixed "(agent ... node=...)"
+            from ..util.logplane import install_capture
+
+            install_capture(
+                self.session_dir, self.node_id, "agent",
+                max_bytes=self.config.log_rotate_bytes,
+            )
         self.shm_ns_dir = os.path.join("/dev/shm", self.session_name, self.node_id)
         os.makedirs(self.shm_ns_dir, exist_ok=True)
         self.server = Server(
@@ -306,6 +316,27 @@ class NodeAgent:
         elif m == "kill_worker":
             self._kill_worker(msg["wid"])
             reply()
+        elif m == "log_read":
+            # query plane: the head proxies cross-node log reads through the
+            # owning agent, so `ca logs`/get_log need no shared filesystem
+            from ..util.logplane import tail_file
+
+            name = msg["name"]
+            if "/" in name or ".." in name or name.startswith("."):
+                reply_err(ValueError(f"bad log name {name!r}"))
+                return
+            suffix = ".jsonl" if msg.get("structured") else ".log"
+            path = os.path.join(self.node_dir, name + suffix)
+            try:
+                data, off = tail_file(
+                    path, tail=int(msg.get("tail", 200)), off=msg.get("off")
+                )
+            except (FileNotFoundError, OSError):
+                reply_err(FileNotFoundError(
+                    f"no log for {name!r} on node {self.node_id}"
+                ))
+            else:
+                reply(data=data, off=off, node_id=self.node_id)
         elif m == "pull_chunk":
             reply(data=read_shm_chunk(
                 self.session_name, self._pull_maps, msg["shm_name"], msg["off"], msg["len"]
@@ -381,6 +412,31 @@ class NodeAgent:
                     except Exception:
                         pass
 
+    async def _log_ship_loop(self):
+        """Tail this node's structured capture files and batch new records
+        to the head (log-monitor analogue).  The files are the buffer: a
+        closed head connection just leaves records on disk for the next
+        tick; only a send that fails after the tailer advanced is a loss
+        (counted in ca_log_dropped_total)."""
+        from ..util.logplane import LOG_STATS, LogTailer
+
+        tailer = LogTailer(self.node_dir, max_records=self.config.log_ship_batch)
+        period = max(self.config.log_ship_interval_s, 0.05)
+        while not self._shutdown.is_set():
+            await asyncio.sleep(period)
+            if self.head is None or self.head.closed:
+                continue
+            try:
+                records = tailer.poll()
+            except Exception:
+                continue
+            if not records:
+                continue
+            try:
+                self.head.notify("log_batch", node_id=self.node_id, records=records)
+            except Exception:
+                LOG_STATS["dropped_total"] += len(records)
+
     async def _on_head_push(self, msg):
         # the head reaches us both through its own connection (requests)
         # and as pushes on ours; route pushes through the same handler
@@ -409,9 +465,11 @@ class NodeAgent:
         os.replace(ready + ".tmp", ready)  # atomic: never visible half-written
         hb = spawn_bg(self._heartbeat_loop())
         head_watch = spawn_bg(self._watch_head())
+        log_ship = spawn_bg(self._log_ship_loop())
         await self._shutdown.wait()
         hb.cancel()
         head_watch.cancel()
+        log_ship.cancel()
         self._teardown()
 
     async def _watch_head(self):

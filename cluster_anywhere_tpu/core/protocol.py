@@ -36,6 +36,17 @@ definition inlined while the head — the blob directory — is down).  All of
 these are ordinary logical messages: they cork, batch, and charge chaos
 budgets exactly like every other method.
 
+Log plane: the structured log pipeline rides the same frames and envelopes.
+Agent -> head: `log_batch` (notify; a tick's tailed records from that node's
+capture files).  Driver -> head: `log_sub` (notify; join/leave the cluster
+log stream) and `log_fetch` (request; resolve a worker/actor/task/node id
+and read/tail its log, proxied cross-node).  Head -> agent: `log_read`
+(request; tail a file in the agent's node dir).  Head -> driver: `log_batch`
+pushes (unsolicited frames, expanded by the Connection push handler).  All
+of them cork and batch like any other logical message; delivery to a stalled
+subscriber drops (bounded buffers + a dropped-line counter) rather than
+backpressuring the printing worker.
+
 Trace context: logical task/actor-call messages may carry a small optional
 `tr` field (TRACE_FIELD) — {"tid": trace id, "sid": parent span id} — minted
 at remote() submission when util/tracing is enabled.  Batch envelopes splice

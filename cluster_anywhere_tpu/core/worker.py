@@ -693,6 +693,9 @@ class Worker:
         )
         self._stopped = False
         self._head_fenced = False  # head refused re-registration: must exit
+        # log plane: lazily-built printer for log_batch pushes (drivers
+        # subscribed via log_sub; see util/logplane.DriverLogPrinter)
+        self._log_printer = None
         self._external_loop = loop is not None
         if loop is None:
             self.loop = asyncio.new_event_loop()
@@ -788,9 +791,35 @@ class Worker:
             remote=self.client_mode,
         )
         self.total_resources = reply["resources"]
+        self._maybe_log_sub(self.head)
         self._housekeeping_task = spawn_bg(self._housekeeping())
 
+    def _maybe_log_sub(self, conn) -> None:
+        """Subscribe this driver to the cluster log stream (log plane):
+        remote workers' prints land on our stdout/stderr with attribution.
+        init(log_to_driver=False) opts out."""
+        if self.mode != "driver" or not getattr(self.config, "log_to_driver", True):
+            return
+        try:
+            conn.notify("log_sub")
+        except Exception:
+            pass
+
+    def _on_log_batch(self, msg) -> None:
+        printer = self._log_printer
+        if printer is None:
+            from ..util.logplane import DriverLogPrinter
+
+            printer = self._log_printer = DriverLogPrinter()
+        try:
+            printer.print_records(msg.get("records") or ())
+        except Exception:
+            pass  # a printing hiccup must never take down the read loop
+
     async def _on_push(self, msg):
+        if msg.get("m") == "log_batch":
+            self._on_log_batch(msg)
+            return
         if msg.get("m") != "pub":
             return
         ch = msg.get("ch")
@@ -907,6 +936,8 @@ class Worker:
             await conn.close()
             return False
         self.head = conn
+        # the restarted head lost its subscriber table: re-join the stream
+        self._maybe_log_sub(conn)
         return True
 
     # ----------------------------------------------------------- lease plane

@@ -45,7 +45,7 @@ from .worker import Worker, _device_spec, _is_device_value, set_global_worker
 
 # imported after .worker so the util package's own core imports resolve
 # against a fully-initialized module
-from ..util import tracing
+from ..util import logplane, tracing
 
 
 class ActorContext:
@@ -70,6 +70,16 @@ class WorkerProcess:
         self.sock_path = os.environ["CA_WORKER_SOCK"]
         self.config = CAConfig.from_json(os.environ["CA_CONFIG_JSON"])
         set_config(self.config)
+        self.node_id = os.environ.get("CA_NODE_ID", "n0")
+        if self.config.log_capture:
+            # log plane capture: stdout/stderr pass through to the raw .log
+            # fd AND stamp each line (task/actor identity from the ambient
+            # execution context) into nodes/<node_id>/<wid>.jsonl, which the
+            # node's agent (or the head, on n0) tails and ships to drivers
+            logplane.install_capture(
+                self.session_dir, self.node_id, self.worker_id,
+                max_bytes=self.config.log_rotate_bytes,
+            )
         self.loop = asyncio.new_event_loop()
         if hasattr(asyncio, "eager_task_factory"):
             self.loop.set_task_factory(asyncio.eager_task_factory)
@@ -211,7 +221,18 @@ class WorkerProcess:
         # reacts to them (e.g. ObjectLostError triggers lineage
         # reconstruction); everything else becomes a TaskError with traceback
         if not isinstance(exc, CAError):
-            exc = TaskError(repr(exc), traceback.format_exc())
+            tb = traceback.format_exc()
+            # the last lines this worker printed travel with the error: the
+            # caller sees what the task said right before it died without a
+            # separate `ca logs` round-trip
+            tail = logplane.recent_lines(20)
+            if tail:
+                tb += (
+                    "\n--- last captured worker output ---\n"
+                    + "\n".join(tail)
+                    + "\n"
+                )
+            exc = TaskError(repr(exc), tb)
         blob = pickle.dumps(exc)
         return [{"e": blob} for _ in range(num_returns)]
 
@@ -226,6 +247,13 @@ class WorkerProcess:
         worker-death retry."""
         tr = msg.get(TRACE_FIELD)
         token = None
+        # log-plane attribution for everything this task prints (always on,
+        # unlike the trace context which only rides traced submissions)
+        ltok = logplane.push_context(
+            task=task_id.hex(),
+            actor=actor_id,
+            name=msg.get("method") or getattr(fn, "__name__", "task"),
+        )
         if tr is not None:
             # install the submitter's trace context as ambient for this
             # executor thread: nested remote() calls and tracing.span()
@@ -268,6 +296,7 @@ class WorkerProcess:
                     pass
             raise
         finally:
+            logplane.pop_context(ltok)
             if token is not None:
                 tracing.pop_execution(token)
             if self._cancel_requested or self._precancelled:
@@ -425,12 +454,20 @@ class WorkerProcess:
                         # method body (and anything it submits) is traced
                         # without leaking context onto the shared loop
                         token = None
+                        # the coroutine snapshots the ambient context at task
+                        # creation: log attribution and (when traced) trace
+                        # context both ride into the method body
+                        ltok = logplane.push_context(
+                            task=task_id.hex(), actor=msg["actor_id"],
+                            name=msg["method"],
+                        )
                         if tr is not None:
                             token = tracing.push_execution(tr)
                             self._record_running(task_id, ev_name, "actor_task", tr)
                         try:
                             coro_task = asyncio.ensure_future(method(*args, **kwargs))
                         finally:
+                            logplane.pop_context(ltok)
                             if token is not None:
                                 tracing.pop_execution(token)
                         self._async_running[task_id] = coro_task
@@ -518,6 +555,10 @@ class WorkerProcess:
         idx = 0
         tr = msg.get(TRACE_FIELD)
         token = None
+        ltok = logplane.push_context(
+            task=task_id.hex(), actor=actor_id,
+            name=msg.get("method") or getattr(fn, "__name__", "stream"),
+        )
         if tr is not None:
             token = tracing.push_execution(tr)
             self._record_running(
@@ -585,6 +626,7 @@ class WorkerProcess:
             err = self._error_results(1, e)[0]["e"]
             return {"results": [], "stream_end": True, "count": idx, "stream_error": err}
         finally:
+            logplane.pop_context(ltok)
             if token is not None:
                 tracing.pop_execution(token)
             self._streams.pop(task_id, None)

@@ -57,6 +57,10 @@ th { color: #9aa5b1; font-weight: 600; }
 <h2>Placement groups</h2><table id="pgs"></table>
 <h2>Task timeline <span id="tlaxis"></span></h2><div id="tl"></div>
 <h2>Recent tasks</h2><table id="tasks"></table>
+<h2>Logs <select id="logsel"><option value="">(choose a process)</option></select>
+<span id="logstats"></span></h2>
+<pre id="logview" style="background:#161b22;border:1px solid #2a3038;padding:8px;
+max-height:300px;overflow:auto;font-size:11px;white-space:pre-wrap"></pre>
 <script>
 function row(cells, tag) {
   return "<tr>" + cells.map(c => "<" + (tag||"td") + ">" + c + "</" + (tag||"td") + ">").join("") + "</tr>";
@@ -160,7 +164,23 @@ async function refresh() {
     done.slice(-30).reverse().map(t => row([esc(t.name), t.type, t.state, t.worker_id,
       ((t.end - t.start) * 1000).toFixed(1)])).join("");
 }
+async function refreshLogs() {
+  const sel = document.getElementById("logsel");
+  const ids = await (await fetch("/api/logs")).json();
+  const cur = sel.value;
+  sel.innerHTML = '<option value="">(choose a process)</option>' +
+    ids.map(i => '<option' + (i === cur ? " selected" : "") + '>' + esc(i) + "</option>").join("");
+  const lp = await (await fetch("/api/logplane")).json();
+  document.getElementById("logstats").textContent =
+    " lines " + (lp.ca_log_lines_total||0) + " shipped " + (lp.log_lines_shipped||0) +
+    " dropped " + ((lp.ca_log_dropped_total||0) + (lp.log_lines_dropped||0));
+  if (!sel.value) return;
+  const r = await (await fetch("/api/logs?id=" + encodeURIComponent(sel.value) + "&tail=100")).json();
+  document.getElementById("logview").textContent = r.data != null ? r.data : (r.error || "");
+}
+document.getElementById("logsel").addEventListener("change", refreshLogs);
 refresh(); setInterval(refresh, 2000);
+refreshLogs(); setInterval(refreshLogs, 3000);
 </script></body></html>"""
 
 
@@ -209,6 +229,10 @@ class Dashboard:
             body = await reader.readexactly(clen) if clen else b""
             if method == "POST":
                 status, ctype, resp = self._route_post(path, body)
+            elif path.split("?", 1)[0] == "/api/logs":
+                # async route: cross-node reads proxy through the owning
+                # node's agent (head._log_fetch_data awaits the agent RPC)
+                status, ctype, resp = await self._route_logs(path)
             else:
                 status, ctype, resp = self._route(path)
             await self._respond(writer, status, ctype, resp)
@@ -330,6 +354,15 @@ class Dashboard:
                     for p in h.pgs.values()
                 ]
             )
+        if path == "/api/logplane":
+            # log-plane counter snapshot: capture-side aggregates from the
+            # metrics table + this head's ship/drop stats
+            out = {
+                "log_lines_shipped": h.stats.get("log_lines_shipped", 0),
+                "log_lines_dropped": h.stats.get("log_lines_dropped", 0),
+                **h._log_counter_totals(),
+            }
+            return self._json(out)
         if path == "/metrics":
             from .util.metrics import render_prometheus
 
@@ -339,6 +372,42 @@ class Dashboard:
                 text = ""
             return 200, "text/plain; version=0.0.4", text.encode()
         return 404, "text/plain", b"not found"
+
+    # ------------------------------------------------------------- log view
+    async def _route_logs(self, path: str):
+        """GET /api/logs            -> available log ids
+        GET /api/logs?id=X&tail=N[&off=M] -> that process's log text (any
+        node; reads proxy through the owning agent)."""
+        query = path.partition("?")[2]
+        params = dict(p.partition("=")[::2] for p in query.split("&") if p)
+        h = self.head
+        ident = params.get("id")
+        if not ident:
+            # dead workers stay listed: a crashed worker's log is exactly
+            # the one worth reading (readable as long as its node is up)
+            ids = (
+                ["head"]
+                + sorted(w.worker_id for w in h.workers.values())
+                + sorted(
+                    n.node_id
+                    for n in h.nodes.values()
+                    if not n.is_local and n.state == "alive"
+                )
+            )
+            return self._json(ids)
+        try:
+            out = await h._log_fetch_data(
+                ident,
+                tail=int(params.get("tail", 200)),
+                off=int(params["off"]) if params.get("off") else None,
+                structured=params.get("structured") in ("1", "true"),
+            )
+        except (FileNotFoundError, RuntimeError, ValueError) as e:
+            return 404, "application/json", json.dumps({"error": str(e)}).encode()
+        return self._json(
+            {"id": ident, "node_id": out["node_id"], "off": out["off"],
+             "data": out["data"]}
+        )
 
     # --------------------------------------------------------- job REST API
     # Reference parity: dashboard/modules/job REST surface (JobSubmissionClient

@@ -60,6 +60,14 @@ _WIRE_DESCS = {
 }
 
 
+_logplane_shipped: Dict[str, int] = {}
+_LOGPLANE_DESCS = {
+    "lines_total": "log lines captured by this process's log-plane writers",
+    "bytes_total": "bytes of captured log line text",
+    "dropped_total": "log lines dropped (ship failure, malformed tail read)",
+}
+
+
 _lease_shipped: Dict[str, int] = {}
 _LEASE_DESCS = {
     "local_grants": "leases granted node-locally by agents (lease blocks)",
@@ -109,6 +117,15 @@ def _lease_records() -> List[dict]:
     return _counter_deltas("ca_lease_", LEASE_STATS, _lease_shipped, _LEASE_DESCS)
 
 
+def _logplane_records() -> List[dict]:
+    """Log-plane counters (util/logplane.py LOG_STATS) as ca_log_lines_total
+    / ca_log_bytes_total / ca_log_dropped_total — capture volume and drop
+    visibility for `ca status`, the dashboard, and Prometheus."""
+    from .logplane import LOG_STATS
+
+    return _counter_deltas("ca_log_", LOG_STATS, _logplane_shipped, _LOGPLANE_DESCS)
+
+
 # drained-but-unsent records: a send that fails after the drain (head closed
 # or unreachable in the window between drain and notify) re-stages its batch
 # here instead of losing the deltas; the next flush ships them first so
@@ -150,6 +167,7 @@ def flush_once():
         batch.extend(m._drain())
     batch.extend(_wire_records())
     batch.extend(_lease_records())
+    batch.extend(_logplane_records())
     if not batch:
         return
 

@@ -7,7 +7,6 @@ export backed by the head's task-event buffer).
 from __future__ import annotations
 
 import json
-import os
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
@@ -345,16 +344,29 @@ def timeline(
 
 
 def get_log(worker_id: Optional[str] = None, tail: int = 200) -> str:
-    """Read a worker's (or the head's) captured stdout/stderr log."""
-    w = global_worker()
-    name = f"{worker_id}.log" if worker_id else "head.log"
-    path = os.path.join(w.session_dir, name)
-    if not os.path.exists(path):
-        raise FileNotFoundError(f"no log at {path}")
-    with open(path, "rb") as f:
-        data = f.read().decode("utf-8", "replace")
-    lines = data.splitlines()
-    return "\n".join(lines[-tail:])
+    """Read a worker's (or an actor's, a task's, a node agent's, or the
+    head's) captured stdout/stderr, wherever it lives: the head resolves the
+    id to the owning node and proxies the read through that node's agent
+    (`log_fetch` -> `log_read`), so no shared filesystem is assumed — the
+    old direct `session_dir/<wid>.log` read only worked for head-spawned
+    workers.  Raises FileNotFoundError when no such log exists."""
+    return _head("log_fetch", id=worker_id, tail=tail)["data"]
+
+
+def get_log_records(
+    worker_id: Optional[str] = None, tail: int = 200
+) -> List[Dict[str, Any]]:
+    """Structured log records (the JSONL capture) for one process: each has
+    line text plus `(node, wid, pid, task, actor, name, stream, ts)`
+    attribution stamped by the log plane at print time."""
+    data = _head("log_fetch", id=worker_id, tail=tail, structured=True)["data"]
+    out: List[Dict[str, Any]] = []
+    for line in data.splitlines():
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out[-tail:]
 
 
 __all__ = [
@@ -371,4 +383,5 @@ __all__ = [
     "lease_plane",
     "timeline",
     "get_log",
+    "get_log_records",
 ]

@@ -143,8 +143,23 @@ VARIANTS = {
 def main():
     names = [a for a in sys.argv[1:] if a in VARIANTS] or ["jnp8", "flash8", "jnp16", "flash16"]
     print(f"devices: {jax.devices()}", flush=True)
+    from cluster_anywhere_tpu.util.logplane import log_stats
+
+    lp0 = log_stats()
     for n in names:
         VARIANTS[n]()
+    # trailing JSON record for the BENCH harness: log-plane counter deltas
+    # over the probe (zeros unless capture is active in this process — the
+    # row exists either way so "plane off" and "never recorded" differ)
+    import json as _json
+
+    lp1 = log_stats()
+    print(
+        _json.dumps(
+            {"logplane_deltas": {k: lp1[k] - lp0.get(k, 0) for k in lp1}}
+        ),
+        flush=True,
+    )
     if _WATCHDOG is not None:
         _WATCHDOG.cancel()  # clean exit: don't let the timer outlive main
 

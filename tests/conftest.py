@@ -32,6 +32,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'`; register the marker so marked
+    # long-running integration tests don't warn
+    config.addinivalue_line(
+        "markers", "slow: long-running integration tests excluded from tier-1"
+    )
+
+
 @pytest.fixture
 def ca_cluster():
     """A running local cluster, torn down after the test (analogue of the
