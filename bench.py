@@ -121,6 +121,7 @@ def bench_core():
     # in this process, so the head's cluster-wide aggregates ARE the run's
     # deltas (capture volume + drops prove the plane stayed out of the way)
     logplane = {}
+    drainplane = {}
     try:
         stats = ca.cluster_stats()
         logplane = {
@@ -132,11 +133,22 @@ def bench_core():
             )
         }
         log(f"logplane counters: {logplane}")
+        # drain-plane counters: a clean bench run proves the plane is free
+        # when idle (all zeros) — a chaos/preemption run shows its work
+        drainplane = {
+            k: stats.get(k, 0)
+            for k in (
+                "nodes_drained", "drain_actors_migrated",
+                "drain_objects_migrated", "drain_deadline_kills",
+                "drain_tasks_evacuated",
+            )
+        }
+        log(f"drain counters: {drainplane}")
     except Exception:
         pass
 
     ca.shutdown()
-    return best_tasks, best_actor, sync_rate, logplane
+    return best_tasks, best_actor, sync_rate, logplane, drainplane
 
 
 class _MemcpyProbe:
@@ -387,7 +399,7 @@ def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
 
 
 def main():
-    _, best_actor, _, logplane = bench_core()
+    _, best_actor, _, logplane, drainplane = bench_core()
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -401,6 +413,8 @@ def main():
     }
     if logplane:
         out["logplane"] = logplane
+    if drainplane:
+        out["drainplane"] = drainplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

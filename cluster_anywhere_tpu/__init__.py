@@ -20,6 +20,7 @@ from .core.api import (
     cancel,
     cluster_resources,
     cluster_stats,
+    drain_node,
     get,
     init,
     is_initialized,
@@ -79,6 +80,7 @@ __all__ = [
     "cluster_resources",
     "available_resources",
     "cluster_stats",
+    "drain_node",
     "timeline",
     "placement_group",
     "remove_placement_group",

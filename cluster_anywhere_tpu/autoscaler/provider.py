@@ -48,6 +48,43 @@ class NodeProvider:
         raise NotImplementedError
 
 
+def _drain_at_head(w, node_id: str, reason: str = "idle") -> bool:
+    """Drain-then-kill, step one: ask the head to drain `node_id` (recall
+    lease blocks, evacuate actors and sole-copy objects, let running tasks
+    finish) and wait until the node reaches `drained`/`dead` — so provider
+    termination never strands in-flight work.  Returns True once the node is
+    out of the cluster; False when it never was a head node (LocalNodeProvider
+    capacity credits), the head is unreachable, or the window expired (the
+    caller falls back to the hard kill — exactly the old behavior)."""
+    try:
+        r = w.head_call("drain_node", node_id=node_id, reason=reason, timeout=5)
+    except Exception:
+        return False
+    if r.get("state") in ("drained", "dead"):
+        return True
+    deadline = time.monotonic() + float(w.config.drain_deadline_s) + 10.0
+    errors = 0
+    while time.monotonic() < deadline:
+        try:
+            for n in w.head_call("nodes", timeout=5)["nodes"]:
+                if n["node_id"] == node_id:
+                    if n.get("state") in ("drained", "dead"):
+                        return True
+                    break
+            else:
+                return True  # gone from the table entirely
+            errors = 0
+        except Exception:
+            # one dropped/slow poll must not abort a healthy mid-flight
+            # drain into a hard kill; only a head that stays unreachable
+            # ends the wait early
+            errors += 1
+            if errors >= 10:
+                return False
+        time.sleep(0.1)
+    return False
+
+
 class LocalNodeProvider(NodeProvider):
     """Launches worker processes against the connected cluster. Each "node"
     is `workers_per_node` pool worker processes plus a capacity credit."""
@@ -106,15 +143,45 @@ class LocalNodeProvider(NodeProvider):
         if node.state == "terminated":
             return
         node.state = "terminated"
+        # drain-then-kill: this provider's "node" is ext-worker processes on
+        # the head node (no head node record to drain), so the evacuation is
+        # local — debit the capacity first so nothing NEW is granted on these
+        # workers, then give in-flight leases until the drain deadline to
+        # finish before the kill
+        if node.resources:
+            delta = {k: -v for k, v in node.resources.items()}
+            self.w.head_call("update_resources", delta=delta)
+        prefix = f"ext-{node.node_id}-"
+        deadline = time.monotonic() + float(self.w.config.drain_deadline_s)
+        killed: set = set()
+        while time.monotonic() < deadline:
+            try:
+                mine = [
+                    w
+                    for w in self.w.head_call("list_workers")["workers"]
+                    if w["worker_id"].startswith(prefix) and w["state"] != "dead"
+                ]
+            except Exception:
+                break  # head gone: nothing to wait for
+            busy = [w for w in mine if w["state"] in ("leased", "actor", "delegated")]
+            # kill IDLE workers now: each one gone is one fewer slot a new
+            # lease could land on mid-wait (and then die a budgeted death —
+            # these workers never get a drain pub, n0 is not draining)
+            for w in mine:
+                if w not in busy and w["pid"] and w["pid"] not in killed:
+                    killed.add(w["pid"])
+                    try:
+                        os.kill(w["pid"], signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            if not busy:
+                break
+            time.sleep(0.1)
         for p in node.handle or []:
             try:
                 os.kill(p.pid, signal.SIGKILL)
             except ProcessLookupError:
                 pass
-        # debit the capacity this node contributed
-        if node.resources:
-            delta = {k: -v for k, v in node.resources.items()}
-            self.w.head_call("update_resources", delta=delta)
         self.nodes.pop(node.node_id, None)
 
     def non_terminated_nodes(self) -> List[NodeInfo]:
@@ -251,6 +318,9 @@ class CommandRunnerNodeProvider(NodeProvider):
             return
         node.state = "terminated"
         host = self._host_of.pop(node.node_id, "")
+        # command-runner nodes are real agent nodes (ca join): evacuate via
+        # the head before running the terminate command / killing the runner
+        _drain_at_head(self.w, node.node_id, reason="idle")
         if self.terminate_cmd:
             try:
                 subprocess.run(
@@ -351,15 +421,24 @@ class AgentNodeProvider(NodeProvider):
             return
         node.state = "terminated"
         proc = node.handle
+        # drain-then-kill: evacuate through the head first (autoscaler
+        # downscale must never strand in-flight tasks, actors, or sole-copy
+        # objects).  On drain completion the head's node_shutdown notify makes
+        # the agent exit on its own; the signals below are the fallback for
+        # an unreachable head or a hung agent.
+        drained = _drain_at_head(self.w, node.node_id, reason="idle")
         if proc is not None:
             try:
-                os.kill(proc.pid, signal.SIGTERM)
-                proc.wait(timeout=10)
-            except (ProcessLookupError, subprocess.TimeoutExpired):
+                proc.wait(timeout=10 if drained else 0.1)
+            except subprocess.TimeoutExpired:
                 try:
-                    os.kill(proc.pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
+                    os.kill(proc.pid, signal.SIGTERM)
+                    proc.wait(timeout=10)
+                except (ProcessLookupError, subprocess.TimeoutExpired):
+                    try:
+                        os.kill(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
         self.nodes.pop(node.node_id, None)
 
     def non_terminated_nodes(self) -> List[NodeInfo]:

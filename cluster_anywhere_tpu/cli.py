@@ -196,6 +196,34 @@ def cmd_stop(args):
     w.shutdown(stop_cluster=True)
 
 
+def cmd_drain(args):
+    """Gracefully drain a node: evacuate actors/objects, let running tasks
+    finish until the deadline, then let the provider reclaim the VM."""
+    ca = _connect(args)
+    try:
+        kw = {"reason": args.reason}
+        if args.deadline is not None:
+            kw["deadline_s"] = args.deadline
+        r = ca.drain_node(args.node, **kw)
+    except Exception as e:
+        print(f"drain failed: {e}")
+        ca.shutdown()
+        sys.exit(1)
+    state = r.get("state")
+    print(f"node {args.node}: {state}"
+          + (f" (deadline {r['deadline_s']:g}s)" if "deadline_s" in r else ""))
+    if args.wait and state == "draining":
+        while True:
+            time.sleep(0.2)
+            rec = next(
+                (n for n in ca.nodes() if n["node_id"] == args.node), None
+            )
+            if rec is None or rec.get("state") in ("drained", "dead"):
+                print(f"node {args.node}: {rec['state'] if rec else 'gone'}")
+                break
+    ca.shutdown()
+
+
 def cmd_status(args):
     ca = _connect(args)
     total = ca.cluster_resources()
@@ -206,6 +234,21 @@ def cmd_status(args):
         print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g} available")
     for k, v in sorted(stats.items()):
         print(f"  {k}: {v}")
+    # node states: draining nodes show their reason + remaining window so an
+    # announced exit (preemption, downscale) is visible before it completes
+    draining = [
+        n for n in ca.nodes() if n.get("state") not in ("alive", None)
+    ]
+    if draining:
+        print("== nodes not alive ==")
+        for n in draining:
+            d = n.get("drain") or {}
+            extra = (
+                f" reason={d.get('reason')} deadline_in={d.get('deadline_in_s')}s"
+                if n.get("state") == "draining"
+                else ""
+            )
+            print(f"  {n['node_id']}: {n.get('state')}{extra}")
     # lease plane: delegated vs used block capacity per node and pool, so an
     # exhausted block (every local grant denied -> head fallback) is
     # diagnosable without the dashboard
@@ -489,6 +532,30 @@ def main(argv=None):
     sp = sub.add_parser("status", help="cluster resources and stats")
     addr(sp)
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "drain",
+        help="gracefully drain a node (evacuate, then release to the provider)",
+    )
+    sp.add_argument("node", help="node id to drain (see ca status / ca list nodes)")
+    sp.add_argument(
+        "--reason",
+        choices=("manual", "idle", "preemption"),
+        default="manual",
+        help="drain reason recorded in events/metrics (default: manual)",
+    )
+    sp.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        help="evacuation window in seconds (default: cluster drain_deadline_s)",
+    )
+    sp.add_argument(
+        "--wait", action="store_true",
+        help="block until the node reaches drained/dead",
+    )
+    addr(sp)
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("submit", help="submit a job: ca submit -- python x.py")
     addr(sp)

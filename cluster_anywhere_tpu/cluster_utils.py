@@ -152,9 +152,12 @@ class Cluster:
         return nid
 
     def remove_node(self, node_id: str, graceful: bool = False):
-        """Kill a node: SIGKILL the agent (simulated power-off; the head
-        detects the death via connection drop / missed heartbeats and fences
-        the node's workers, which exit on their closed head connections)."""
+        """Kill a node.  Default: SIGKILL the agent (simulated power-off;
+        the head detects the death via connection drop / missed heartbeats
+        and fences the node's workers).  graceful=True sends SIGTERM — the
+        preemption warning — and the agent SELF-DRAINS through the head
+        (evacuation, then a clean exit), so the wait below can take up to
+        the drain deadline when the node is busy."""
         proc = self._agents.pop(node_id, None)
         if proc is None:
             raise ValueError(f"unknown node {node_id!r}")
@@ -162,7 +165,7 @@ class Cluster:
             os.kill(proc.pid, signal.SIGTERM if graceful else signal.SIGKILL)
         except ProcessLookupError:
             pass
-        proc.wait(timeout=10)
+        proc.wait(timeout=(self.config.drain_deadline_s + 15) if graceful else 10)
 
     def nodes(self) -> List[dict]:
         from .core import api

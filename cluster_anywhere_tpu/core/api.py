@@ -341,6 +341,23 @@ def cluster_stats() -> Dict[str, Any]:
     return global_worker().head_call("stats")["stats"]
 
 
+def drain_node(
+    node_id: str, *, reason: str = "manual", deadline_s: Optional[float] = None
+) -> Dict[str, Any]:
+    """Gracefully drain a node (DrainNode protocol analogue): stop new
+    placement on it, recall its delegated lease blocks, migrate its actors
+    and sole-copy objects to survivors, and give running tasks until the
+    deadline before the kill — whose retries do NOT consume the tasks'
+    max_retries budget.  `reason` is one of "manual" | "idle" | "preemption";
+    `deadline_s` defaults to the cluster's drain_deadline_s.  Returns the
+    head's reply ({"state": "draining", "deadline_s": ...}, or the current
+    state when the node is already draining/drained/dead)."""
+    fields: Dict[str, Any] = {"node_id": node_id, "reason": reason}
+    if deadline_s is not None:
+        fields["deadline_s"] = float(deadline_s)
+    return global_worker().head_call("drain_node", **fields)
+
+
 def timeline(filename: Optional[str] = None, *, limit: int = 100_000) -> List[dict]:
     """Chrome-trace/Perfetto events of task lifecycles, with flow arrows
     between submit and execute spans when tracing is enabled (see

@@ -65,6 +65,10 @@ class CAConfig:
     # node used/total exceeds the threshold; 0 disables the monitor
     memory_usage_threshold: float = 0.95
     memory_monitor_refresh_ms: int = 250
+    # drain plane: default evacuation window for `drain_node` / agent SIGTERM
+    # self-drain — running tasks get this long to finish before the deadline
+    # kill; actors and sole-copy objects migrate to survivors inside it
+    drain_deadline_s: float = 30.0
 
     # --- tasks / actors ---
     default_max_retries: int = 3

@@ -59,7 +59,12 @@ class NodeRec:
     total: Dict[str, float]
     avail: Dict[str, float]
     index: int = 0  # join order (scheduling tiebreak: pack onto earliest)
-    state: str = "alive"  # alive | dead
+    # drain-plane FSM: alive -> draining -> drained | dead.  A draining node
+    # is still UP (accounting, pulls, heartbeats) but no longer SCHEDULABLE
+    # (grants, delegation, PG placement, actor placement all skip it).
+    state: str = "alive"  # alive | draining | drained | dead
+    drain_reason: str = ""  # preemption | idle | manual (while draining/drained)
+    drain_deadline: float = 0.0  # monotonic deadline for the evacuation window
     pid: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     idle: Dict[str, deque] = field(default_factory=lambda: {"cpu": deque(), "tpu": deque()})
@@ -78,6 +83,12 @@ class NodeRec:
     @property
     def is_local(self) -> bool:
         return self.addr is None
+
+    @property
+    def up(self) -> bool:
+        """Node process is running (accounting/pulls valid) — includes
+        draining nodes, which are up but not schedulable."""
+        return self.state in ("alive", "draining")
 
 
 @dataclass
@@ -274,7 +285,15 @@ class Head:
             "oom_kills": 0,
             "lease_blocks_delegated": 0,  # worker-slots handed to agents
             "lease_blocks_returned": 0,  # slots revoked/returned to the head
+            # drain plane (per-reason drain_nodes_<reason> keys appear lazily)
+            "nodes_drained": 0,  # drains completed (node reached `drained`)
+            "drain_actors_migrated": 0,  # actors proactively restarted off a draining node
+            "drain_objects_migrated": 0,  # sole-copy primaries re-homed to survivors
+            "drain_deadline_kills": 0,  # busy workers killed at the drain deadline
         }
+        # draining nodes whose background evacuation pass has finished (the
+        # quiesce check refuses to finalize before actors/objects are out)
+        self._drain_evac_done: set = set()
         self._last_deleg_reclaim = 0.0  # debounce for block revocations
         # (node_id, wid) -> pool: block workers an agent reported that the
         # head didn't know yet (snapshotless restart, agent registered before
@@ -377,7 +396,12 @@ class Head:
         return self.nodes[LOCAL_NODE]
 
     def _alive_nodes(self) -> List[NodeRec]:
+        """SCHEDULABLE nodes: draining nodes are excluded — nothing new is
+        placed on capacity that is announced to be leaving."""
         return [n for n in self.nodes.values() if n.state == "alive"]
+
+    def _up_nodes(self) -> List[NodeRec]:
+        return [n for n in self.nodes.values() if n.up]
 
     def _node_views(self, nodes: Optional[List[NodeRec]] = None) -> List[scheduling.NodeView]:
         return [
@@ -411,6 +435,14 @@ class Head:
                     "node_id": n.node_id, "addr": n.addr, "total": n.total,
                     "avail": n.avail, "index": n.index, "state": n.state,
                     "pid": n.pid, "labels": n.labels,
+                    "drain_reason": n.drain_reason,
+                    # monotonic deadlines don't survive a restart: persist
+                    # the remaining window and re-anchor it at load
+                    "drain_in": (
+                        max(0.0, n.drain_deadline - time.monotonic())
+                        if n.state == "draining"
+                        else 0.0
+                    ),
                     # delegated lease blocks survive a head restart: avail
                     # already carries their unit charges, so membership must
                     # be restored with it or the accounting desyncs
@@ -501,6 +533,9 @@ class Head:
                 index=n["index"], state=n["state"], pid=n["pid"],
                 labels=n.get("labels") or {},
             )
+            rec.drain_reason = n.get("drain_reason") or ""
+            if rec.state == "draining":
+                rec.drain_deadline = now + float(n.get("drain_in") or 0.0)
             rec.delegated = {
                 p: set(w) for p, w in (n.get("delegated") or {}).items()
             }
@@ -787,10 +822,13 @@ class Head:
             if req.pg_id:
                 self._lease_pg[lease_id] = (req.pg_id, req.bundle_index)
             self.stats["leases_granted"] += 1
+            # node travels with the grant so the submitter can tell a drain
+            # kill (system failure, free retry) from an app crash
             req.reply(
                 lease_id=lease_id,
                 worker_id=wid,
                 addr=self._addr_for(rec, req.remote),
+                node=node.node_id,
             )
             return True
         return False
@@ -899,7 +937,7 @@ class Head:
                         b.used[k] = b.used.get(k, 0.0) - v
             else:
                 node = self.nodes.get(nid or LOCAL_NODE)
-                if node is not None and node.state == "alive":
+                if node is not None and node.up:
                     self._give(node.avail, shape)
         if wid is not None:
             rec = self.workers.get(wid)
@@ -981,7 +1019,7 @@ class Head:
         if wid not in node.delegated.get(pool, ()):
             return
         node.delegated[pool].discard(wid)
-        if node.state == "alive":
+        if node.up:
             self._give(node.avail, LEASE_UNIT_SHAPES[pool])
         rec = self.workers.get(wid)
         if not dead and rec is not None and rec.state == "delegated":
@@ -1270,7 +1308,7 @@ class Head:
                 # node by one unit per blocked-death
                 shape = LEASE_UNIT_SHAPES.get(rec.pool)
             cpus = (shape or {}).get("CPU", 0.0)
-            if cpus and node is not None and node.state == "alive":
+            if cpus and node is not None and node.up:
                 self._take(node.avail, {"CPU": cpus})
             rec.blocked = False
         if prev_state == "delegated":
@@ -1296,7 +1334,7 @@ class Head:
                             b.used[k] = b.used.get(k, 0.0) - v
                 elif a.charged == "node":
                     anode = self.nodes.get(a.node_id or LOCAL_NODE)
-                    if anode is not None and anode.state == "alive":
+                    if anode is not None and anode.up:
                         self._give(anode.avail, a.resources)
                 a.charged = None
                 if a.can_restart:
@@ -1332,9 +1370,12 @@ class Head:
     async def _on_node_death(self, node: NodeRec):
         """Node agent died or went silent: everything on it is gone.
         Mirrors GcsNodeManager::OnNodeFailure + per-manager node-death hooks."""
-        if node.state == "dead":
+        if node.state in ("dead", "drained"):
+            # a drained node's agent exiting is the PLANNED end of the drain
+            # FSM — its tables were already settled by _drain_finalize
             return
         node.state = "dead"
+        self._drain_evac_done.discard(node.node_id)  # died mid-drain
         self.stats["nodes_died"] += 1
         self._log_event("node_died", node_id=node.node_id)
         if node.conn is not None:
@@ -1385,6 +1426,326 @@ class Head:
                 self.pending_pgs.append(pg.pg_id)
                 self._log_event("pg_rescheduling", pg_id=pg.pg_id)
         self._pub("nodes", {"node_id": node.node_id, "alive": False})
+        self._service_queue()
+
+    # ----------------------------------------------------------- drain plane
+    # FSM: alive -> draining -> drained (DrainNode protocol analogue,
+    # gcs_node_manager.h HandleDrainNode).  A drain converts an announced
+    # exit (preemption warning, autoscaler downscale, `ca drain`) into
+    # zero-loss evacuation: placement stops immediately, delegated lease
+    # blocks are recalled, actors restart on survivors through the normal
+    # restart FSM (without consuming their restart budget), sole-copy
+    # primary objects re-replicate, and running tasks get until the deadline
+    # before the kill — whose retries clients exempt from max_retries.
+
+    DRAIN_REASONS = ("preemption", "idle", "manual")
+
+    async def _h_drain_node(self, state, msg, reply, reply_err):
+        nid = msg.get("node_id")
+        node = self.nodes.get(nid)
+        if node is None:
+            reply_err(ValueError(f"unknown node {nid!r}"))
+            return
+        if node.is_local:
+            reply_err(ValueError(
+                "cannot drain the head node n0 (stop the cluster instead)"
+            ))
+            return
+        if node.state != "alive":
+            reply(state=node.state)  # idempotent: already draining/gone
+            return
+        reason = msg.get("reason") or "manual"
+        if reason not in self.DRAIN_REASONS:
+            reply_err(ValueError(
+                f"drain reason must be one of {self.DRAIN_REASONS}, got {reason!r}"
+            ))
+            return
+        raw = msg.get("deadline_s")
+        # explicit 0 is a valid "drain NOW" — only None takes the default
+        deadline_s = float(self.config.drain_deadline_s if raw is None else raw)
+        self._drain_begin(node, reason, deadline_s)
+        reply(state="draining", deadline_s=deadline_s)
+
+    def _drain_begin(self, node: NodeRec, reason: str, deadline_s: float):
+        node.state = "draining"
+        node.drain_reason = reason
+        node.drain_deadline = time.monotonic() + deadline_s
+        key = f"drain_nodes_{reason}"
+        self.stats[key] = self.stats.get(key, 0) + 1
+        self._log_event(
+            "node_draining", node_id=node.node_id, reason=reason,
+            deadline_s=deadline_s,
+        )
+        # recall the delegated lease blocks: unleased slots come back now;
+        # outstanding local grants keep their workers until the deadline
+        if node.conn is not None and not node.conn.closed:
+            for pool, wids in node.delegated.items():
+                if wids:
+                    try:
+                        node.conn.notify("lease_block_revoke", pool=pool, n=len(wids))
+                    except Exception:
+                        pass
+        # PG bundles reserved here lose their reservation and the PG goes
+        # back to pending for placement on survivors (node-death semantics,
+        # but the capacity is credited back — the node is still accounted
+        # while draining)
+        for pg in self.pgs.values():
+            hit = False
+            for b in pg.bundles:
+                if b.node_id == node.node_id:
+                    self._give(node.avail, b.resources)
+                    b.node_id = None
+                    b.used = {}
+                    hit = True
+            if hit:
+                # actors charged against the wiped reservations went back
+                # WITH them (b.used reset): drop their charge marker, or the
+                # migrate/finalize charge-return would decrement the re-placed
+                # bundle's fresh accounting negative (permanent overcommit)
+                for a in self.actors.values():
+                    if (
+                        a.pg_id == pg.pg_id
+                        and a.charged == "pg"
+                        and a.node_id == node.node_id
+                    ):
+                        a.charged = None
+                if pg.state == "created":
+                    pg.state = "pending"
+                    self.pending_pgs.append(pg.pg_id)
+                    self._log_event("pg_rescheduling", pg_id=pg.pg_id)
+        # tell every client: task deaths on this node inside the window are
+        # preemptions — retried without consuming the user's max_retries
+        self._pub_drain(node)
+        self._pub(
+            "nodes", {"node_id": node.node_id, "alive": True, "state": "draining"}
+        )
+        self._drain_evac_done.discard(node.node_id)
+        spawn_bg(self._drain_evacuate(node))
+        self._dirty = True
+        self._service_queue()
+
+    def _drain_pub_frame(self, node: NodeRec) -> dict:
+        """The one definition of the drain announcement (broadcast AND the
+        register-time late-joiner push read it — they must never drift)."""
+        return {
+            "m": "pub",
+            "ch": "drain",
+            "data": {
+                "node_id": node.node_id,
+                "reason": node.drain_reason,
+                "state": node.state,
+                "deadline_s": max(0.0, node.drain_deadline - time.monotonic()),
+            },
+        }
+
+    def _pub_drain(self, node: NodeRec):
+        """Fan a drain announcement out to every connected client (drivers
+        and workers both submit tasks).  Direct push, not channel pubsub:
+        clients must not need a subscription round-trip to learn their
+        retries are about to be free."""
+        frame = self._drain_pub_frame(node)
+        for st in list(self._clients.values()):
+            try:
+                write_frame(st["writer"], frame)
+            except Exception:
+                pass
+
+    async def _drain_evacuate(self, node: NodeRec):
+        """Background evacuation pass: migrate live actors off the node
+        through the restart FSM, then re-home sole-copy primary objects.
+        Finishing arms the quiesce check in the monitor loop."""
+        try:
+            for a in list(self.actors.values()):
+                if node.state != "draining":
+                    return
+                if a.node_id == node.node_id and a.state == "alive":
+                    await self._migrate_actor(a, node)
+            await self._evacuate_objects(node)
+        except Exception as e:
+            self._log_event(
+                "drain_evacuate_failed", node_id=node.node_id, error=repr(e)
+            )
+        finally:
+            if node.state == "draining":
+                # arm the quiesce check — unless the node died or finalized
+                # mid-pass, where adding would leak a stale id in the set
+                self._drain_evac_done.add(node.node_id)
+
+    async def _migrate_actor(self, a: ActorRec, node: NodeRec):
+        """Proactively restart one actor on a survivor (drain evacuation).
+        Rides the normal restart FSM (clients see restarting -> alive and
+        re-resolve the address) but does NOT consume restarts_used: a drain
+        is a system event, not an app failure."""
+        old_rec = self.workers.get(a.worker_id) if a.worker_id else None
+        # return the old incarnation's charge to wherever it was taken
+        if a.charged == "pg":
+            if a.pg_id in self.pgs:
+                b = self.pgs[a.pg_id].bundles[a.bundle_index]
+                for k, v in a.resources.items():
+                    b.used[k] = b.used.get(k, 0.0) - v
+        elif a.charged == "node":
+            anode = self.nodes.get(a.node_id or LOCAL_NODE)
+            if anode is not None and anode.up:
+                self._give(anode.avail, a.resources)
+        a.charged = None
+        a.incarnation += 1
+        a.state = "restarting"
+        a.addr = None
+        self.stats["drain_actors_migrated"] += 1
+        self.stats["actor_restarts"] += 1
+        self._log_event(
+            "actor_migrating", actor_id=a.actor_id, from_node=node.node_id
+        )
+        self._pub("actors", self._actor_info(a))
+        if old_rec is not None:
+            # detach BEFORE the kill: the old worker's death event must not
+            # re-fire the restart FSM against the new incarnation
+            old_rec.actor_id = None
+            self._kill_worker_rec(old_rec)
+        await self._place_actor(a)
+
+    async def _evacuate_objects(self, node: NodeRec):
+        """Re-home every primary copy whose only holder is the draining
+        node: promote an existing survivor copy when one exists, else pull
+        the bytes into the head's n0 namespace (obj_copy/spill machinery in
+        reverse — the head is always a valid transfer target).  After this,
+        an announced exit can never fire ObjectLostError/reconstruction."""
+        for rec in list(self.objects.values()):
+            if node.state != "draining":
+                return
+            if rec.node_id != node.node_id or rec.oid not in self.objects:
+                continue
+            if self._promote_copy(rec):
+                self.stats["drain_objects_migrated"] += 1
+                continue
+            await self._pull_object_to_head(node, rec)
+
+    def _promote_copy(self, rec: ObjectRec) -> bool:
+        """Make an existing copy on a schedulable survivor the primary.  The
+        old primary's bytes stay on the draining node untracked — its whole
+        shm namespace is swept when the agent terminates."""
+        for nid in list(rec.copies):
+            n2 = self.nodes.get(nid)
+            if n2 is not None and n2.state == "alive":
+                rec.node_id = nid
+                rec.shm_name = rec.copies.pop(nid)
+                rec.spill_path = None
+                rec.pending_free = None
+                return True
+        return False
+
+    async def _pull_object_to_head(self, node: NodeRec, rec: ObjectRec):
+        """Chunk-pull one object off the draining node into a dedicated n0
+        segment and promote it to primary (the same wire path workers use
+        for node-to-node transfer, served by the node's agent)."""
+        if node.conn is None or node.conn.closed:
+            return
+        src = rec.shm_name or (f"spill:{rec.spill_path}" if rec.spill_path else None)
+        if src is None:
+            return
+        name = f"{self.session_name}/{LOCAL_NODE}/drain_{rec.oid.hex()}"
+        path = os.path.join("/dev/shm", name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        chunk = self.config.transfer_chunk_bytes
+        try:
+            with open(path, "wb") as f:
+                off = 0
+                while off < rec.size:
+                    r = await node.conn.call(
+                        "pull_chunk", shm_name=src, off=off,
+                        len=min(chunk, rec.size - off), timeout=30,
+                    )
+                    data = r["data"]
+                    if not data:
+                        raise ConnectionError("short read evacuating object")
+                    f.write(data)
+                    off += len(data)
+        except Exception as e:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._log_event(
+                "drain_object_evac_failed", oid=rec.oid.hex(),
+                node_id=node.node_id, error=repr(e),
+            )
+            return
+        if rec.oid not in self.objects or rec.node_id != node.node_id:
+            # freed or re-homed while the pull ran: drop the orphan bytes
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
+        rec.node_id = LOCAL_NODE
+        rec.shm_name = name
+        rec.spill_path = None
+        rec.pending_free = None
+        self.stats["drain_objects_migrated"] += 1
+        self.stats["objects_transferred"] += 1
+
+    def _drain_quiesced(self, node: NodeRec) -> bool:
+        """Evacuation finished and nothing is still running on the node —
+        the drain can complete before its deadline."""
+        if node.node_id not in self._drain_evac_done:
+            return False
+        for w in self.workers.values():
+            if w.node_id == node.node_id and w.state in ("leased", "actor"):
+                return False
+        # agent-granted local leases (heartbeat-fed block occupancy)
+        for hb in node.lease_used.values():
+            if int((hb or {}).get("used", 0)) > 0:
+                return False
+        return True
+
+    async def _drain_finalize(self, node: NodeRec):
+        """Deadline reached or the node quiesced: the drain completes.  Any
+        still-busy workers are deadline kills (their submitters retry for
+        free), the worker table settles through the normal death path, and
+        the agent is told to shut down so the provider can reclaim the VM."""
+        if node.state != "draining":
+            return
+        busy = sum(
+            1
+            for w in self.workers.values()
+            if w.node_id == node.node_id and w.state in ("leased", "actor")
+        )
+        busy += sum(
+            int((hb or {}).get("used", 0)) for hb in node.lease_used.values()
+        )
+        if busy:
+            self.stats["drain_deadline_kills"] += busy
+        node.state = "drained"
+        self.stats["nodes_drained"] += 1
+        self._drain_evac_done.discard(node.node_id)
+        self._log_event(
+            "node_drained", node_id=node.node_id, reason=node.drain_reason,
+            deadline_kills=busy,
+        )
+        # residual primaries (evacuation raced a new put, or a pull failed):
+        # promote a survivor copy, else the object is genuinely lost
+        for rec in list(self.objects.values()):
+            rec.copies.pop(node.node_id, None)
+            if rec.node_id == node.node_id:
+                if not self._promote_copy(rec):
+                    self.objects.pop(rec.oid, None)
+                    self._log_event(
+                        "object_lost", oid=rec.oid.hex(), node_id=node.node_id
+                    )
+        # the no-budget retry window must outlive the kills below
+        self._pub_drain(node)
+        for rec in list(self.workers.values()):
+            if rec.node_id == node.node_id and rec.state != "dead":
+                await self._on_worker_death(rec)
+        # the agent tears itself down (kills workers, sweeps shm, exits);
+        # providers watching for `drained` may now terminate the VM
+        if node.conn is not None and not node.conn.closed:
+            try:
+                node.conn.notify("node_shutdown")
+            except Exception:
+                pass
+        self._pub("nodes", {"node_id": node.node_id, "alive": False, "state": "drained"})
+        self._dirty = True
         self._service_queue()
 
     # --------------------------------------------------------------- objects
@@ -1554,11 +1915,19 @@ class Head:
             resources=self._agg_total(),
             head_tcp=self.tcp_addr,
         )
+        # late joiners learn about in-progress drains (their retries on those
+        # nodes must be budget-exempt too)
+        for node in self.nodes.values():
+            if node.state == "draining":
+                try:
+                    write_frame(state["writer"], self._drain_pub_frame(node))
+                except Exception:
+                    pass
 
     async def _register_agent(self, state, msg, reply, reply_err):
         node_id = msg["client_id"]
         existing = self.nodes.get(node_id)
-        if existing is not None and existing.state == "alive":
+        if existing is not None and existing.up:
             if existing.conn is None or existing.conn.closed:
                 # agent reconnecting to a restarted head: re-adopt in place
                 # (resource accounting was restored from the snapshot)
@@ -1567,7 +1936,7 @@ class Head:
                 existing.last_heartbeat = time.monotonic()
                 state["node_id"] = node_id
                 await self._connect_agent(existing)
-                if existing.state != "alive":
+                if not existing.up:
                     reply_err(ConnectionError(f"head cannot reach agent at {existing.addr}"))
                     return
                 self._log_event("node_readopted", node_id=node_id)
@@ -1721,7 +2090,7 @@ class Head:
             rec.blocked = True
             shape, node = self._blocked_shape_node(rec)
             cpus = (shape or {}).get("CPU", 0.0)
-            if cpus and node is not None and node.state == "alive":
+            if cpus and node is not None and node.up:
                 self._give(node.avail, {"CPU": cpus})
                 self._service_queue()
 
@@ -1732,7 +2101,7 @@ class Head:
             rec.blocked = False
             shape, node = self._blocked_shape_node(rec)
             cpus = (shape or {}).get("CPU", 0.0)
-            if cpus and node is not None and node.state == "alive":
+            if cpus and node is not None and node.up:
                 # oversubscribe temporarily rather than deadlock
                 self._take(node.avail, {"CPU": cpus})
 
@@ -1988,7 +2357,7 @@ class Head:
                 )
             return {"data": data, "off": new_off, "node_id": node_id}
         node = self.nodes.get(node_id)
-        if node is None or node.state != "alive" or node.conn is None or node.conn.closed:
+        if node is None or not node.up or node.conn is None or node.conn.closed:
             # RuntimeError, not ConnectionError: a pickled ConnectionError
             # would look like "head down" to head_call's reconnect retry loop
             raise RuntimeError(
@@ -2218,7 +2587,9 @@ class Head:
         if node_id == LOCAL_NODE:
             return self.tcp_addr
         node = self.nodes.get(node_id)
-        return node.addr if node is not None and node.state == "alive" else None
+        # draining nodes keep serving pulls: drain evacuation and borrowers
+        # both read from them until the deadline
+        return node.addr if node is not None and node.up else None
 
     def _locate_fields(self, rec: ObjectRec, caller_node: str) -> dict:
         if rec.node_id != caller_node and caller_node in rec.copies:
@@ -2532,7 +2903,7 @@ class Head:
             for b in pg.bundles:
                 if b.node_id is not None:
                     node = self.nodes.get(b.node_id)
-                    if node is not None and node.state == "alive":
+                    if node is not None and node.up:
                         self._give(node.avail, b.resources)
             if pg.state != "created":
                 try:
@@ -2608,12 +2979,26 @@ class Head:
             out.append(
                 {
                     "node_id": n.node_id,
-                    "alive": n.state == "alive",
+                    "alive": n.up,  # draining nodes are up (but unschedulable)
+                    "state": n.state,
+                    "drain": (
+                        {
+                            "reason": n.drain_reason,
+                            "deadline_in_s": round(
+                                max(0.0, n.drain_deadline - time.monotonic()), 3
+                            ),
+                        }
+                        if n.state == "draining"
+                        else None
+                    ),
                     "resources": n.total,
                     "available": n.avail,
                     "labels": n.labels,
                     "load": n.load if not n.is_local else node_load_sample(),
                     "is_head_node": n.is_local,
+                    # agent pid (same-host test tooling: PreemptionSimulator
+                    # sends the preemption SIGTERM straight to it)
+                    "pid": n.pid,
                     "lease_blocks": self._node_lease_blocks(n),
                     "n_workers": sum(
                         1
@@ -2655,6 +3040,12 @@ class Head:
         # flushed by every worker) next to this head's own shipped/dropped
         # stats — `ca status` shows both
         log_counters = self._log_counter_totals()
+        # drain plane: the client-side evacuated-task counter aggregates
+        # through the metrics table (submitters count their exempted retries)
+        evac = self.metrics.get("ca_drain_tasks_evacuated_total")
+        drain_tasks_evacuated = (
+            int(sum(evac["data"].values())) if evac and evac.get("data") else 0
+        )
         reply(
             rpc_counts=dict(self.rpc_counts),
             stats=dict(
@@ -2665,6 +3056,10 @@ class Head:
                 lease_local_used=lease_local_used,
                 lease_local_granted=lease_local_granted,
                 lease_head_granted=self.stats["leases_granted"],
+                drain_tasks_evacuated=drain_tasks_evacuated,
+                nodes_draining=sum(
+                    1 for n in self.nodes.values() if n.state == "draining"
+                ),
                 pending_leases=len(self.pending_leases),
                 idle_workers=sum(
                     len(d) for n in self._alive_nodes() for d in n.idle.values()
@@ -2919,13 +3314,18 @@ class Head:
                 ):
                     await self._on_worker_death(rec)
             for node in list(self.nodes.values()):
-                if node.state != "alive" or node.is_local:
+                if not node.up or node.is_local:
                     continue
                 if (
                     now - node.last_heartbeat
                     > period * self.config.health_check_failure_threshold
                 ):
                     await self._on_node_death(node)
+                    continue
+                if node.state == "draining" and (
+                    now >= node.drain_deadline or self._drain_quiesced(node)
+                ):
+                    await self._drain_finalize(node)
             if self._spent_transit:
                 # expire tombstones whose late pin never arrived (sender died)
                 cutoff = now - 60.0
@@ -3043,6 +3443,13 @@ class Head:
                 actors=len(self.actors),
                 nodes=len(self.nodes),
             )
+            # resume drains interrupted by the restart: re-announce to the
+            # re-registering clients and re-run the evacuation pass (idempotent
+            # — already-migrated actors/objects are no longer on the node)
+            for node in self.nodes.values():
+                if node.state == "draining":
+                    self._pub_drain(node)
+                    spawn_bg(self._drain_evacuate(node))
         # HTTP dashboard (dashboard/head.py analogue): zero extra process,
         # the head answers from its own tables
         self.dashboard = None

@@ -230,6 +230,7 @@ class NodeAgent:
         self.procs: Dict[str, subprocess.Popen] = {}  # wid -> proc
         self._pull_maps: Dict[str, Any] = {}
         self._shutdown = asyncio.Event()
+        self._draining = False  # SIGTERM self-drain already requested
 
     # --------------------------------------------------------------- workers
     def _spawn_worker(self, wid: str, purpose: str, pool: str) -> None:
@@ -463,6 +464,16 @@ class NodeAgent:
         with open(ready + ".tmp", "w") as f:
             f.write(f"{os.getpid()}\n{self.serve_addr}\n")
         os.replace(ready + ".tmp", ready)  # atomic: never visible half-written
+        # preemption warning: spot/preemptible VMs deliver SIGTERM tens of
+        # seconds before the kill — convert it into a head-driven drain
+        # (zero-loss evacuation) instead of dying by heartbeat timeout
+        try:
+            loop = asyncio.get_running_loop()
+            loop.add_signal_handler(
+                signal.SIGTERM, lambda: spawn_bg(self._self_drain())
+            )
+        except (NotImplementedError, RuntimeError):
+            pass  # non-unix loop: preemption warnings degrade to hard kills
         hb = spawn_bg(self._heartbeat_loop())
         head_watch = spawn_bg(self._watch_head())
         log_ship = spawn_bg(self._log_ship_loop())
@@ -471,6 +482,26 @@ class NodeAgent:
         head_watch.cancel()
         log_ship.cancel()
         self._teardown()
+
+    async def _self_drain(self):
+        """SIGTERM landed (preemption warning / graceful stop request): ask
+        the head to drain this node instead of dying by heartbeat timeout.
+        The agent keeps serving (object pulls, heartbeats, lease releases)
+        through the evacuation window; the head's `node_shutdown` notify ends
+        it.  A second SIGTERM — or an unreachable head — shuts down now."""
+        if self._draining:
+            self._shutdown.set()  # impatient supervisor: obey immediately
+            return
+        self._draining = True
+        try:
+            await self.head.call(
+                "drain_node", node_id=self.node_id, reason="preemption",
+                timeout=5,
+            )
+        except Exception:
+            # no head to evacuate through: the warning buys nothing — exit
+            # so workers die with the process group, not mid-RPC later
+            self._shutdown.set()
 
     async def _watch_head(self):
         """Watch the head connection, redialing through restarts (a restarted

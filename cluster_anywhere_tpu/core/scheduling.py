@@ -22,7 +22,7 @@ Policies mirrored from the reference:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Container, Dict, List, Optional, Sequence, Tuple
 
 Shape = Dict[str, float]
 
@@ -248,18 +248,27 @@ def _sim_take(avail: Shape, shape: Shape) -> bool:
     return True
 
 
-def rank_delegation(entries: Sequence[dict], pool: str) -> List[dict]:
+def rank_delegation(
+    entries: Sequence[dict], pool: str, exclude: Optional[Container[str]] = None
+) -> List[dict]:
     """Order lease-directory entries for a submitter's node choice on the
     lease plane (the client-side mirror of spread scheduling: the head picks
     WHERE capacity is delegated; the submitter only picks among blocks the
     head already sized).  Most free delegated slots first, so concurrent
     submitters fan out instead of stampeding one agent; entries without the
-    pool are dropped.  Occupancy is heartbeat-stale, so callers must treat
+    pool are dropped, as are nodes in `exclude` (draining nodes — their
+    blocks are being recalled, and a grant there would be killed at the
+    drain deadline).  Occupancy is heartbeat-stale, so callers must treat
     the order as a hint and probe down the list on denial."""
     def free(e: dict) -> int:
         b = (e.get("pools") or {}).get(pool) or {}
         return int(b.get("size", 0)) - int(b.get("used", 0))
 
-    ranked = [e for e in entries if (e.get("pools") or {}).get(pool)]
+    ranked = [
+        e
+        for e in entries
+        if (e.get("pools") or {}).get(pool)
+        and not (exclude and e.get("node_id") in exclude)
+    ]
     ranked.sort(key=lambda e: (-free(e), e.get("node_id", "")))
     return ranked

@@ -78,6 +78,20 @@ def lease_stats() -> Dict[str, int]:
     return dict(LEASE_STATS)
 
 
+# Drain-plane counters (shipped as ca_drain_* by util/metrics).  A task
+# retry caused by a drained/preempted node is a SYSTEM failure: it is
+# exempted from the user's max_retries budget and counted here instead.
+DRAIN_STATS: Dict[str, int] = {
+    "tasks_evacuated_total": 0,  # budget-exempt retries off draining nodes
+    "leases_recalled_total": 0,  # idle leases returned early on a drain pub
+}
+
+
+def drain_stats() -> Dict[str, int]:
+    """Snapshot of this process's drain-plane counters."""
+    return dict(DRAIN_STATS)
+
+
 def global_worker() -> "Worker":
     if _global_worker is None:
         raise RuntimeError("not initialized — call init() first")
@@ -144,6 +158,9 @@ class _Lease:
     # out of a delegated lease block) or None for the head.  Releases go
     # back to the granter.
     granter: Optional[str] = None
+    # node hosting the leased worker: lets the submitter tell a drain/
+    # preemption kill (budget-exempt retry) from an app-level worker crash
+    node: Optional[str] = None
 
 
 class LeasePool:
@@ -371,7 +388,12 @@ class LeasePool:
                 await asyncio.sleep(0.1)
                 continue
             LEASE_STATS["head_grants"] += 1
-            self._adopt_lease(_Lease(reply["lease_id"], reply["worker_id"], reply["addr"]))
+            self._adopt_lease(
+                _Lease(
+                    reply["lease_id"], reply["worker_id"], reply["addr"],
+                    node=reply.get("node"),
+                )
+            )
             return
 
     def _wake(self, n: int = 1):
@@ -522,6 +544,20 @@ class LeasePool:
         self.leases = [l for l in self.leases if not l.dead]
         return out
 
+    def reap_node(self, node_id: str) -> List[_Lease]:
+        """Give back every IDLE lease hosted on `node_id` (drain recall:
+        the node is leaving — new pushes must land on survivors).  Busy
+        leases run on until the drain deadline; their deaths retry
+        budget-exempt."""
+        out = []
+        for l in self.leases:
+            if not l.dead and l.inflight == 0 and l.node == node_id:
+                l.dead = True
+                out.append(l)
+        if out:
+            self.leases = [l for l in self.leases if not l.dead]
+        return out
+
     def reap_contended(self) -> List[_Lease]:
         """Another client's lease request is pending at the head: give back
         every idle lease this pool does not need for its own current demand
@@ -633,6 +669,11 @@ class Worker:
         # cancelled, and where each in-flight push currently executes
         self._cancelled_tasks: set = set()
         self._inflight_tasks: Dict[bytes, str] = {}  # task_id -> worker addr
+        # drain plane: node_id -> monotonic expiry of the preemption window.
+        # Fed by "drain" pubs from the head; worker/lease deaths on a node
+        # inside its window are SYSTEM failures — retried without consuming
+        # the task's max_retries budget (see _retry_exempt)
+        self._draining_nodes: Dict[str, float] = {}
         # lineage: task specs of submitted normal tasks, so a lost object can
         # be recomputed by re-executing its creating task (object_recovery_
         # manager.h).  Holding the original arg ObjectRefs here pins the
@@ -835,6 +876,8 @@ class Worker:
             name = data.get("shm_name")
             if name:
                 self.shm_store.free_local(name)
+        elif ch == "drain":
+            self._on_drain_pub(msg.get("data") or {})
         elif ch == "lease_reclaim":
             # another client's lease request is queued: return surplus idle
             # leases NOW instead of after the idle timeout, and shed down to
@@ -854,6 +897,49 @@ class Worker:
                     pool.contended_until = time.monotonic() + 1.0
                 to_return.extend(pool.reap_contended())
             self.return_leases(to_return)
+
+    # drain kills may land a little after the announced deadline (the head's
+    # monitor tick, worker teardown): the retry exemption outlives it by this
+    _DRAIN_GRACE_S = 15.0
+
+    def _on_drain_pub(self, data: dict) -> None:
+        """Head announced a node drain (preemption warning, `ca drain`,
+        autoscaler downscale).  From now until the deadline (+grace), any
+        worker death on that node is a system failure: retries are exempt
+        from the user's max_retries budget.  Idle leases on the node are
+        returned immediately so new tasks land on survivors."""
+        nid = data.get("node_id")
+        if not nid:
+            return
+        window = float(data.get("deadline_s") or 0.0) + self._DRAIN_GRACE_S
+        self._draining_nodes[nid] = time.monotonic() + window
+        # steer new local grants away: the cached lease directory may name
+        # the draining agent for up to a TTL — drop it now
+        ts, entries = self._lease_dir_cache
+        if entries:
+            self._lease_dir_cache = (
+                ts, [e for e in entries if e.get("node_id") != nid]
+            )
+        recalled = []
+        for pool in self._lease_pools.values():
+            recalled.extend(pool.reap_node(nid))
+        if recalled:
+            DRAIN_STATS["leases_recalled_total"] += len(recalled)
+            self.return_leases(recalled)
+
+    def _retry_exempt(self, node_id: Optional[str]) -> bool:
+        """Is a worker death on `node_id` inside a drain window?  Exempt
+        retries don't consume max_retries (announced exits are the system's
+        fault, not the app's)."""
+        if not node_id:
+            return False
+        exp = self._draining_nodes.get(node_id)
+        if exp is None:
+            return False
+        if time.monotonic() > exp:
+            del self._draining_nodes[node_id]
+            return False
+        return True
 
     async def _housekeeping(self):
         period = 0.25
@@ -879,6 +965,12 @@ class Worker:
             for pool in self._lease_pools.values():
                 to_return.extend(pool.reap_idle(now, self.config.lease_idle_timeout_s))
             self.return_leases(to_return)
+            if self._draining_nodes:
+                # expired preemption windows (the node is gone or the drain
+                # completed long ago) stop excluding/exempting
+                self._draining_nodes = {
+                    n: t for n, t in self._draining_nodes.items() if t > now
+                }
             self.reference_counter.flush()
             self._flush_task_events()
 
@@ -976,7 +1068,9 @@ class Worker:
         from . import scheduling
 
         denied = False
-        for ent in scheduling.rank_delegation(entries, pool):
+        for ent in scheduling.rank_delegation(
+            entries, pool, exclude=self._draining_nodes
+        ):
             try:
                 conn = await self.conn_to(ent["addr"])
                 r = await conn.call("lease_grant", pool=pool, timeout=5)
@@ -987,7 +1081,8 @@ class Worker:
                 if blk is not None:  # optimistic: steer the next grant away
                     blk["used"] = blk.get("used", 0) + 1
                 return _Lease(
-                    r["lease_id"], r["worker_id"], r["addr"], granter=ent["addr"]
+                    r["lease_id"], r["worker_id"], r["addr"],
+                    granter=ent["addr"], node=ent.get("node_id"),
                 ), True
             denied = True
             if blk is not None:
@@ -1354,6 +1449,10 @@ class Worker:
             return conn
         except BaseException as e:
             fut.set_exception(e)
+            # mark retrieved for the no-other-waiter case (the creator
+            # re-raises below), else GC logs "exception was never retrieved"
+            # on every refused dial — e.g. racing a drained worker's address
+            fut.exception()
             raise
         finally:
             del self._connecting[addr]
@@ -2600,9 +2699,17 @@ class Worker:
                     self._store_error(oids, TaskCancelledError("task was cancelled"))
                     return
                 # worker died with the push in flight: retry on a fresh lease
-                # only within the task's retry budget (at-most-once otherwise)
+                # only within the task's retry budget (at-most-once otherwise).
+                # Death on a DRAINING node is a preemption, not an app
+                # failure: the retry is free — the budget is not touched
                 retries = opts.get("max_retries", self.config.default_max_retries)
-                if retries > 0:
+                if self._retry_exempt(lease.node):
+                    DRAIN_STATS["tasks_evacuated_total"] += 1
+                    t = spawn_bg(
+                        self._submit_task(task_id, fn_id, None, (), {}, opts, oids)
+                    )
+                    t.add_done_callback(self._report_task_exc)
+                elif retries > 0:
                     retry_opts = dict(opts, max_retries=retries - 1)
                     t = spawn_bg(
                         self._submit_task(task_id, fn_id, None, (), {}, retry_opts, oids)
@@ -2779,6 +2886,10 @@ class Worker:
                 if task_id.binary() in self._cancelled_tasks:
                     self._store_error(oids, TaskCancelledError("task was cancelled"))
                     return
+                if self._retry_exempt(lease.node):
+                    # preemption/drain kill: free retry, budget untouched
+                    DRAIN_STATS["tasks_evacuated_total"] += 1
+                    continue
                 if retries > 0:
                     retries -= 1
                     continue

@@ -133,8 +133,12 @@ async function refresh() {
     " &nbsp; actors " + s.stats.n_actors + " &nbsp; objects " + s.stats.n_objects +
     " &nbsp; pending leases " + s.stats.pending_leases;
   const nodes = await (await fetch("/api/nodes")).json();
-  document.getElementById("nodes").innerHTML = row(["node", "alive", "head", "CPU avail/total", "workers", "leases used/delegated", "labels"], "th") +
-    nodes.map(n => row([n.node_id, n.alive ? "<span class=ok>yes</span>" : "<span class=bad>DEAD</span>",
+  document.getElementById("nodes").innerHTML = row(["node", "state", "head", "CPU avail/total", "workers", "leases used/delegated", "labels"], "th") +
+    nodes.map(n => row([n.node_id,
+      n.state == "alive" ? "<span class=ok>alive</span>" :
+      n.state == "draining" ? "<span class=warn>draining " + esc((n.drain||{}).reason||"") +
+        " " + ((n.drain||{}).deadline_in_s||0).toFixed(0) + "s</span>" :
+      "<span class=bad>" + esc((n.state||"dead").toUpperCase()) + "</span>",
       n.is_head_node ? "*" : "", (n.available.CPU||0) + "/" + (n.resources.CPU||0), n.n_workers,
       esc(Object.entries(n.lease_blocks||{})
         .map(([p, b]) => p + " " + b.used + "/" + b.size).join(" ") || "-"),
@@ -282,7 +286,22 @@ class Dashboard:
                 [
                     {
                         "node_id": n.node_id,
-                        "alive": n.state == "alive",
+                        "alive": n.up,  # draining: up but unschedulable
+                        "state": n.state,
+                        "drain": (
+                            {
+                                "reason": n.drain_reason,
+                                "deadline_in_s": round(
+                                    max(
+                                        0.0,
+                                        n.drain_deadline - time.monotonic(),
+                                    ),
+                                    3,
+                                ),
+                            }
+                            if n.state == "draining"
+                            else None
+                        ),
                         "is_head_node": n.is_local,
                         "resources": n.total,
                         "available": n.avail,

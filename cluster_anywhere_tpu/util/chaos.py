@@ -2,7 +2,7 @@
 python/ray/_private/test_utils.py:1512 ResourceKillerActor/WorkerKillerActor,
 and the RPC chaos env described in src/ray/rpc/rpc_chaos.h).
 
-Two layers:
+Three layers:
 - RPC chaos: set CA_TESTING_RPC_FAILURE="method=N,method2=M" (or the
   testing_rpc_failure config field) before init(); the first N sends of each
   named method raise ConnectionError in the sending process.  Deterministic —
@@ -10,16 +10,23 @@ Two layers:
 - WorkerKiller: kills random pool-worker processes on a cadence while a
   workload runs, from a thread in the driver (same-host process kill; the
   multi-node analogue is Cluster.remove_node).
+- PreemptionSimulator: replays a spot/preemptible-VM termination against a
+  node agent — SIGTERM (the cloud's warning, which the agent converts into
+  a self-drain), then SIGKILL once the warning window expires (the cloud
+  reclaiming the VM regardless of drain progress).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import random
 import signal
 import threading
 import time
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 
 class WorkerKiller:
@@ -30,6 +37,7 @@ class WorkerKiller:
         self.period_s = period_s
         self.max_kills = max_kills
         self.kills = 0
+        self.skipped = 0  # rounds where listing failed or the pid was gone
         self._rng = random.Random(seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -48,16 +56,88 @@ class WorkerKiller:
         while not self._stop.is_set() and self.kills < self.max_kills:
             try:
                 victims = self._victims()
-                if victims:
-                    victim = self._rng.choice(victims)
+            except (ConnectionError, RuntimeError, KeyError) as e:
+                # head unreachable / worker not initialized: skip this round,
+                # loudly — a killer that silently stops killing invalidates
+                # the chaos test it is supposed to drive
+                self.skipped += 1
+                log.warning("WorkerKiller: victim listing failed (%r), skipping", e)
+                self._stop.wait(self.period_s)
+                continue
+            if victims:
+                victim = self._rng.choice(victims)
+                try:
                     os.kill(victim["pid"], signal.SIGKILL)
+                except ProcessLookupError:
+                    # victim exited between listing and kill: not a kill,
+                    # try again next round
+                    self.skipped += 1
+                    log.info(
+                        "WorkerKiller: pid %s already gone, skipped", victim["pid"]
+                    )
+                else:
                     self.kills += 1
-            except (ProcessLookupError, Exception):
-                pass
             self._stop.wait(self.period_s)
 
     def start(self) -> "WorkerKiller":
         self._thread = threading.Thread(target=self._loop, daemon=True, name="ca-killer")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class PreemptionSimulator:
+    """Replay a spot/preemptible-VM termination against one node agent
+    (same-host processes only): SIGTERM now — the cloud's advance warning,
+    which the agent turns into a head-driven self-drain — then SIGKILL after
+    `kill_after_s` if the agent is still up, the cloud reclaiming the VM
+    whether or not the drain finished.  A well-tuned drain deadline finishes
+    the evacuation first, so the SIGKILL usually finds the process gone."""
+
+    def __init__(self, node_id: str, kill_after_s: float = 30.0):
+        self.node_id = node_id
+        self.kill_after_s = kill_after_s
+        self.sigterm_at: Optional[float] = None
+        self.sigkilled = False  # the warning window expired before exit
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _agent_pid(self) -> int:
+        from ..core.worker import global_worker
+
+        for n in global_worker().head_call("nodes")["nodes"]:
+            if n["node_id"] == self.node_id:
+                if not n.get("pid"):
+                    raise RuntimeError(f"node {self.node_id} has no known agent pid")
+                return n["pid"]
+        raise ValueError(f"unknown node {self.node_id!r}")
+
+    def _loop(self, pid: int):
+        if self._stop.wait(self.kill_after_s):
+            return  # cancelled: the preemption never completed
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass  # drained and exited inside the window: the good ending
+        else:
+            self.sigkilled = True
+            log.warning(
+                "PreemptionSimulator: node %s still up after %.1fs, SIGKILLed",
+                self.node_id, self.kill_after_s,
+            )
+
+    def start(self) -> "PreemptionSimulator":
+        pid = self._agent_pid()
+        os.kill(pid, signal.SIGTERM)  # the preemption warning
+        self.sigterm_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, args=(pid,), daemon=True, name="ca-preempt"
+        )
         self._thread.start()
         return self
 

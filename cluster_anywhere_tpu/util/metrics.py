@@ -68,6 +68,16 @@ _LOGPLANE_DESCS = {
 }
 
 
+_drain_shipped: Dict[str, int] = {}
+_DRAIN_DESCS = {
+    "tasks_evacuated_total": (
+        "task retries exempted from max_retries because the worker died on "
+        "a draining/preempted node"
+    ),
+    "leases_recalled_total": "idle leases returned early on a drain pub",
+}
+
+
 _lease_shipped: Dict[str, int] = {}
 _LEASE_DESCS = {
     "local_grants": "leases granted node-locally by agents (lease blocks)",
@@ -115,6 +125,17 @@ def _lease_records() -> List[dict]:
     from ..core.worker import LEASE_STATS
 
     return _counter_deltas("ca_lease_", LEASE_STATS, _lease_shipped, _LEASE_DESCS)
+
+
+def _drain_records() -> List[dict]:
+    """Drain-plane counters (core/worker.py DRAIN_STATS) as ca_drain_*
+    records: budget-exempt task evacuations and early lease recalls — the
+    client-side half of the drain plane (the head ships its own
+    nodes_drained / drain_actors_migrated / drain_objects_migrated /
+    drain_deadline_kills through the stats table)."""
+    from ..core.worker import DRAIN_STATS
+
+    return _counter_deltas("ca_drain_", DRAIN_STATS, _drain_shipped, _DRAIN_DESCS)
 
 
 def _logplane_records() -> List[dict]:
@@ -167,6 +188,7 @@ def flush_once():
         batch.extend(m._drain())
     batch.extend(_wire_records())
     batch.extend(_lease_records())
+    batch.extend(_drain_records())
     batch.extend(_logplane_records())
     if not batch:
         return
