@@ -147,8 +147,24 @@ def bench_core():
     except Exception:
         pass
 
+    # ownership-plane counters: in a clean bench run the object lifetime
+    # traffic settles owner-resident — refs_head_fallback ~0 and the head's
+    # obj_refs RPC count near zero are the structural halves of the claim
+    ownerplane = {}
+    try:
+        from cluster_anywhere_tpu.core.ownership import owner_stats
+        from cluster_anywhere_tpu.core.worker import global_worker
+
+        ownerplane = owner_stats()
+        rc = global_worker().head_call("stats").get("rpc_counts", {})
+        ownerplane["head_obj_refs_rpcs"] = rc.get("obj_refs", 0)
+        ownerplane["head_owner_sync_rpcs"] = rc.get("owner_sync", 0)
+        log(f"ownerplane counters: {ownerplane}")
+    except Exception:
+        pass
+
     ca.shutdown()
-    return best_tasks, best_actor, sync_rate, logplane, drainplane
+    return best_tasks, best_actor, sync_rate, logplane, drainplane, ownerplane
 
 
 class _MemcpyProbe:
@@ -399,7 +415,7 @@ def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
 
 
 def main():
-    _, best_actor, _, logplane, drainplane = bench_core()
+    _, best_actor, _, logplane, drainplane, ownerplane = bench_core()
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -415,6 +431,8 @@ def main():
         out["logplane"] = logplane
     if drainplane:
         out["drainplane"] = drainplane
+    if ownerplane:
+        out["ownerplane"] = ownerplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

@@ -267,6 +267,24 @@ def cmd_status(args):
                 f"delegated (granted {b.get('granted', 0)}, "
                 f"denied {b.get('denied', 0)})"
             )
+    # ownership plane: owner-resident vs head-fallback settlement volume —
+    # the structural proof (or diagnosis) that object lifetime traffic
+    # stays off the head in steady state
+    try:
+        from .util.state import owner_plane
+
+        op = owner_plane()
+        if op["counters"] or op["objects_released_by_owner"]:
+            print("== ownership plane (cluster-aggregated) ==")
+            for k, v in sorted(op["counters"].items()):
+                print(f"  {k}: {v}")
+            for k in (
+                "objects_released_by_owner", "owners_adopted",
+                "early_refs_expired", "head_obj_refs_rpcs",
+            ):
+                print(f"  {k}: {op[k]}")
+    except Exception:
+        pass  # pre-plane head (rolling upgrade): status stays usable
     ca.shutdown()
 
 
@@ -469,6 +487,13 @@ def cmd_microbenchmark(args):
 
         run_lease_plane(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "owner_plane", False):
+        # owns its own clusters (owner-resident vs centralized object A/B
+        # plus the GC-with-the-head-down proof)
+        from .microbenchmark import run_owner_plane
+
+        run_owner_plane(quick=getattr(args, "quick", False))
+        return
 
     import cluster_anywhere_tpu as ca
 
@@ -649,6 +674,11 @@ def main(argv=None):
     sp.add_argument(
         "--lease-plane", dest="lease_plane", action="store_true",
         help="node-local vs head lease granting tasks/s + head-RPC proof",
+    )
+    sp.add_argument(
+        "--owner-plane", dest="owner_plane", action="store_true",
+        help="owner-resident vs centralized object settlement A/B + "
+        "head-down GC proof",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

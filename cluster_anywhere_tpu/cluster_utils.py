@@ -188,7 +188,10 @@ class Cluster:
     def connect(self) -> dict:
         from .core import api
 
-        info = api.init(address=self.session_dir)
+        # the driver must run the SAME plane configuration as the cluster it
+        # joins (e.g. owner_plane off in an A/B) — a default-config driver
+        # would settle its objects owner-resident against a centralized head
+        info = api.init(address=self.session_dir, config=self.config)
         self._connected = True
         return info
 

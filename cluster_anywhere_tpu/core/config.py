@@ -53,9 +53,27 @@ class CAConfig:
     # per TTL while growing, zero in steady state)
     lease_dir_ttl_s: float = 3.0
 
+    # --- ownership plane (core/ownership.py; NSDI'21 ownership protocol) ---
+    # owner-resident object lifetime: borrowers settle inc/dec with the
+    # OWNER process's ledger over direct connections; the head keeps only
+    # the registry (obj_created/obj_release) and adopts orphaned ledgers on
+    # owner death.  Off = classic centralized holders at the head.
+    owner_plane: bool = True
+    # owner_sync digest cadence (ledger deltas ride the housekeeping loop)
+    owner_sync_period_s: float = 1.0
+    # how long the head (and owner ledgers) hold a refcount inc that arrived
+    # before its obj_created/registration (cross-socket ordering), before the
+    # entry is swept as orphaned.  Must comfortably exceed the longest task
+    # whose return ref is forwarded before completion.
+    early_ref_grace_s: float = 600.0
+
     # --- multi-node ---
     head_host: str = "127.0.0.1"  # TCP bind host for the head (cross-host: 0.0.0.0)
     transfer_chunk_bytes: int = 4 * 1024**2  # node-to-node object pull chunk
+    # delta-synced node state (ray_syncer analogue): agents send versioned
+    # component deltas (node_sync) instead of full per-tick heartbeats; an
+    # idle node's tick is a bare keepalive.  Off = legacy full node_heartbeat.
+    delta_sync: bool = True
 
     # --- health / failure detection ---
     health_check_period_s: float = 2.0

@@ -79,6 +79,8 @@ class NodeRec:
     delegated: Dict[str, set] = field(default_factory=dict)
     # agent-reported block occupancy/counters, disseminated via heartbeats
     lease_used: Dict[str, dict] = field(default_factory=dict)
+    # last node_sync delta version applied (delta-synced node state)
+    sync_version: int = 0
 
     @property
     def is_local(self) -> bool:
@@ -161,6 +163,11 @@ class ObjectRec:
     # held alive (holder "cnt:<oid>") for as long as this object exists
     # (borrowed-reference containment edges)
     contains: List[bytes] = field(default_factory=list)
+    # ownership-plane form of the same, for containers whose owner has no
+    # ledger (client mode): [oid, authority-cid-or-""] pairs whose edges
+    # live at each inner object's OWN authority — released by the registry
+    # when this record settles (see _release_cnt_pairs)
+    cnt_pairs: Optional[list] = None
     # spill state (external_storage.py analogue): when set, the bytes live in
     # a disk file on `node_id`; pending_free is the old shm slice awaiting
     # reclaim until the last zero-copy pin drops
@@ -237,8 +244,20 @@ class Head:
         self.actors: Dict[str, ActorRec] = {}
         self.named_actors: Dict[str, str] = {}
         self.objects: Dict[bytes, ObjectRec] = {}
-        # refs reported before obj_created arrived (cross-socket ordering)
+        # refs reported before obj_created arrived (cross-socket ordering).
+        # Bounded by an EXPLICIT grace window (config.early_ref_grace_s, the
+        # same bound owner ledgers use for their pending adds): entries older
+        # than the window are swept by the monitor loop instead of relying on
+        # the obj_created eventually arriving — a crashed producer must not
+        # pin its early refs forever.
         self._early_refs: Dict[bytes, set] = {}
+        self._early_ref_ts: Dict[bytes, float] = {}
+        # ownership plane: per-owner ledger digests (owner_sync deltas).
+        # The head is the failover arbiter — when an owner dies, the last
+        # synced digest is what it adopts (borrower sets + released flags)
+        # so orphaned objects drain through the central path without leaking
+        # shm segments or spill files.
+        self.owner_digests: Dict[str, Dict[bytes, dict]] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.pgs: Dict[str, PGRec] = {}
         self.pending_pgs: deque = deque()  # PG ids awaiting resources, FIFO
@@ -501,7 +520,8 @@ class Head:
                     "oid": r.oid, "shm_name": r.shm_name, "size": r.size,
                     "owner": r.owner, "node_id": r.node_id, "copies": r.copies,
                     "holders": list(r.holders), "owner_released": r.owner_released,
-                    "contains": r.contains, "spill_path": r.spill_path,
+                    "contains": r.contains, "cnt_pairs": r.cnt_pairs,
+                    "spill_path": r.spill_path,
                     "pending_free": r.pending_free,
                 }
                 for r in self.objects.values()
@@ -511,6 +531,12 @@ class Head:
             "lease_pg": {k: list(v) for k, v in self._lease_pg.items()},
             "lease_node": self._lease_node,
             "stats": self.stats,
+            # ownership plane: owners whose death lands in the restart
+            # window must still be adoptable from their last synced digest
+            "owner_digests": [
+                [cid, [[oid, info] for oid, info in d.items()]]
+                for cid, d in self.owner_digests.items()
+            ],
         }
         blob = msgpack.packb(state, use_bin_type=True)
         tmp = self._ckpt_path + ".tmp"
@@ -574,6 +600,7 @@ class Head:
                 oid=r["oid"], shm_name=r["shm_name"], size=r["size"],
                 owner=r["owner"], node_id=r["node_id"], copies=r["copies"],
                 owner_released=r["owner_released"], contains=r["contains"],
+                cnt_pairs=r.get("cnt_pairs"),
                 spill_path=r.get("spill_path"), pending_free=r.get("pending_free"),
             )
             rec.holders = set(r["holders"])
@@ -583,6 +610,8 @@ class Head:
         self._lease_pg = {k: tuple(v) for k, v in state["lease_pg"].items()}
         self._lease_node = state["lease_node"]
         self.stats.update(state["stats"])
+        for cid, entries in state.get("owner_digests") or ():
+            self.owner_digests[cid] = {bytes(oid): info for oid, info in entries}
 
     async def _persist_loop(self):
         """Debounced snapshot writer: at most one disk write per interval.
@@ -1790,6 +1819,24 @@ class Head:
                 except Exception:
                     pass
 
+    def _early_ref_add(self, oid: bytes, holder: str) -> None:
+        """Park a holder registration that raced ahead of obj_created
+        (cross-socket ordering).  The grace window is EXPLICIT and bounded:
+        the first add stamps the entry, and the monitor loop expires entries
+        older than config.early_ref_grace_s — a producer that died before
+        registering must not pin its early refs forever (and dict insertion
+        order is no longer load-bearing for cleanup)."""
+        e = self._early_refs.get(oid)
+        if e is None:
+            e = self._early_refs[oid] = set()
+            self._early_ref_ts[oid] = time.monotonic()
+        e.add(holder)
+
+    def _take_early_refs(self, oid: bytes) -> set:
+        """Adopt (and clear) the parked holders at obj_created time."""
+        self._early_ref_ts.pop(oid, None)
+        return self._early_refs.pop(oid, set())
+
     def _obj_maybe_gc(self, rec: ObjectRec):
         if rec.owner_released and not rec.holders:
             self.objects.pop(rec.oid, None)
@@ -1810,11 +1857,19 @@ class Head:
                     if inner is not None:
                         inner.holders.discard(edge)
                         self._obj_maybe_gc(inner)
+            if rec.cnt_pairs:
+                # owner-resident edges of a ledgerless (client-mode) owner's
+                # container: route each dec to the ledger holding the pin
+                self._release_cnt_pairs(
+                    f"cnt:{rec.owner}:{rec.oid.hex()}", rec.cnt_pairs
+                )
+                rec.cnt_pairs = None
 
     # --------------------------------------------------------------- handler
     _READONLY_METHODS = frozenset(
         {
-            "heartbeat", "node_heartbeat", "kv_get", "kv_keys", "get_function",
+            "heartbeat", "node_heartbeat", "node_sync", "kv_get", "kv_keys",
+            "get_function",
             "obj_locate", "pull_chunk", "nodes", "cluster_resources", "stats",
             "client_addr", "lease_dir",
             "list_actors", "list_workers", "list_task_events", "list_objects",
@@ -1991,6 +2046,81 @@ class Head:
                 # agent-side block occupancy (delegated vs used) for
                 # `ca status` / /api/nodes / lease_dir freshness
                 node.lease_used = msg["lease_stats"] or {}
+
+    async def _h_node_sync(self, state, msg, reply, reply_err):
+        """Delta-synced node state (the ray_syncer analogue, head-ward):
+        agents send versioned component deltas instead of full per-tick
+        heartbeats.  A bare {node_id} frame is a keepalive (liveness only);
+        components present in the frame replace the stored state; a frame
+        with full=True replaces everything (reconnect resync).  The
+        mem-pressure component carries a [flag, tick] pair while pressured
+        so the kill policy's clear-after-acting re-arm keeps working."""
+        node = self.nodes.get(msg.get("node_id", state.get("node_id")))
+        if node is None:
+            return
+        node.last_heartbeat = time.monotonic()
+        if "v" in msg:
+            node.sync_version = msg["v"]
+        if "load" in msg:
+            node.load = msg["load"]
+        if "lease_stats" in msg:
+            node.lease_used = msg["lease_stats"] or {}
+        if "mem_pressured" in msg:
+            v = msg["mem_pressured"]
+            node.mem_pressured = (
+                bool(v[0]) if isinstance(v, (list, tuple)) else bool(v)
+            )
+
+    async def _h_owner_sync(self, state, msg, reply, reply_err):
+        """An owner's ledger digest (versioned delta, or full on reconnect):
+        what the head adopts if that owner dies.  Entries carry the borrower
+        set ("b"), the owner-released flag ("r"), and whether the object is
+        registered here ("g"); removed oids settle out of the digest."""
+        cid = state.get("client_id", "?")
+        digest = self.owner_digests.setdefault(cid, {})
+        if msg.get("full"):
+            digest.clear()
+        for oid, info in (msg.get("e") or {}).items():
+            digest[oid] = info
+        for oid in msg.get("rm") or ():
+            digest.pop(oid, None)
+
+    async def _h_obj_release(self, state, msg, reply, reply_err):
+        """An owner's ledger settled an object's cluster-wide lifetime (the
+        registry half of ownership-plane GC): drop the record and reclaim
+        whatever physical copies the owner could not free itself — it
+        already freed its local slices/spill files and says so in `freed`,
+        which must not be double-freed (arena slices get recycled)."""
+        cid = state.get("client_id", "?")
+        digest = self.owner_digests.get(cid)
+        released = 0
+        for pair in msg.get("rel") or ():
+            oid, freed = pair[0], set(pair[1] or ())
+            if digest is not None:
+                digest.pop(oid, None)
+            rec = self.objects.get(oid)
+            if rec is None:
+                # never registered (inline-only) or already reaped: drop any
+                # stray early refs so they don't age out as "expired"
+                if self._early_refs.pop(oid, None) is not None:
+                    self._early_ref_ts.pop(oid, None)
+                continue
+            if rec.shm_name in freed:
+                rec.shm_name = None
+            if rec.pending_free in freed:
+                rec.pending_free = None
+            if rec.spill_path and ("spill:" + rec.spill_path) in freed:
+                rec.spill_path = None
+            # the owner is the lifetime authority: its settle overrides any
+            # head-side holder residue (early strays, fallback pins)
+            rec.owner_released = True
+            rec.holders.clear()
+            self._obj_maybe_gc(rec)
+            released += 1
+        if released:
+            self.stats["objects_released_by_owner"] = (
+                self.stats.get("objects_released_by_owner", 0) + released
+            )
 
     async def _h_worker_exit(self, state, msg, reply, reply_err):
         """Node agent reports one of its worker processes exited."""
@@ -2467,7 +2597,7 @@ class Head:
                 owner=state.get("client_id", "?"),
                 node_id=LOCAL_NODE,
             )
-            rec.holders |= self._early_refs.pop(oid, set())
+            rec.holders |= self._take_early_refs(oid)
             self.objects[oid] = rec
             self.stats["objects_created"] += 1
         reply(name=name)
@@ -2496,17 +2626,83 @@ class Head:
             owner=msg.get("owner") or state.get("client_id", "?"),
             node_id=msg.get("node") or state.get("node_id", LOCAL_NODE),
         )
-        rec.holders |= self._early_refs.pop(oid, set())
+        rec.holders |= self._take_early_refs(oid)
         self.objects[oid] = rec
         self.stats["objects_created"] += 1
 
+    def _forward_to_owner(self, owner: str, frame: dict) -> bool:
+        """Push a settlement frame to a live owner's ledger over its own head
+        connection (the worker side serves owner_refs/owner_transit_done on
+        that socket).  Only owners that run a ledger qualify — a synced
+        digest is the proof (client-mode drivers never sync one).  Returns
+        False when the owner is dead/ledgerless/unwritable: the caller keeps
+        the central path, which is also the post-adoption authority."""
+        if owner not in self.owner_digests:
+            return False
+        st = self._clients.get(owner)
+        if st is None:
+            return False
+        try:
+            write_frame(st["writer"], frame)
+            return True
+        except Exception:
+            return False
+
+    def _release_cnt_pairs(self, edge: str, pairs) -> None:
+        """Release owner-resident containment edges held under `edge` for a
+        container whose lifetime settled HERE (its owner has no ledger):
+        each dec routes to the ledger that actually holds the pin — a live
+        owner's, pushed over its own head connection (the worker side
+        serves `owner_refs` on that socket), or this registry for
+        head-resident/adopted inners."""
+        for p in pairs:
+            ioid, iowner = bytes(p[0]), p[1]
+            if iowner and self._forward_to_owner(
+                iowner, {"m": "owner_refs", "dec": [ioid], "as_id": edge}
+            ):
+                continue
+            # head-resident inner (incl. one owned by a LEDGERLESS client —
+            # the digest qualification inside _forward_to_owner refuses
+            # those, whose serve_owner_refs would drop the dec), or a dead
+            # owner whose ledger this registry adopted: settle centrally
+            rec = self.objects.get(ioid)
+            if rec is not None:
+                rec.holders.discard(edge)
+                self._obj_maybe_gc(rec)
+            else:
+                e = self._early_refs.get(ioid)
+                if e is not None:
+                    e.discard(edge)
+
     async def _h_obj_contains(self, state, msg, reply, reply_err):
         """Register containment edges: the object's payload embeds serialized
-        ObjectRefs, which must outlive it (borrowing, reference_count.h)."""
+        ObjectRefs, which must outlive it (borrowing, reference_count.h).
+        Two forms: the head-resident one (refs only — this registry adds
+        `cnt:<container>` holders to inner records), and the ownership-plane
+        `pairs` form from a LEDGERLESS owner (client mode), whose edges
+        already live at each inner object's own authority under
+        `cnt:<owner>:<container>` — the registry only remembers the pairs so
+        it can release them when the container settles here."""
         rec = self.objects.get(msg["oid"])
         refs = msg.get("refs") or []
+        pairs = msg.get("pairs")
         if rec is None:
+            if pairs:
+                # container already settled or never registered: nobody else
+                # will release these edges
+                cid = state.get("client_id", "?")
+                self._release_cnt_pairs(
+                    f"cnt:{cid}:{msg['oid'].hex()}", pairs
+                )
             return  # container unknown (already GC'd): nothing to pin
+        if pairs is not None:
+            edge = f"cnt:{rec.owner}:{rec.oid.hex()}"
+            if rec.cnt_pairs:
+                # re-registration (e.g. reconstruction re-ran the creating
+                # task): release the previous edges or the old inners leak
+                self._release_cnt_pairs(edge, rec.cnt_pairs)
+            rec.cnt_pairs = [[bytes(i), o] for i, o in pairs]
+            return
         edge = f"cnt:{rec.oid.hex()}"
         if rec.contains:
             # re-registration (e.g. reconstruction re-ran the creating task):
@@ -2522,7 +2718,7 @@ class Head:
             if inner is not None:
                 inner.holders.add(edge)
             else:
-                self._early_refs.setdefault(r, set()).add(edge)
+                self._early_ref_add(r, edge)
 
     async def _h_transit_done(self, state, msg, reply, reply_err):
         """Receiver ack of in-transit borrowed refs: the receiver now holds
@@ -2540,6 +2736,17 @@ class Head:
         for oid in msg.get("oids") or []:
             rec = self.objects.get(oid)
             if rec is not None:
+                if token not in rec.holders and self._forward_to_owner(
+                    rec.owner,
+                    {
+                        "m": "owner_transit_done", "token": token,
+                        "oids": [oid], "cid": cid, "register": register,
+                    },
+                ):
+                    # ack fallback for a pin living in the (alive) owner's
+                    # ledger: settle it there — tombstone semantics and the
+                    # borrower registration must land at the same authority
+                    continue
                 if register:
                     rec.holders.add(cid)
                 if token in rec.holders:
@@ -2555,7 +2762,7 @@ class Head:
                         seen = True
                         early.discard(token)
                 elif register:
-                    self._early_refs.setdefault(oid, set()).add(cid)
+                    self._early_ref_add(oid, cid)
         if not seen:
             self._spent_transit[token] = time.monotonic()
 
@@ -2677,6 +2884,23 @@ class Head:
         (local_object_manager.h spill).  The old shm slice is reclaimed
         immediately when nothing holds a zero-copy view of it; otherwise the
         reclaim waits for the last pin to drop."""
+        if msg.get("decided"):
+            # ownership plane: the OWNER already made the free-now-vs-defer
+            # call against its ledger's pin state; this notify just keeps
+            # the registry snapshot (locate/pull routing, failover) current
+            rec = self.objects.get(msg["oid"])
+            if rec is not None:
+                for nid, name in rec.copies.items():
+                    self._free_shm_name(name, nid)
+                rec.copies.clear()
+                rec.spill_path = msg["path"]
+                rec.shm_name = None
+                rec.pending_free = None
+                self.stats["objects_spilled"] = (
+                    self.stats.get("objects_spilled", 0) + 1
+                )
+            reply(found=rec is not None, free_now=False)
+            return
         rec = self.objects.get(msg["oid"])
         if rec is None:
             reply(found=False, free_now=False)
@@ -2690,6 +2914,19 @@ class Head:
             self._free_shm_name(name, nid)
         rec.copies.clear()
         pinned = any(h.endswith("#v") for h in rec.holders)
+        if not pinned:
+            # the holder truth is owner-resident: a reader's #v pin on this
+            # object lives in the OWNER's ledger (owner_pin), not here —
+            # consult the last synced digest before freeing a slice a view
+            # may be mapping.  The residual window is one owner_sync period
+            # (plus the owner's own pins, which the digest excludes by
+            # design); deferral via pending_free is the safe direction —
+            # worst case the slice is reclaimed at object settle instead.
+            info = self.owner_digests.get(rec.owner, {}).get(rec.oid)
+            if info is not None:
+                pinned = any(
+                    h.endswith("#v") for h in info.get("b") or ()
+                )
         if old is None:
             reply(found=True, free_now=False)
         elif pinned:
@@ -2709,7 +2946,17 @@ class Head:
         if rec is None:
             reply(found=False)
             return
-        rec.holders.add(msg["as_id"])
+        if not self._forward_to_owner(
+            rec.owner,
+            {"m": "owner_refs", "inc": [msg["oid"]], "as_id": msg["as_id"]},
+        ):
+            rec.holders.add(msg["as_id"])
+        # else: pin fallback for an owner-resident object (owner_pin dial
+        # failed) — the pin must land in the owner's ledger or its
+        # spill_transition would free the slice under the reader.  The
+        # location replied below is the registry's view; the owner's notify
+        # keeps it current, so the residual race window is one in-flight
+        # obj_spilled, same as the pre-plane path.
         reply(**self._locate_fields(rec, state.get("node_id", LOCAL_NODE)))
 
     async def _h_pull_chunk(self, state, msg, reply, reply_err):
@@ -2739,13 +2986,38 @@ class Head:
             for oid in inc:
                 rec = self.objects.get(oid)
                 if rec is not None:
+                    if cid != rec.owner and self._forward_to_owner(
+                        rec.owner,
+                        {
+                            "m": "owner_refs", "inc": [oid], "as_id": cid,
+                            "ttl": bool(msg.get("ttl")),
+                        },
+                    ):
+                        # a borrower's registration that fell back here while
+                        # the owner (the lifetime authority) is alive: land
+                        # it in the owner's ledger, not as head-side residue
+                        # an owner settle would silently clobber
+                        continue
                     rec.holders.add(cid)
                 else:
                     # inc may race ahead of obj_created (different sockets)
-                    self._early_refs.setdefault(oid, set()).add(cid)
+                    self._early_ref_add(oid, cid)
         for oid in msg.get("dec", []):
             rec = self.objects.get(oid)
             if rec is not None:
+                if (
+                    cid not in rec.holders
+                    and cid != rec.owner
+                    and self._forward_to_owner(
+                        rec.owner,
+                        {"m": "owner_refs", "dec": [oid], "as_id": cid},
+                    )
+                ):
+                    # release fallback for a hold that lives in the (alive)
+                    # owner's ledger — e.g. the direct dial failed once at
+                    # release time; without the forward the hold would pin
+                    # the object until the borrower process dies
+                    continue
                 rec.holders.discard(cid)
                 if cid == rec.owner:
                     rec.owner_released = True
@@ -2764,6 +3036,7 @@ class Head:
                     early.discard(cid)
                     if not early:
                         del self._early_refs[oid]
+                        self._early_ref_ts.pop(oid, None)
 
     # placement groups ------------------------------------------------------
     @staticmethod
@@ -3126,17 +3399,30 @@ class Head:
         limit = msg.get("limit") or 10_000
         reply(events=events[-limit:])
 
+    def digest_holders(self, rec) -> tuple:
+        """(num_holders, from_ledger) for display surfaces: the holder truth
+        is owner-resident, so when the owner has synced a digest surface it
+        (borrower set + implied owner hold unless released) — head-side
+        holders are empty by design in steady state.  Shared by
+        _h_list_objects and the dashboard's /api/objects."""
+        info = self.owner_digests.get(rec.owner, {}).get(rec.oid)
+        if info is None:
+            return len(rec.holders), False
+        return len(info.get("b") or ()) + (0 if info.get("r") else 1), True
+
     async def _h_list_objects(self, state, msg, reply, reply_err):
         limit = msg.get("limit") or 10_000
         out = []
         for rec in list(self.objects.values())[:limit]:
+            holders, ledger = self.digest_holders(rec)
             out.append(
                 {
                     "object_id": rec.oid.hex(),
                     "size": rec.size,
                     "owner": rec.owner,
                     "in_shm": rec.shm_name is not None,
-                    "num_holders": len(rec.holders),
+                    "num_holders": holders,
+                    "owner_ledger": ledger,
                     "node_id": rec.node_id,
                 }
             )
@@ -3261,17 +3547,55 @@ class Head:
         self.subscribers.pop(f"shm_free:{cid}", None)
         pin_id = f"{cid}#v"
         transit_prefix = f"t:{cid}:"
+        # cnt:<cid>: containment edges die with the client too — its
+        # containers can never release them (OwnerLedger.purge_holder does
+        # the same for owner-resident records; adopted records live here)
+        cnt_prefix = f"cnt:{cid}:"
         for rec in list(self.objects.values()):
             stale = [
                 h
                 for h in rec.holders
-                if h == cid or h == pin_id or h.startswith(transit_prefix)
+                if h == cid
+                or h == pin_id
+                or h.startswith(transit_prefix)
+                or h.startswith(cnt_prefix)
             ]
             if stale:
                 rec.holders.difference_update(stale)
                 self._obj_maybe_gc(rec)
         for tok in [t for t in self._transit_pins if t.startswith(transit_prefix)]:
             del self._transit_pins[tok]
+        # ownership plane: every OTHER owner's ledger must purge this
+        # client's holder ids/pins/tokens/containment edges too — they can
+        # never dec (broadcast, like the drain pub: no subscription
+        # round-trip may gate lifetime correctness)
+        gone_frame = {"m": "pub", "ch": "client_gone", "data": {"client_id": cid}}
+        for st in list(self._clients.values()):
+            try:
+                write_frame(st["writer"], gone_frame)
+            except Exception:
+                pass
+        # ... and this OWNER's orphaned objects are adopted from its last
+        # owner_sync digest: the borrowers recorded there drain through the
+        # central path; the owner itself is dead, so its release is implied
+        digest = self.owner_digests.pop(cid, None)
+        if digest:
+            adopted = 0
+            for oid, info in digest.items():
+                rec = self.objects.get(oid)
+                if rec is None:
+                    continue
+                rec.holders |= set(info.get("b") or ())
+                rec.owner_released = True
+                adopted += 1
+                self._obj_maybe_gc(rec)
+            if adopted:
+                self.stats["owners_adopted"] = (
+                    self.stats.get("owners_adopted", 0) + 1
+                )
+                self._log_event(
+                    "owner_ledger_adopted", client_id=cid, objects=adopted
+                )
         self._departed_clients[cid] = None
         while len(self._departed_clients) > 10_000:
             self._departed_clients.popitem(last=False)
@@ -3349,6 +3673,23 @@ class Head:
                         early = self._early_refs.get(oid)
                         if early is not None:
                             early.discard(tok)
+            if self._early_refs:
+                # explicit, bounded grace for refs that arrived before their
+                # obj_created: entries older than the window can only belong
+                # to producers that died before registering — sweep them so
+                # they can't pin future records or grow without bound
+                cutoff = now - getattr(self.config, "early_ref_grace_s", 600.0)
+                expired = [
+                    o for o, ts in self._early_ref_ts.items() if ts < cutoff
+                ]
+                for o in expired:
+                    self._early_ref_ts.pop(o, None)
+                    self._early_refs.pop(o, None)
+                if expired:
+                    self.stats["early_refs_expired"] = (
+                        self.stats.get("early_refs_expired", 0) + len(expired)
+                    )
+                    self._log_event("early_refs_expired", count=len(expired))
             if (
                 self.mem_monitor is not None
                 and now - self._last_mem_check

@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional
 
 from .config import CAConfig, set_config
 from .head import read_shm_chunk
+from .ownership import DeltaReporter, quantize_load
 from .protocol import Server, connect_addr, spawn_bg
 
 
@@ -231,6 +232,12 @@ class NodeAgent:
         self._pull_maps: Dict[str, Any] = {}
         self._shutdown = asyncio.Event()
         self._draining = False  # SIGTERM self-drain already requested
+        # delta-synced node state (ray_syncer role, head-ward): components
+        # re-send only when their payload changes; an idle node's tick
+        # degenerates to a bare node_sync keepalive.  reset() on every
+        # (re)registration forces a full resync to the (new) head.
+        self.reporter = DeltaReporter()
+        self._mp_tick = 0  # re-send the pressure component while pressured
 
     # --------------------------------------------------------------- workers
     def _spawn_worker(self, wid: str, purpose: str, pool: str) -> None:
@@ -388,14 +395,17 @@ class NodeAgent:
         while not self._shutdown.is_set():
             await asyncio.sleep(min(period, 1.0))
             try:
-                hb = {"node_id": self.node_id, "load": node_load_sample()}
-                if self.mem_monitor is not None:
-                    hb["mem_pressured"] = self.mem_monitor.is_pressured()
-                # delegated/used block occupancy rides the heartbeat (the
-                # same dissemination path as load): the head's `ca status`,
-                # /api/nodes, and revocation sizing read it
-                hb["lease_stats"] = self.granter.stats()
-                self.head.notify("node_heartbeat", **hb)
+                if getattr(self.config, "delta_sync", True):
+                    self._send_node_sync()
+                else:
+                    hb = {"node_id": self.node_id, "load": node_load_sample()}
+                    if self.mem_monitor is not None:
+                        hb["mem_pressured"] = self.mem_monitor.is_pressured()
+                    # delegated/used block occupancy rides the heartbeat (the
+                    # same dissemination path as load): the head's `ca
+                    # status`, /api/nodes, and revocation sizing read it
+                    hb["lease_stats"] = self.granter.stats()
+                    self.head.notify("node_heartbeat", **hb)
             except Exception:
                 pass
             # reap exited worker processes and report them (the head cannot
@@ -412,6 +422,32 @@ class NodeAgent:
                         self.head.notify("worker_exit", wid=wid)
                     except Exception:
                         pass
+
+    def _send_node_sync(self):
+        """Versioned delta heartbeat (node_sync): only components whose
+        payload changed since the last send travel; an unchanged tick is a
+        bare {node_id} keepalive (liveness only).  Load telemetry is
+        quantized first — raw loadavg jitter would re-send the component
+        every tick and make delta sync a full heartbeat with extra steps.
+        The mem-pressure component re-sends every tick WHILE pressured: the
+        head clears its flag after acting on it (kill one worker per refresh
+        period), so a level-triggered single send would stop the policy
+        after the first kill."""
+        comps: Dict[str, Any] = {
+            "load": quantize_load(node_load_sample()),
+            "lease_stats": self.granter.stats(),
+        }
+        if self.mem_monitor is not None:
+            if self.mem_monitor.is_pressured():
+                self._mp_tick += 1
+                comps["mem_pressured"] = [True, self._mp_tick]
+            else:
+                comps["mem_pressured"] = False
+        d = self.reporter.delta(comps)
+        if d is None:
+            self.head.notify("node_sync", node_id=self.node_id)
+        else:
+            self.head.notify("node_sync", node_id=self.node_id, **d)
 
     async def _log_ship_loop(self):
         """Tail this node's structured capture files and batch new records
@@ -543,6 +579,9 @@ class NodeAgent:
                 )
                 self.head = conn
                 down_since = None
+                # the restarted head has no delta state for this node: the
+                # next node_sync must be a full resync
+                self.reporter.reset()
             except Exception:
                 await asyncio.sleep(0.5)
 

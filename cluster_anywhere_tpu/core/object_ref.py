@@ -25,9 +25,13 @@ class ObjectRef:
 
     def __init__(self, object_id: ObjectID, owner: Optional[str] = None, worker=None):
         self.id = object_id
-        self.owner = owner  # worker/actor address owning the primary copy
+        self.owner = owner  # client id of the owning process
         self._worker = worker
         if worker is not None:
+            if owner is not None:
+                # ownership plane: remember who settles this ref's counts so
+                # inc/dec route to the owner's ledger, not the head
+                worker.note_borrowed_owner(self.id.binary(), owner)
             if worker.reference_counter.add_local_ref(self.id) == 1:
                 # a handle came back for an object whose local refs all died
                 # (e.g. returned from an actor): its producing task's lineage

@@ -1,12 +1,17 @@
 """Local reference counting with batched release notifications.
 
-Round-1 scope of the reference's distributed ReferenceCounter
-(src/ray/core_worker/reference_count.h): per-process local refcounts for every
-ObjectRef handle; when the local count for an object hits zero the release is
-batched and flushed to the head, which maintains the cluster-wide count and
-unlinks shared-memory segments at zero.  The full borrowing ledger
-(AddBorrowedObject / WaitForRefRemoved worker<->worker pubsub) is scheduled
-for the multi-node milestone.
+Per-process HALF of the reference's distributed ReferenceCounter
+(src/ray/core_worker/reference_count.h): local refcounts for every ObjectRef
+handle, with zero-crossings batched into inc/dec updates.  Where those
+updates SETTLE is the ownership plane's concern (core/ownership.py +
+worker.py routing): for objects this process owns they land directly in its
+OwnerLedger; for borrowed objects they flow to the owner process's ledger
+over a direct connection (the AddBorrowedObject / WaitForRefRemoved
+worker<->worker protocol, owner-resident form); the head is only the
+fallback when an owner is unknown, unreachable, or dead — and the failover
+arbiter that adopts a dead owner's ledger from its last synced digest.
+(The round-1 note that deferred the borrowing ledger "for the multi-node
+milestone" is settled: this IS that milestone.)
 """
 
 from __future__ import annotations
@@ -70,8 +75,16 @@ class ReferenceCounter:
         if zero and self._on_zero is not None:
             try:
                 self._on_zero(oid)
-            except Exception:
-                pass
+            except Exception as e:
+                # a failing eviction callback is a GC bug (leaked pins /
+                # unevictable cache entries) — surface it, rate-limited,
+                # instead of silently swallowing it
+                from .ownership import warn_ratelimited
+
+                warn_ratelimited(
+                    "refcount-on-zero",
+                    f"on-zero eviction callback failed for {oid}: {e!r}",
+                )
         if flush and self._flush_cb:
             self._flush_cb(*flush)
 

@@ -46,6 +46,7 @@ from .protocol import (
     connect_addr,
     spawn_bg,
 )
+from .ownership import OWNER_STATS, OwnerLedger
 from .reference_counter import ReferenceCounter
 
 _global_worker: Optional["Worker"] = None
@@ -646,6 +647,40 @@ class Worker:
             self.shm_store.warm()
         self.fn_manager = FunctionManager()
         self.reference_counter = ReferenceCounter(self._flush_refs)
+        # --- ownership plane (core/ownership.py) --------------------------
+        # This process is the lifetime authority for the objects it creates:
+        # its OwnerLedger holds their cluster-wide borrower sets, and other
+        # processes settle inc/dec against it over direct connections.  The
+        # head keeps only the registry (obj_created/obj_release) and adopts
+        # orphaned ledgers on owner death (owner_sync digests).  Client-mode
+        # drivers have no ledger — their puts are hosted (and their holders
+        # kept) by the head — but still ROUTE updates for borrowed refs to
+        # the owning worker over TCP.
+        self._owner_plane = bool(getattr(self.config, "owner_plane", True))
+        self.owner_ledger: Optional[OwnerLedger] = None
+        if self._owner_plane and not client_mode:
+            self.owner_ledger = OwnerLedger(
+                self.client_id,
+                on_clear=self._ledger_clear,
+                on_pin_zero=self._ledger_pin_zero,
+                pending_grace_s=getattr(self.config, "early_ref_grace_s", 600.0),
+            )
+        # borrowed oid -> owner client id (fed by ObjectRef rehydration);
+        # routes that ref's inc/dec/pins to the owner's ledger.  NEVER
+        # dropped eagerly — a value pin's release can fire from GC long
+        # after the handle died, and misrouting it to the head would strand
+        # the holder in the owner's ledger.  Pruned periodically instead
+        # (housekeeping), skipping oids with live handles or queued updates;
+        # pin callbacks re-seed their captured owner when they fire late.
+        self._borrowed_owners: Dict[bytes, str] = {}
+        # obj_release notifies that found the head down: re-sent by
+        # housekeeping once the head is back (lifetime already settled —
+        # only the registry record and remote copies remain to clean)
+        self._deferred_releases: List[list] = []
+        self._last_owner_sync = 0.0
+        self._last_ledger_sweep = 0.0
+        self._last_borrow_prune = 0.0
+        self._owner_sync_full = True  # first sync after (re)connect is full
         # evict the cache when the last local ref drops: cached values hold
         # zero-copy views, which hold arena value-pins — without eviction,
         # pinned slices would never be reusable.  Owned INLINE values (no shm
@@ -861,6 +896,24 @@ class Worker:
         if msg.get("m") == "log_batch":
             self._on_log_batch(msg)
             return
+        if msg.get("m") == "owner_refs":
+            # the head settling against THIS owner's ledger: releasing a
+            # settled ledgerless (client-mode) container's containment edges
+            # (head._release_cnt_pairs), or relaying a borrower's inc/dec/pin
+            # that fell back to it while we were transiently unreachable
+            # (head._forward_to_owner)
+            self.serve_owner_refs(
+                msg.get("inc"), msg.get("dec"),
+                msg.get("as_id") or "head", bool(msg.get("ttl")),
+            )
+            return
+        if msg.get("m") == "owner_transit_done":
+            # relayed receiver ack for a transit pin held in this ledger
+            self.serve_owner_transit_done(
+                msg["token"], msg.get("oids"), msg.get("cid", "?"),
+                msg.get("register", True),
+            )
+            return
         if msg.get("m") != "pub":
             return
         ch = msg.get("ch")
@@ -878,6 +931,18 @@ class Worker:
                 self.shm_store.free_local(name)
         elif ch == "drain":
             self._on_drain_pub(msg.get("data") or {})
+        elif ch == "client_gone":
+            # a borrower process died: its holder ids, value pins, transit
+            # tokens, and containment edges in this owner's ledger can never
+            # dec — purge them (the head does the same for its own records)
+            gone = (msg.get("data") or {}).get("client_id")
+            if gone:
+                if self.owner_ledger is not None:
+                    self.owner_ledger.purge_holder(gone)
+                # in-flight owner routing to it should fail over to the head
+                self._owner_addr_cache[gone] = (
+                    None, time.monotonic() + self._OWNER_ADDR_NEG_TTL
+                )
         elif ch == "lease_reclaim":
             # another client's lease request is queued: return surplus idle
             # leases NOW instead of after the idle timeout, and shed down to
@@ -972,6 +1037,29 @@ class Worker:
                     n: t for n, t in self._draining_nodes.items() if t > now
                 }
             self.reference_counter.flush()
+            if self.owner_ledger is not None:
+                self._owner_plane_tick(now)
+            if (
+                self._owner_plane
+                and len(self._borrowed_owners) > 4096
+                and now - self._last_borrow_prune > 10.0
+            ):
+                # bound the borrowed-owner map: drop routing entries for
+                # oids with no live handle, no cached entry, and no queued
+                # update (late pin releases re-seed their captured owner)
+                self._last_borrow_prune = now
+                queued: set = set()
+                for ent in self._ref_pending.values():
+                    queued |= ent["inc"]
+                    queued |= ent["dec"]
+                for oid_b in list(self._borrowed_owners):
+                    o = ObjectID(oid_b)
+                    if (
+                        oid_b not in queued
+                        and self.reference_counter.local_count(o) == 0
+                        and self.memory_store.get_entry(o) is None
+                    ):
+                        del self._borrowed_owners[oid_b]
             self._flush_task_events()
 
     _TASK_EVENTS_CHUNK = 5000  # bounded notify frames after a long restage
@@ -1030,6 +1118,8 @@ class Worker:
         self.head = conn
         # the restarted head lost its subscriber table: re-join the stream
         self._maybe_log_sub(conn)
+        # ... and this owner's ledger digest: next owner_sync is a full one
+        self._owner_sync_full = True
         return True
 
     # ----------------------------------------------------------- lease plane
@@ -1188,25 +1278,95 @@ class Worker:
             self.loop.call_later(self._REFS_FLUSH_DELAY_S, self._flush_ref_pending)
 
     def _flush_ref_pending(self):
-        """Send the coalesced obj_refs updates, riding whatever batch
-        envelope the cork assembles this tick.
+        """Settle the coalesced obj_refs updates with each object's lifetime
+        AUTHORITY (ownership plane): oids this process owns apply directly
+        to its own OwnerLedger (no IO at all); borrowed oids ride a direct
+        `owner_refs` notify to the owner process's ledger; only oids with no
+        known live owner — plane off, owner unknown, owner unreachable/dead
+        — fall back to the head's centralized obj_refs path, which is also
+        the failover authority after the head adopts a dead owner's ledger.
 
-        Two phases — every inc of the window ships before any dec — because
-        holder keys are flushed independently and a dec that reaches the
-        head before a DIFFERENT key's inc for the same object could GC it
-        under a live pin (dec fires _obj_maybe_gc; the late inc would strand
-        in _early_refs).  Promoting an inc is always safe: at worst the
-        object lives until its paired dec in a later message of the same
-        flush, which the head processes in socket order."""
+        Two phases per destination — every inc of the window ships before
+        any dec — because holder keys are flushed independently and a dec
+        that reaches an authority before a DIFFERENT key's inc for the same
+        object could GC it under a live pin (the late inc would strand in
+        the pending-refs grace buffer).  Promoting an inc is always safe: at
+        worst the object lives until its paired dec in a later message of
+        the same flush, processed in socket order.  Destinations need no
+        cross-ordering: one object has exactly one authority."""
         self._ref_flush_scheduled = False
         if not self._ref_pending:
             return
         pending, self._ref_pending = self._ref_pending, {}
+        if not self._owner_plane:
+            self._send_head_refs(list(pending.items()))
+            return
+        # partition each (as_id, ttl) window's oids by authority
+        local: List[tuple] = []   # (as_id, ttl, inc, dec) for my own ledger
+        remote: Dict[str, List[tuple]] = {}  # owner cid -> windows
+        central: List[tuple] = []  # head fallback
+        for (as_id, ttl), ent in pending.items():
+            buckets: Dict[Optional[str], List[List[bytes]]] = {}
+            for oid in ent["inc"]:
+                buckets.setdefault(self._ref_dest(oid), [[], []])[0].append(oid)
+            for oid in ent["dec"]:
+                buckets.setdefault(self._ref_dest(oid), [[], []])[1].append(oid)
+            for dest, (inc, dec) in buckets.items():
+                win = (as_id, ttl, inc, dec)
+                if dest == "":
+                    local.append(win)
+                elif dest is None:
+                    central.append(win)
+                else:
+                    remote.setdefault(dest, []).append(win)
+        led = self.owner_ledger
+        if local:
+            OWNER_STATS["refs_settled_local"] += len(local)
+            # same two-phase discipline as the wire paths: every window's
+            # inc applies before any window's dec, so a cross-key pair for
+            # one object can never GC it under a live pin
+            for as_id, ttl, inc, _dec in local:
+                if inc:
+                    led.apply(inc, [], as_id if as_id is not None else self.client_id, ttl)
+            for as_id, _ttl, _inc, dec in local:
+                if dec:
+                    led.apply([], dec, as_id if as_id is not None else self.client_id)
+        for owner, wins in remote.items():
+            self._send_owner_refs(owner, wins)
+        if central:
+            OWNER_STATS["refs_head_fallback"] += len(central)
+            self._send_head_refs([((a, t), {"inc": i, "dec": d})
+                                  for a, t, i, d in central])
+
+    # ------------------------------------------------------ ownership plane
+    def _ref_dest(self, oid: bytes) -> Optional[str]:
+        """Which authority settles this oid's holder updates: "" = this
+        process's own ledger, a client id = that owner's ledger, None = the
+        head (plane off / owner unknown / resurrection after settle)."""
+        led = self.owner_ledger
+        if led is not None and led.tracks(oid):
+            return ""
+        owner = self._borrowed_owners.get(oid)
+        if owner is not None:
+            return owner
+        if led is not None and self.reference_counter.is_owned(ObjectID(oid)):
+            return ""
+        return None
+
+    def note_borrowed_owner(self, oid_b: bytes, owner: str) -> None:
+        """An ObjectRef handle for another process's object materialized
+        here: remember who settles its counts (ObjectRef.__init__)."""
+        if self._owner_plane and owner != self.client_id:
+            self._borrowed_owners[oid_b] = owner
+
+    def _send_head_refs(self, items) -> None:
+        """The classic centralized path: obj_refs notifies to the head, all
+        incs of the flush window before any dec (IO loop only)."""
         head = self.head
         if head is None or head.closed:
-            return  # head down: same drop-on-floor as the old notify path
+            return  # head down: same drop-on-floor as the pre-plane path
         for phase in ("inc", "dec"):
-            for (as_id, ttl), ent in pending.items():
+            for (as_id, ttl), ent in items:
                 oids = list(ent[phase])
                 if not oids:
                     continue
@@ -1219,6 +1379,295 @@ class Worker:
                     head.notify("obj_refs", **fields)
                 except Exception:
                     pass
+
+    def _send_owner_refs(self, owner: str, wins: List[tuple]) -> None:
+        """Ship one flush window's updates to a borrowed object's owner over
+        the direct worker<->worker connection (AddBorrowedObject /
+        WaitForRefRemoved, owner-resident form).  A cached open connection
+        sends synchronously; otherwise a background dial sends (or fails
+        over to the head — the arbiter for unreachable/dead owners)."""
+        hit = self._cached_owner_addr(owner)
+        if hit is not None and hit[0] is not None:
+            conn = self._conns.get(self._normalize_peer_addr(hit[0]))
+            if conn is not None and not conn.closed:
+                try:
+                    self._notify_owner_refs(conn, wins)
+                    return
+                except Exception:
+                    pass
+        t = spawn_bg(self._send_owner_refs_async(owner, wins))
+        t.add_done_callback(self._report_task_exc)
+
+    def _notify_owner_refs(self, conn: Connection, wins: List[tuple]) -> None:
+        OWNER_STATS["refs_sent_owner"] += 1
+        for phase in (0, 1):  # inc windows before dec windows
+            for as_id, ttl, inc, dec in wins:
+                oids = inc if phase == 0 else dec
+                if not oids:
+                    continue
+                fields: Dict[str, Any] = {
+                    ("inc" if phase == 0 else "dec"): oids,
+                    "as_id": as_id if as_id is not None else self.client_id,
+                }
+                if ttl and phase == 0:
+                    fields["ttl"] = True
+                conn.notify("owner_refs", **fields)
+
+    async def _send_owner_refs_async(self, owner: str, wins: List[tuple]) -> None:
+        try:
+            addr = await self._owner_addr_async(owner)
+            if addr is None:
+                raise ConnectionError(f"owner {owner} not dialable")
+            conn = await self.conn_to(addr)
+            self._notify_owner_refs(conn, wins)
+        except Exception:
+            # owner unreachable or dead: the head is the failover authority
+            # (it adopts the owner's ledger from the last synced digest)
+            OWNER_STATS["refs_head_fallback"] += len(wins)
+            self._send_head_refs([((a, t), {"inc": i, "dec": d})
+                                  for a, t, i, d in wins])
+
+    def serve_owner_refs(self, inc, dec, as_id, ttl: bool = False) -> None:
+        """A borrower's inc/dec landing on this process's ledger (the
+        owner-resident settle path; workerproc/_p2p server `owner_refs`)."""
+        led = self.owner_ledger
+        if led is None:
+            return  # plane raced off (shutdown): the disconnect sweep settles
+        OWNER_STATS["refs_recv"] += 1
+        led.apply(list(inc or ()), list(dec or ()), as_id, bool(ttl))
+
+    def serve_owner_transit_done(self, token, roids, cid, register=True) -> None:
+        led = self.owner_ledger
+        if led is not None:
+            led.transit_done(token, list(roids or ()), cid, bool(register))
+
+    def serve_owner_pin(self, oid_b: bytes, as_id: str) -> dict:
+        """Atomic pin+locate served by the owner (obj_pin, owner-resident):
+        the pin registers in the ledger under the same lock that reads the
+        location, so a reader can never map a slice the owner's spiller is
+        about to recycle."""
+        led = self.owner_ledger
+        loc = led.pin(oid_b, as_id) if led is not None else None
+        if loc is None:
+            return {"found": False}
+        return {"found": True, "node": self.node_id, "owner": self.client_id, **loc}
+
+    def _is_my_slice(self, shm_name: str) -> bool:
+        """Can this process reclaim these bytes itself?  Its own arena
+        slices (only the creating allocator may recycle a slice) and its
+        node's dedicated segments qualify; everything else needs the head's
+        reclaim routing (shm_free pubs / agent unlinks)."""
+        if "@" in shm_name:
+            fname = shm_name.split("@", 1)[0].rsplit("/", 1)[-1]
+            return fname.startswith(f"arena_{self.client_id}_")
+        return self.shm_store.is_local(shm_name)
+
+    def _ledger_clear(self, cleared: List[tuple]) -> None:
+        """An owned object's cluster-wide lifetime settled (owner released +
+        last borrower gone): free what this process can locally, release
+        containment edges on nested refs, and tell the head to drop the
+        registry record and reclaim the remote copies.  With the head down
+        the LOCAL reclaim still completes (the acceptance property: GC does
+        not need the control plane); the registry release is deferred."""
+        release: List[list] = []
+        for oid, info in cleared:
+            OWNER_STATS["owner_gc"] += 1
+            freed: List[str] = []
+            for name in (info.get("shm_name"), info.get("pending_free")):
+                if name and self._is_my_slice(name):
+                    try:
+                        self.shm_store.free_local(name)
+                    except Exception:
+                        pass
+                    self._spilled_pinned.discard(name)
+                    freed.append(name)
+            spill = info.get("spill_path")
+            if spill and os.path.exists(spill):
+                try:
+                    os.unlink(spill)
+                    freed.append("spill:" + spill)
+                except OSError:
+                    pass
+            for ioid, iowner in info.get("contains") or ():
+                # the container dies: its borrow-pins on nested objects die
+                # with it, routed to each inner object's own authority
+                if iowner and iowner != self.client_id:
+                    self._borrowed_owners.setdefault(ioid, iowner)
+                self._queue_refs(
+                    [], [ioid], as_id=f"cnt:{self.client_id}:{oid.hex()}"
+                )
+            if info.get("registered"):
+                release.append([oid, freed])
+        if not release:
+            return
+        head = self.head
+        if head is not None and not head.closed:
+            try:
+                head.notify("obj_release", rel=release)
+                return
+            except Exception:
+                pass
+        OWNER_STATS["owner_gc_head_down"] += len(release)
+        self._deferred_releases.extend(release)
+
+    def _ledger_pin_zero(self, oid: bytes) -> None:
+        """Last zero-copy value pin dropped on an object this owner spilled:
+        the old slice's memory comes back now (owner-side pending_free)."""
+        led = self.owner_ledger
+        name = led.pop_pending_free(oid) if led is not None else None
+        if name and self._is_my_slice(name):
+            try:
+                self.shm_store.free_local(name)
+            except Exception:
+                pass
+            self._spilled_pinned.discard(name)
+
+    def _add_owned(self, oid: ObjectID) -> None:
+        """Mint ownership: local refcount authority + a ledger entry, BEFORE
+        any handle can leave the process (borrower registrations race only
+        reconstruction re-registration, absorbed by the pending buffer)."""
+        self.reference_counter.add_owned(oid)
+        if self.owner_ledger is not None:
+            self.owner_ledger.register(oid.binary())
+
+    def _register_contains(self, container_b: bytes, nested: List[bytes]) -> None:
+        """Containment edges for a container THIS process owns: each nested
+        ref gains a "cnt:<my-cid>:<container>" holder at its own authority,
+        and the ledger remembers the edge list so settling the container
+        releases them (head-resident obj_contains when the plane is off)."""
+        led = self.owner_ledger
+        if not self._owner_plane:
+            self._notify_threadsafe(
+                "obj_contains", oid=container_b, refs=list(nested)
+            )
+            return
+        if led is None or not led.tracks(container_b):
+            # ledgerless owner (client mode): the HEAD is this container's
+            # lifetime authority.  The edges still register at each inner
+            # object's OWN authority (head-side holders would not protect
+            # owner-resident inners), and the head remembers the (oid,
+            # authority) pairs so it can release them when the container
+            # settles there.  Pair authority mirrors where the inc actually
+            # routes ("" = the head itself).
+            pairs = []
+            for ioid in nested:
+                d = self._ref_dest(ioid)
+                pairs.append([ioid, self.client_id if d == "" else (d or "")])
+            self._queue_refs(
+                list(nested), [],
+                as_id=f"cnt:{self.client_id}:{container_b.hex()}",
+            )
+            self._notify_threadsafe(
+                "obj_contains", oid=container_b, refs=list(nested),
+                pairs=pairs,
+            )
+            return
+        pairs = [
+            (ioid, self._borrowed_owners.get(ioid) or self.client_id)
+            for ioid in nested
+        ]
+        old = led.set_contains(container_b, pairs)
+        edge = f"cnt:{self.client_id}:{container_b.hex()}"
+        self._queue_refs(list(nested), [], as_id=edge)
+        for ioid, iowner in old or ():
+            if iowner and iowner != self.client_id:
+                self._borrowed_owners.setdefault(ioid, iowner)
+            self._queue_refs([], [ioid], as_id=edge)
+
+    def result_contains_pairs(
+        self, container_b: bytes, nested: List[bytes], owner: str
+    ) -> Optional[list]:
+        """Worker-side half of owner-resident containment for a task RETURN
+        (the container's owner is the submitter): register the edges at each
+        nested ref's authority under the SUBMITTER's edge id and hand back
+        the (oid, owner) pairs to ship with the result, so the submitter's
+        ledger can release them when the container settles.  Returns None on
+        the centralized path (caller falls back to obj_contains)."""
+        if not self._owner_plane:
+            return None
+        pairs = [
+            [ioid, self._borrowed_owners.get(ioid) or self.client_id]
+            for ioid in nested
+        ]
+        self._queue_refs(
+            list(nested), [], as_id=f"cnt:{owner}:{container_b.hex()}"
+        )
+        return pairs
+
+    def _adopt_result_contains(self, oid_b: bytes, res: dict) -> None:
+        """Owner-side half: a task result carried containment pairs for a
+        container this process owns.  Record them — or, if the container's
+        lifetime already settled (fire-and-forget), release the edges right
+        away so the nested objects don't leak a dead container's pins.  A
+        LEDGERLESS owner (client mode) cannot do either itself: it forwards
+        the pairs to the head — its containers' lifetime authority — which
+        releases the owner-resident edges when the record settles there."""
+        pairs = [
+            (bytes(i), (o if isinstance(o, str) else None))
+            for i, o in (res.get("contains") or ())
+        ]
+        if not pairs:
+            return
+        led = self.owner_ledger
+        if led is None:
+            self._notify_threadsafe(
+                "obj_contains", oid=oid_b,
+                refs=[i for i, _ in pairs],
+                pairs=[[i, o or ""] for i, o in pairs],
+            )
+            return
+        old = led.set_contains(oid_b, pairs)
+        edge = f"cnt:{self.client_id}:{oid_b.hex()}"
+        stale = pairs if old is None else old
+        for ioid, iowner in stale:
+            if iowner and iowner != self.client_id:
+                self._borrowed_owners.setdefault(ioid, iowner)
+            self._queue_refs([], [ioid], as_id=edge)
+
+    def _owner_plane_tick(self, now: float) -> None:
+        """Housekeeping leg of the ownership plane (IO loop): ledger sweeps
+        (expired pending adds / lost transit acks), deferred registry
+        releases, and the owner_sync digest — versioned deltas of this
+        ledger so the head can adopt it if this process dies.  A reconnect
+        resets to a full sync (the restarted head lost the digest)."""
+        led = self.owner_ledger
+        if now - self._last_ledger_sweep > 5.0:
+            self._last_ledger_sweep = now
+            expired = led.sweep(now)
+            if expired:
+                # grace-expired borrower registrations are the owner-side
+                # symptom of the same ordering bug the head counts as
+                # early_refs_expired — surface them the same way
+                OWNER_STATS["pending_expired"] += expired
+                warn_ratelimited(
+                    "ledger-pending-expired",
+                    f"{expired} pending borrower registration(s) expired "
+                    "past the grace window (lost registration ordering?)",
+                )
+        head = self.head
+        if head is None or head.closed:
+            return
+        if self._deferred_releases:
+            rel, self._deferred_releases = self._deferred_releases, []
+            try:
+                head.notify("obj_release", rel=rel)
+            except Exception:
+                self._deferred_releases = rel + self._deferred_releases
+        if now - self._last_owner_sync < self.config.owner_sync_period_s:
+            return
+        self._last_owner_sync = now
+        full = self._owner_sync_full
+        d = led.digest_delta(full=full)
+        if d is None:
+            return
+        try:
+            head.notify("owner_sync", **d)
+        except Exception:
+            return
+        OWNER_STATS["syncs_sent"] += 1
+        if full:
+            OWNER_STATS["syncs_full"] += 1
+            self._owner_sync_full = False
 
     def _normalize_peer_addr(self, addr: str) -> str:
         """Remote clients may receive TCP duals bound to a wildcard host
@@ -1266,6 +1715,22 @@ class Worker:
             m = msg["m"]
             if m == "owner_locate":
                 reply(**await self.owner_locate_async(msg["oid"]))
+            elif m == "owner_refs":
+                # borrower inc/dec settling against this driver's ledger
+                self.serve_owner_refs(
+                    msg.get("inc"), msg.get("dec"),
+                    msg.get("as_id") or state.get("client_id", "?"),
+                    bool(msg.get("ttl")),
+                )
+                reply()
+            elif m == "owner_transit_done":
+                self.serve_owner_transit_done(
+                    msg["token"], msg.get("oids"), msg.get("cid", "?"),
+                    msg.get("register", True),
+                )
+                reply()
+            elif m == "owner_pin":
+                reply(**self.serve_owner_pin(msg["oid"], msg["as_id"]))
             elif m == "coll_push":
                 self.coll_deliver(
                     msg["group"], msg["key"], msg["src"],
@@ -1298,6 +1763,17 @@ class Worker:
         head (the arbiter for spill relocation and GC)."""
         e = self.memory_store.get_entry(ObjectID(oid_b))
         if e is None:
+            # local read-cache evicted (owner's last handle died) while
+            # borrowers still hold: the ledger remembers the primary copy
+            led = self.owner_ledger
+            info = led.entry_info(oid_b) if led is not None else None
+            if info is not None and info.get("shm_name"):
+                return {
+                    "found": True,
+                    "shm_name": info["shm_name"],
+                    "size": info["size"],
+                    "node": self.node_id,
+                }
             return {"found": False}
         if e.state in ("shm", "value", "packed") and e.shm_name:
             if e.shm_name.startswith("spill:"):
@@ -1468,7 +1944,7 @@ class Worker:
             return  # stream abandoned
         idx = msg["idx"]
         oid = ObjectID.for_return(st.task_id, idx)
-        self.reference_counter.add_owned(oid)
+        self._add_owned(oid)
         self._store_results([oid], [msg["res"]], st.addr or "")
         st.on_item(idx)
 
@@ -1602,7 +2078,7 @@ class Worker:
         and by futures like PlacementGroup.ready())."""
         task_id = self.current_task_id or TaskID.for_normal_task(self.job_id)
         oid = ObjectID.for_put(task_id, self._put_counter.next())
-        self.reference_counter.add_owned(oid)
+        self._add_owned(oid)
         return ObjectRef(oid, owner=self.client_id, worker=self)
 
     def put(self, value: Any) -> ObjectRef:
@@ -1637,10 +2113,15 @@ class Worker:
                 self._notify_threadsafe(
                     "obj_created", oid=oid.binary(), shm_name=shm_name, size=size
                 )
+                if self.owner_ledger is not None:
+                    # the ledger serves owner_pin/owner_locate from this even
+                    # after the local read-cache entry is evicted
+                    self.owner_ledger.set_location(oid.binary(), shm_name, size)
             if nested:
                 # borrowed refs inside the stored value live as long as the
-                # containing object (containment edges at the head)
-                self._notify_threadsafe("obj_contains", oid=oid.binary(), refs=nested)
+                # containing object (containment edges at each inner object's
+                # authority; head-resident when the plane is off)
+                self._register_contains(oid.binary(), nested)
 
     def _client_upload(self, oid: ObjectID, data: bytes, raws: List[Any]) -> Tuple[str, int]:
         """Client-mode put: chunk the packed bytes to the head, which hosts
@@ -1889,7 +2370,10 @@ class Worker:
                             return
                 if reply.get("found"):
                     if reply.get("v") is not None:
-                        # inline payload served straight from the owner
+                        # inline payload served straight from the owner; seed
+                        # ack routing first — an unpack failure must still
+                        # release the pin at the ledger that holds it
+                        self._note_transit_owners(reply)
                         try:
                             value = serialization.unpack(reply["v"])
                         except Exception:
@@ -1972,12 +2456,18 @@ class Worker:
         """Register a value-holder for an arena-backed object and return the
         callback that releases it (runs from GC in any thread).  Pin and
         unpin ride the debounced obj_refs coalescer: a flood of zero-copy
-        reads costs a handful of logical messages, not one per object."""
+        reads costs a handful of logical messages, not one per object.  The
+        unpin captures the owner at pin time — a view can outlive both the
+        handle and the borrowed-owner map entry, and its release must still
+        reach the ledger that holds the pin."""
         pin_id = f"{self.client_id}#v"
         oid_b = oid.binary()
+        owner = self._borrowed_owners.get(oid_b)
         self._queue_refs([oid_b], [], as_id=pin_id)
 
         def _unpin():
+            if owner is not None:
+                self._borrowed_owners.setdefault(oid_b, owner)
             self._queue_refs([], [oid_b], as_id=pin_id)
 
         return _unpin
@@ -2024,11 +2514,49 @@ class Worker:
 
     def _pin_unref_cb(self, oid_b: bytes):
         pin_id = f"{self.client_id}#v"
+        # capture the pin's authority: the unpin may fire from GC after the
+        # borrowed-owner map entry was pruned (see _make_value_pin)
+        owner = self._borrowed_owners.get(oid_b)
 
         def _unpin():
+            if owner is not None:
+                self._borrowed_owners.setdefault(oid_b, owner)
             self._queue_refs([], [oid_b], as_id=pin_id)
 
         return _unpin
+
+    def _owner_pin_blocking(self, oid_b: bytes) -> Optional[dict]:
+        """Confirmed zero-copy pin at the object's OWNER (the head-free read
+        path of the ownership plane): our own ledger when we own it, an
+        owner_pin RPC otherwise.  None = no authoritative answer (owner
+        unknown/unreachable, entry gone) — the caller falls back to the
+        head, which arbitrates for adopted/centralized objects."""
+        if not self._owner_plane:
+            return None
+        pin_id = f"{self.client_id}#v"
+        led = self.owner_ledger
+        if led is not None and led.tracks(oid_b):
+            # led.pin counts pins_served itself (shared with the RPC path)
+            loc = led.pin(oid_b, pin_id)
+            if loc is None:
+                return None
+            return {"found": True, "node": self.node_id, **loc}
+        owner = self._borrowed_owners.get(oid_b)
+        if not owner:
+            return None
+        addr = self._owner_addr(owner)
+        if not addr:
+            return None
+
+        async def _pin():
+            conn = await self.conn_to(addr)
+            return await conn.call("owner_pin", oid=oid_b, as_id=pin_id, timeout=10)
+
+        try:
+            r = self.run_coro(_pin(), timeout=15)
+        except Exception:
+            return None
+        return r if r.get("found") else None
 
     def _read_shm_entry(self, ref: ObjectRef, e: _Entry) -> Any:
         """Materialize a shm-backed entry: confirmed pin + authoritative
@@ -2048,9 +2576,11 @@ class Worker:
                 if "@" in name:
                     pin_cb = self._make_value_pin(ref.id)
             else:
-                loc = self.head_call(
-                    "obj_pin", oid=oid_b, as_id=f"{self.client_id}#v"
-                )
+                loc = self._owner_pin_blocking(oid_b)
+                if loc is None:
+                    loc = self.head_call(
+                        "obj_pin", oid=oid_b, as_id=f"{self.client_id}#v"
+                    )
                 if not loc.get("found"):
                     # obj_created may still be in flight on the producer's
                     # socket while our entry (from the task reply) is already
@@ -2365,11 +2895,16 @@ class Worker:
 
     def _spill_pass(self, target: int):
         """Move the oldest live slices of this process to disk until `target`
-        bytes are freed (LocalObjectManager spill analogue).  The head
-        arbitrates: a slice under zero-copy pins is relocated but its memory
-        reclaim is deferred to the last pin drop.  Serialized: concurrent
-        inline + background passes would re-spill the same slices."""
-        if self.head is None or self.head.closed:
+        bytes are freed (LocalObjectManager spill analogue).  The slice's
+        OWNER arbitrates when it is this process (ownership plane: the
+        free-now-vs-defer decision is one ledger transition, the head just
+        learns `obj_spilled` asynchronously for its snapshot); the head
+        arbitrates for slices backing other owners' objects and on the
+        centralized path.  Either way a slice under zero-copy pins is
+        relocated but its memory reclaim is deferred to the last pin drop.
+        Serialized: concurrent inline + background passes would re-spill the
+        same slices."""
+        if (self.head is None or self.head.closed) and self.owner_ledger is None:
             return
         with self._spill_lock:
             self._spill_pass_locked(target)
@@ -2385,6 +2920,16 @@ class Worker:
                 # already relocated to disk; its memory comes back only when
                 # the last zero-copy pin drops — re-spilling would just
                 # rewrite the same file for nothing
+                continue
+            led = self.owner_ledger
+            if (
+                (self.head is None or self.head.closed)
+                and not (led is not None and led.tracks(oid_b))
+            ):
+                # borrowed slice with no arbiter reachable: it can only stay
+                # in memory — check BEFORE the file write, or a head outage
+                # under pressure rewrites and deletes the same multi-MB
+                # files every pass
                 continue
             try:
                 mv = self.shm_store.open(name)
@@ -2402,10 +2947,52 @@ class Worker:
                     mv.release()
                 except Exception:
                     pass
+            led = self.owner_ledger
+            if led is not None and led.tracks(oid_b):
+                # owner-side decision: one ledger transition, no head RPC on
+                # the allocating path (works with the head down, too)
+                pinned = led.spill_transition(oid_b, path)
+                if pinned is None:
+                    # GC won the race: drop the file, reclaim the slice
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    self.shm_store.free_local(name)
+                    freed += size
+                    continue
+                # the registry learns asynchronously (snapshot/pull routing)
+                self._notify_threadsafe(
+                    "obj_spilled", oid=oid_b, path=path, size=size,
+                    decided=True, freed=not pinned,
+                )
+                if pinned:
+                    # memory comes back on the last value-pin drop
+                    # (_ledger_pin_zero); never a spill candidate again
+                    self._spilled_pinned.add(name)
+                else:
+                    self.shm_store.free_local(name)
+                    freed += size
+                continue
+            if self.head is None or self.head.closed:
+                # borrowed slice, no arbiter reachable: leave it in memory —
+                # but keep scanning: later candidates may be OWNED slices
+                # this process can settle head-free (spill_transition above)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
             try:
                 reply = self.head_call("obj_spilled", oid=oid_b, path=path, size=size)
             except Exception:
-                return
+                # head died mid-pass: same story — owned candidates later in
+                # the scan still settle without it
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
             if not reply.get("found"):
                 # object already GC'd: drop the file, reclaim the slice
                 try:
@@ -2472,9 +3059,11 @@ class Worker:
                 self._notify_threadsafe(
                     "obj_created", oid=oid_b, shm_name=name, size=size, node=self.node_id
                 )
+                if self.owner_ledger is not None and self.owner_ledger.tracks(oid_b):
+                    self.owner_ledger.set_location(oid_b, name, size)
             if sub:
                 self._promote_nested(sub, depth + 1)
-                self._notify_threadsafe("obj_contains", oid=oid_b, refs=list(sub))
+                self._register_contains(oid_b, list(sub))
 
     def transit_pin(self, nested: List[bytes]) -> str:
         """Pin in-transit borrowed refs at the head under a fresh token (the
@@ -2485,26 +3074,89 @@ class Worker:
         self._queue_refs(list(nested), [], as_id=token)
         return token
 
+    def transit_owners(self, nested: List[bytes]) -> List[str]:
+        """Per-roid authority metadata ("rown") shipped alongside a transit
+        envelope: the cid whose ledger the sender's pin lands at ("" = the
+        head).  The receiver seeds its routing from this BEFORE unpacking,
+        so an ack for a payload that never unpacks still reaches the ledger
+        holding the pin instead of tombstoning the token at the head."""
+        if not self._owner_plane:
+            return ["" for _ in nested]
+        out = []
+        for oid in nested:
+            d = self._ref_dest(oid)
+            out.append(self.client_id if d == "" else (d or ""))
+        return out
+
+    def _note_transit_owners(self, env: dict) -> None:
+        """Seed borrowed-owner routing from a transit envelope's rown
+        metadata (see transit_owners) so transit_done — and any later dec —
+        routes to the authority the sender actually pinned at, even when
+        the payload fails to unpack and no ObjectRef ever rehydrates."""
+        owners = env.get("rown")
+        if not owners or not self._owner_plane:
+            return
+        for oid, owner in zip(env.get("roids") or (), owners):
+            if owner and owner != self.client_id:
+                self._borrowed_owners.setdefault(bytes(oid), owner)
+
     def transit_done(self, token: str, roids: List[bytes],
                      register: bool = True) -> None:
         """Receiver-side ack: register this process as holder of the smuggled
         refs and release the sender's transit pin (thread-safe).
         register=False releases the pin without claiming holdership — for
-        payloads the receiver failed to unpack."""
+        payloads the receiver failed to unpack.
+
+        Routed per-oid to each object's lifetime authority (the pin was
+        registered there by the sender's transit_pin): our own ledger, the
+        owner's ledger over a direct connection, or the head fallback."""
         def _send():
-            if self.head is not None and not self.head.closed:
-                try:
-                    self.head.notify(
-                        "transit_done", token=token, oids=roids,
-                        register=register,
+            if not self._owner_plane:
+                self._transit_done_head(token, roids, register)
+                return
+            groups: Dict[Optional[str], List[bytes]] = {}
+            for oid in roids:
+                groups.setdefault(self._ref_dest(oid), []).append(oid)
+            for dest, oids in groups.items():
+                if dest == "":
+                    self.owner_ledger.transit_done(
+                        token, oids, self.client_id, register
                     )
-                except Exception:
-                    pass
+                elif dest is None:
+                    self._transit_done_head(token, oids, register)
+                else:
+                    t = spawn_bg(
+                        self._owner_transit_done_async(dest, token, oids, register)
+                    )
+                    t.add_done_callback(self._report_task_exc)
 
         try:
             self.loop.call_soon_threadsafe(_send)
         except RuntimeError:
             pass
+
+    def _transit_done_head(self, token, oids, register) -> None:
+        if self.head is not None and not self.head.closed:
+            try:
+                self.head.notify(
+                    "transit_done", token=token, oids=oids, register=register
+                )
+            except Exception:
+                pass
+
+    async def _owner_transit_done_async(self, owner, token, oids, register) -> None:
+        try:
+            addr = await self._owner_addr_async(owner)
+            if addr is None:
+                raise ConnectionError(f"owner {owner} not dialable")
+            conn = await self.conn_to(addr)
+            conn.notify(
+                "owner_transit_done", token=token, oids=oids,
+                cid=self.client_id, register=register,
+            )
+        except Exception:
+            # dead owner: the head adopted its ledger — settle there
+            self._transit_done_head(token, oids, register)
 
     async def _pack_with_transit_async(self, value: Any, ttl_pin: bool = False) -> dict:
         """_pack_with_transit usable on the IO loop: client-mode promotion
@@ -2523,7 +3175,10 @@ class Worker:
         await self._promote_nested_async(nested)
         token = f"t:{self.client_id}:{self._put_counter.next()}"
         self._queue_refs(list(nested), [], as_id=token, ttl=bool(ttl_pin))
-        return {"v": blob, "t": token, "roids": nested}
+        return {
+            "v": blob, "t": token, "roids": nested,
+            "rown": self.transit_owners(nested),
+        }
 
     async def _build_arg(self, value: Any) -> dict:
         """Build the wire spec for one task argument."""
@@ -2621,7 +3276,7 @@ class Worker:
         oids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
         for oid in oids:
             self.memory_store.mark_pending(oid)
-            self.reference_counter.add_owned(oid)
+            self._add_owned(oid)
         refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
         fn_id, blob = self.fn_manager.export(fn)
         self._record_lineage(task_id, fn_id, args, kwargs, opts, oids)
@@ -2930,6 +3585,11 @@ class Worker:
                 return
             self._cancelled_tasks.discard(tid)
         for oid, res in zip(oids, results):
+            if "contains" in res:
+                # owner-resident containment: the executing worker registered
+                # the nested refs' edges; this (owner) ledger must remember —
+                # or immediately release — them
+                self._adopt_result_contains(oid.binary(), res)
             if (
                 self.memory_store.get_entry(oid) is None
                 and self.reference_counter.local_count(oid) == 0
@@ -2946,6 +3606,7 @@ class Worker:
                 # roids with no live local ref (holders is a set at the head,
                 # so a dec here would erase a legitimate concurrent hold)
                 if "t" in res:
+                    self._note_transit_owners(res)
                     self.transit_done(res["t"], res["roids"])
                     dec = [
                         r
@@ -2964,7 +3625,10 @@ class Worker:
                     # inline value smuggling ObjectRefs: unpack eagerly so the
                     # rehydrated handles register before we release the
                     # sender's transit pin (lazy unpack would leave the
-                    # nested refs unprotected once the sender drops its own)
+                    # nested refs unprotected once the sender drops its own).
+                    # Seed ack routing first: the except path below never
+                    # rehydrates, and its ack must still reach the pin
+                    self._note_transit_owners(res)
                     try:
                         value = serialization.unpack(res["v"])
                     except Exception:
@@ -2980,6 +3644,12 @@ class Worker:
                     self.memory_store.put_packed(oid, res["v"])
             elif "shm" in res:
                 self.memory_store.put_shm(oid, res["shm"], res.get("size", 0))
+                if self.owner_ledger is not None:
+                    # this submitter owns the return: the ledger serves its
+                    # location to borrowers even after local eviction
+                    self.owner_ledger.set_location(
+                        oid.binary(), res["shm"], res.get("size", 0)
+                    )
             elif "dev" in res:
                 e = _Entry("device", value=res.get("spec"), shm_name=res.get("owner", exec_addr))
                 self.memory_store._store(oid, e)
@@ -3062,7 +3732,7 @@ class Worker:
         oids = [ObjectID.for_return(task_id, i) for i in range(num_returns)]
         for oid in oids:
             self.memory_store.mark_pending(oid)
-            self.reference_counter.add_owned(oid)
+            self._add_owned(oid)
         refs = [ObjectRef(oid, owner=self.client_id, worker=self) for oid in oids]
         self._pump_submit(
             lambda: self._actor_call_entry(actor_id, method, args, kwargs, opts, task_id, oids)

@@ -122,6 +122,11 @@ class WorkerProcess:
         if "v" in spec:
             from ..channel.device_transport import maybe_unpack
 
+            if "t" in spec:
+                # seed ack routing before unpack: a failed unpack raises out
+                # of here, and the submitter's pin cleanup (sender liveness)
+                # must not be confused by a misrouted late ack
+                self.worker._note_transit_owners(spec)
             value = maybe_unpack(serialization.unpack(spec["v"]))
             if "t" in spec:
                 # ack smuggled refs: our rehydrated handles are registered,
@@ -179,7 +184,10 @@ class WorkerProcess:
                 # returned value smuggles ObjectRefs: pin them under a
                 # transit token until the submitter's handles register
                 token = self.worker.transit_pin(nested)
-                return {"v": serialization.pack(value), "t": token, "roids": nested}
+                return {
+                    "v": serialization.pack(value), "t": token, "roids": nested,
+                    "rown": self.worker.transit_owners(nested),
+                }
             return {"v": serialization.pack(value)}
         oid = ObjectID(oid_bytes)
         shm_name, size = self.worker.shm_store.create_and_pack(oid, data, raws)
@@ -190,10 +198,20 @@ class WorkerProcess:
         self.worker._notify_threadsafe(
             "obj_created", oid=oid_bytes, shm_name=shm_name, size=size, owner=owner
         )
+        out = {"shm": shm_name, "size": size}
         if nested:
-            # refs inside the stored value live as long as it does
-            self.worker._notify_threadsafe("obj_contains", oid=oid_bytes, refs=nested)
-        return {"shm": shm_name, "size": size}
+            # refs inside the stored value live as long as it does: edges
+            # register at each nested ref's lifetime authority under the
+            # SUBMITTER's edge id, and the pairs travel with the result so
+            # the submitter's ledger releases them when the container dies
+            pairs = self.worker.result_contains_pairs(oid_bytes, nested, owner)
+            if pairs is None:
+                self.worker._notify_threadsafe(
+                    "obj_contains", oid=oid_bytes, refs=nested
+                )
+            else:
+                out["contains"] = pairs
+        return out
 
     def _package_results(
         self, task_id: bytes, num_returns: int, value: Any, owner: str
@@ -790,6 +808,23 @@ class WorkerProcess:
             # ownership-based object directory read path: this process is
             # authoritative for objects it owns (see Worker.owner_locate_async)
             reply(**await self.worker.owner_locate_async(msg["oid"]))
+        elif m == "owner_refs":
+            # ownership plane write path: a borrower settling inc/dec
+            # against this process's OwnerLedger (worker<->worker, no head)
+            self.worker.serve_owner_refs(
+                msg.get("inc"), msg.get("dec"),
+                msg.get("as_id") or state.get("client_id", "?"),
+                bool(msg.get("ttl")),
+            )
+            reply()
+        elif m == "owner_transit_done":
+            self.worker.serve_owner_transit_done(
+                msg["token"], msg.get("oids"), msg.get("cid", "?"),
+                msg.get("register", True),
+            )
+            reply()
+        elif m == "owner_pin":
+            reply(**self.worker.serve_owner_pin(msg["oid"], msg["as_id"]))
         elif m == "coll_push":
             # p2p collective transport: land the chunk in the rank mailbox
             self.worker.coll_deliver(

@@ -335,10 +335,12 @@ class Dashboard:
             limit = int(params.get("limit", 200))
             out = []
             for rec in list(h.objects.values())[:limit]:
+                holders, ledger = h.digest_holders(rec)
                 out.append(
                     {
                         "object_id": rec.oid.hex(), "size": rec.size,
-                        "node_id": rec.node_id, "holders": len(rec.holders),
+                        "node_id": rec.node_id, "holders": holders,
+                        "owner_ledger": ledger,
                         "spilled": rec.spill_path is not None,
                     }
                 )

@@ -78,6 +78,22 @@ _DRAIN_DESCS = {
 }
 
 
+_owner_shipped: Dict[str, int] = {}
+_OWNER_DESCS = {
+    "refs_settled_local": "refcount windows applied to this process's own ledger",
+    "refs_sent_owner": "refcount updates sent to another owner's ledger (direct)",
+    "refs_recv": "borrower refcount updates served by this process's ledger",
+    "refs_head_fallback": "refcount windows that fell back to the head path",
+    "owner_gc": "objects whose cluster lifetime this ledger settled",
+    "owner_gc_head_down": "of those, settled with the head unreachable",
+    "pins_served": "owner_pin requests answered authoritatively",
+    "pending_expired": "grace-expired pending borrower registrations",
+    "spills_decided": "spill free/defer decisions made owner-side",
+    "syncs_sent": "owner_sync ledger digests shipped to the head",
+    "syncs_full": "of those, full resyncs (reconnect)",
+}
+
+
 _lease_shipped: Dict[str, int] = {}
 _LEASE_DESCS = {
     "local_grants": "leases granted node-locally by agents (lease blocks)",
@@ -125,6 +141,16 @@ def _lease_records() -> List[dict]:
     from ..core.worker import LEASE_STATS
 
     return _counter_deltas("ca_lease_", LEASE_STATS, _lease_shipped, _LEASE_DESCS)
+
+
+def _owner_records() -> List[dict]:
+    """Ownership-plane counters (core/ownership.py OWNER_STATS) as
+    ca_owner_* records: owner-resident vs head-fallback refcount settlement,
+    ledger GC, owner-side spill decisions, and digest sync volume — the
+    series that proves steady-state object lifetime stays off the head."""
+    from ..core.ownership import OWNER_STATS
+
+    return _counter_deltas("ca_owner_", OWNER_STATS, _owner_shipped, _OWNER_DESCS)
 
 
 def _drain_records() -> List[dict]:
@@ -188,6 +214,7 @@ def flush_once():
         batch.extend(m._drain())
     batch.extend(_wire_records())
     batch.extend(_lease_records())
+    batch.extend(_owner_records())
     batch.extend(_drain_records())
     batch.extend(_logplane_records())
     if not batch:

@@ -153,6 +153,36 @@ def lease_plane() -> Dict[str, Any]:
     }
 
 
+def owner_plane() -> Dict[str, Any]:
+    """Ownership-plane summary: cluster-aggregated ca_owner_* counters
+    (owner-resident vs head-fallback refcount settlement, ledger GC,
+    owner-side spill decisions, digest sync volume) plus the head's
+    registry/failover counters — the one-call proof that steady-state
+    object lifetime traffic stays off the head."""
+    from .metrics import get_metrics_snapshot
+
+    r = _head("stats")
+    stats = r["stats"]
+    rpc = r.get("rpc_counts", {})
+    counters: Dict[str, int] = {}
+    try:
+        for name, rec in get_metrics_snapshot().items():
+            if name.startswith("ca_owner_"):
+                counters[name[len("ca_owner_"):]] = int(
+                    sum(rec.get("data", {}).values())
+                )
+    except Exception:
+        pass
+    return {
+        "counters": counters,
+        "objects_released_by_owner": stats.get("objects_released_by_owner", 0),
+        "owners_adopted": stats.get("owners_adopted", 0),
+        "early_refs_expired": stats.get("early_refs_expired", 0),
+        "head_obj_refs_rpcs": rpc.get("obj_refs", 0),
+        "head_owner_sync_rpcs": rpc.get("owner_sync", 0),
+    }
+
+
 # ------------------------------------------------------------------ timeline
 
 _PHASE_ORDER = {
@@ -381,6 +411,7 @@ __all__ = [
     "summarize_actors",
     "summarize_objects",
     "lease_plane",
+    "owner_plane",
     "timeline",
     "get_log",
     "get_log_records",

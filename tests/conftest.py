@@ -75,3 +75,49 @@ def ca_cluster_module(_ca_cluster_module_lifecycle):
     if not ca.is_initialized():
         _ca_cluster_module_lifecycle["info"] = ca.init(num_cpus=4)
     yield _ca_cluster_module_lifecycle["info"]
+
+
+# object-plane test modules get a leak tripwire: after the module, no
+# orphaned spill files and no allocated driver arena bytes may remain (the
+# ownership plane's settle path — ledger GC, obj_release, pin drops — must
+# leave the store clean, not merely make the tests pass)
+_OBJECT_PLANE_MODULES = ("test_objects_gc", "test_spill", "test_ownership")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_orphan_object_plane(request):
+    yield
+    mod = request.module.__name__.rpartition(".")[2]
+    if mod not in _OBJECT_PLANE_MODULES:
+        return
+    import glob
+    import time
+
+    import cluster_anywhere_tpu as ca
+    from cluster_anywhere_tpu.core.worker import try_global_worker
+
+    if not ca.is_initialized():
+        return  # cluster already torn down: its namespace went with it
+    w = try_global_worker()
+    if w is None:
+        return
+    w.reference_counter.flush()
+
+    def spill_files():
+        return glob.glob(os.path.join(w.session_dir, "spill", "*", "*.bin"))
+
+    def arena_alloc():
+        return sum(
+            a.size - sum(sz for _, sz in a.free)
+            for a in w.shm_store._arenas.values()
+        )
+
+    deadline = time.time() + 15
+    while time.time() < deadline and (spill_files() or arena_alloc()):
+        time.sleep(0.3)
+    assert not spill_files(), (
+        f"orphaned spill files after {mod}: {spill_files()}"
+    )
+    assert arena_alloc() == 0, (
+        f"orphaned driver arena bytes after {mod}: {arena_alloc()}"
+    )

@@ -276,3 +276,54 @@ def test_rpc_chaos_cancel_notify_dropped(fresh_cluster):
     ca.cancel(ref2)
     with pytest.raises(ca.exceptions.TaskCancelledError):
         ca.get(ref2, timeout=30)
+
+
+def test_owner_death_failover_under_owner_refs_chaos(fresh_cluster):
+    """Chaos variant of owner-death failover (ownership plane): the direct
+    owner_refs sends from this borrower are failing exactly when the owner
+    dies — settlement must fail over to the head's adopted ledger and the
+    registry record must still drain, leaking nothing."""
+    import gc
+    import signal
+
+    import numpy as np
+
+    from cluster_anywhere_tpu.util import state
+
+    @ca.remote
+    class Owner:
+        def __init__(self):
+            self._keep = None
+
+        def make(self):
+            self._keep = ca.put(np.full(50_000, 3.0))
+            return [self._keep]
+
+        def pid(self):
+            return os.getpid()
+
+    o = Owner.remote()
+    holder = ca.get(o.make.remote(), timeout=30)
+    inner = holder[0]
+    oid_hex = inner.id.hex()
+    assert float(ca.get(inner, timeout=30)[0]) == 3.0
+    pid = ca.get(o.pid.remote(), timeout=30)
+    time.sleep(1.8)  # digest with this borrower reaches the head
+    # every direct ledger send from this process now fails while the owner
+    # is dying: the release below must take the head-fallback path
+    reset_rpc_chaos("owner_refs=8,owner_transit_done=8")
+    os.kill(pid, signal.SIGKILL)
+    time.sleep(2.0)
+    del holder, inner
+    gc.collect()
+    deadline = time.monotonic() + 25
+    while time.monotonic() < deadline:
+        if not any(
+            x["object_id"] == oid_hex for x in state.list_objects()
+        ):
+            break
+        time.sleep(0.3)
+    reset_rpc_chaos("")
+    assert not any(
+        x["object_id"] == oid_hex for x in state.list_objects()
+    ), "adopted object never settled under owner_refs chaos"

@@ -83,6 +83,52 @@ def test_client_mode_inline_args_with_refs(tcp_cluster):
     assert ca.get(read.remote({"ref": small_ref}), timeout=60) == 42
 
 
+def test_client_mode_container_edges_release_at_head(tcp_cluster):
+    """Regression (ownership plane): a LEDGERLESS client-mode owner cannot
+    settle containment edges itself.  The head must remember the (oid,
+    authority) pairs that arrive with a shm-backed task result and release
+    the owner-resident edges only when the container record settles — NOT
+    at adopt time, which GC'd live containers' inners out from under them."""
+    import gc
+    import time
+
+    from cluster_anywhere_tpu.util import state
+
+    ca.init(address=tcp_cluster.head_tcp)
+
+    @ca.remote
+    def produce():
+        inner = ca.put(np.full(50_000, 5.0))  # worker-owned, shm-backed
+        # the padding pushes the container itself over the inline limit so
+        # the result ships as shm + containment pairs (not a transit token)
+        return [np.zeros(200_000), inner]
+
+    @ca.remote
+    def read_inner(c):
+        return float(ca.get(c[1])[0])
+
+    cont = produce.remote()
+    val = ca.get(cont, timeout=60)
+    inner_hex = val[1].id.hex()
+    del val  # drop the client's direct handle on the inner (and the pad)
+    gc.collect()
+    time.sleep(1.5)  # decs flush; pre-fix the inner settled right here
+    # the container still embeds the inner: it must resolve cluster-wide
+    assert ca.get(read_inner.remote(cont), timeout=60) == 5.0
+    # dropping the container settles it at the head, which releases the
+    # owner-resident edge — the inner drains everywhere, nothing leaks
+    del cont
+    gc.collect()
+    deadline = time.monotonic() + 25
+    while time.monotonic() < deadline and any(
+        o["object_id"] == inner_hex for o in state.list_objects()
+    ):
+        time.sleep(0.3)
+    assert not any(
+        o["object_id"] == inner_hex for o in state.list_objects()
+    ), "client-owned container's inner never settled after release"
+
+
 def test_wildcard_addr_normalization(tcp_cluster):
     """A worker TCP dual bound to 0.0.0.0 is rewritten to the host the
     client actually dialed the head on."""

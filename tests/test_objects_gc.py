@@ -250,6 +250,11 @@ def test_refcount_coalescer_merges_and_cancels(ca_cluster):
     time.sleep(0.3)  # debounce timer + head processing
 
     def holders():
+        # the object's lifetime AUTHORITY: the driver's own ledger when the
+        # ownership plane is on, else the head's holder table
+        if w.owner_ledger is not None:
+            hs = w.owner_ledger.holders_of(oid_b)
+            return None if hs is None else len(hs)
         for o in state.list_objects():
             if o["object_id"] == ref.id.hex():
                 return o["num_holders"]

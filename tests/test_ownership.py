@@ -7,6 +7,7 @@ bytes move directly between workers).  The head's per-method rpc_counts make
 the claim falsifiable: these tests assert the hot loops add ~zero head RPCs.
 """
 
+import os
 import time
 
 import numpy as np
@@ -293,3 +294,173 @@ def test_producer_node_death_reconstructs_for_borrower():
         assert ca.get(out_ref, timeout=120) == int(np.arange(2000).sum())
     finally:
         c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Ownership plane (owner-resident lifetime): the borrower ledger settles
+# inc/dec at OWNER processes over direct connections; the head keeps only the
+# registry (obj_created/obj_release) and adopts orphaned ledgers on owner
+# death from the owner_sync digests.
+
+
+def _driver_arena_bytes():
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    w = global_worker()
+    return sum(
+        a.size - sum(sz for _, sz in a.free)
+        for a in w.shm_store._arenas.values()
+    )
+
+
+def test_owner_plane_settles_objects_off_head(ca_cluster_module):
+    """The acceptance workload (create -> borrow across workers -> release)
+    must settle refcounts with ZERO head obj_refs/transit_done messages: the
+    borrower registrations, transit acks, value pins, and releases all land
+    on the driver's OwnerLedger over direct connections."""
+    import gc
+
+    from cluster_anywhere_tpu.core.ownership import OWNER_STATS
+
+    @ca.remote
+    def borrow(holder):
+        return int(ca.get(holder[0]).sum())
+
+    arr = np.arange(4000)
+    ca.get([borrow.remote([ca.put(arr)]) for _ in range(3)], timeout=60)
+    time.sleep(1.2)  # let warmup refcounts settle before counting
+    before = _head_counts()
+    recv0 = OWNER_STATS["refs_recv"]
+    refs = [ca.put(arr) for _ in range(8)]
+    outs = ca.get([borrow.remote([r]) for r in refs], timeout=120)
+    assert outs == [int(arr.sum())] * 8
+    del refs, outs
+    gc.collect()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and _driver_arena_bytes() > 0:
+        time.sleep(0.2)
+    after = _head_counts()
+    for m in ("obj_refs", "transit_done", "obj_pin"):
+        delta = after.get(m, 0) - before.get(m, 0)
+        assert delta == 0, f"{m} grew by {delta}: settlement leaned on the head"
+    # the ledger actually served borrowers (owner_refs/owner_transit_done)
+    assert OWNER_STATS["refs_recv"] > recv0
+    # ... and the promoted slices were reclaimed owner-side (full settle)
+    assert _driver_arena_bytes() == 0
+
+
+def test_owner_death_failover_adopts_ledger(ca_cluster_module):
+    """Owner dies with a live borrower: the head adopts the ledger from the
+    last owner_sync digest (the borrower appears as a holder), the
+    borrower's release settles through the central path, and the registry
+    record plus the dead owner's shm files are reclaimed — no leaked
+    segments or spill files."""
+    import gc
+    import signal as _signal
+
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    @ca.remote
+    class Owner:
+        def __init__(self):
+            self._keep = None
+
+        def make(self):
+            self._keep = ca.put(np.full(50_000, 7.0))  # shm-backed put
+            return [self._keep]  # driver borrows via the holder list
+
+        def pid_cid(self):
+            from cluster_anywhere_tpu.core.worker import global_worker
+
+            return os.getpid(), global_worker().client_id
+
+    o = Owner.remote()
+    holder = ca.get(o.make.remote(), timeout=30)
+    inner = holder[0]
+    oid_hex = inner.id.hex()
+    assert float(ca.get(inner, timeout=30)[0]) == 7.0
+    pid, owner_cid = ca.get(o.pid_cid.remote(), timeout=30)
+    # one owner_sync period so the borrower-bearing digest reaches the head
+    time.sleep(1.8)
+    os.kill(pid, _signal.SIGKILL)
+    time.sleep(2.5)  # head notices the death and adopts the ledger
+
+    from cluster_anywhere_tpu.util import state
+
+    recs = [x for x in state.list_objects() if x["object_id"] == oid_hex]
+    assert recs, "head dropped the record instead of adopting the ledger"
+    # now the borrower releases: settlement must drain through the head
+    del holder, inner
+    gc.collect()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if not any(
+            x["object_id"] == oid_hex for x in state.list_objects()
+        ):
+            break
+        time.sleep(0.3)
+    assert not any(
+        x["object_id"] == oid_hex for x in state.list_objects()
+    ), "adopted object never settled after the borrower released"
+    # the dead owner's arena files were swept (no leaked shm segments)
+    w = global_worker()
+    sdir = os.path.join("/dev/shm", w.session_name)
+    leaked = []
+    for root, _dirs, files in os.walk(sdir):
+        leaked += [f for f in files if f.startswith(f"arena_{owner_cid}_")]
+    assert not leaked, f"dead owner's segments leaked: {leaked}"
+
+
+def test_early_ref_grace_window_bounds_pending_refs():
+    """Regression for the inc-before-obj_created race handling: a holder
+    registration that arrives early is adopted if obj_created lands within
+    the grace window, and is SWEPT (stats early_refs_expired) — not kept by
+    dict-ordering luck — once the window passes."""
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    if ca.is_initialized():
+        ca.shutdown()
+    ca.init(num_cpus=1, early_ref_grace_s=1.0)
+    try:
+        w = global_worker()
+
+        def notify(method, **fields):
+            w.loop.call_soon_threadsafe(
+                lambda: w.head.notify(method, **fields)
+            )
+
+        from cluster_anywhere_tpu.util import state
+
+        # within the window: early inc, then obj_created -> holder adopted
+        oid1 = os.urandom(20)
+        notify("obj_refs", inc=[oid1], as_id="ghost-holder")
+        time.sleep(0.3)
+        notify("obj_created", oid=oid1, size=1, owner="ghost-owner")
+        deadline = time.monotonic() + 5
+        rec = None
+        while time.monotonic() < deadline and rec is None:
+            rec = next(
+                (x for x in state.list_objects()
+                 if x["object_id"] == oid1.hex()), None,
+            )
+            time.sleep(0.1)
+        assert rec is not None and rec["num_holders"] == 1, rec
+
+        # past the window: the early inc is swept before obj_created lands
+        oid2 = os.urandom(20)
+        notify("obj_refs", inc=[oid2], as_id="ghost-holder")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if w.head_call("stats")["stats"].get("early_refs_expired", 0) >= 1:
+                break
+            time.sleep(0.2)
+        assert w.head_call("stats")["stats"].get("early_refs_expired", 0) >= 1
+        notify("obj_created", oid=oid2, size=1, owner="ghost-owner")
+        time.sleep(0.5)
+        rec2 = next(
+            (x for x in state.list_objects()
+             if x["object_id"] == oid2.hex()), None,
+        )
+        assert rec2 is not None and rec2["num_holders"] == 0, rec2
+    finally:
+        ca.shutdown()
