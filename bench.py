@@ -163,8 +163,68 @@ def bench_core():
     except Exception:
         pass
 
+    # metrics-plane block: the head's self-instrumentation (event-loop lag
+    # p50/p99, per-RPC dispatch histogram summary) and the time-series
+    # store's retained footprint — the series future saturation work
+    # re-benchmarks against
+    metricsplane = {}
+    try:
+        from cluster_anywhere_tpu.core.worker import global_worker
+
+        w = global_worker()
+        snap = w.head_call("metrics_snapshot")["metrics"]
+
+        def merged_hist(rec):
+            """Merge a histogram's tagged cells into (bounds, buckets, count)."""
+            bounds, buckets, count = [], [], 0
+            for cell in (rec or {}).get("data", {}).values():
+                b = cell.get("bounds", [])
+                if len(b) > len(bounds):
+                    bounds = b
+                    buckets = [0] * (len(b) + 1)
+                for i, c in enumerate(cell["buckets"]):
+                    if i < len(buckets):
+                        buckets[i] += c
+                count += cell["count"]
+            return bounds, buckets, count
+
+        def hist_pct(bounds, buckets, count, q):
+            """Percentile upper bound from cumulative buckets (s)."""
+            if not count:
+                return 0.0
+            target = q * count
+            cum = 0
+            for i, c in enumerate(buckets):
+                cum += c
+                if cum >= target:
+                    return bounds[i] if i < len(bounds) else bounds[-1] * 2
+            return bounds[-1] * 2 if bounds else 0.0
+
+        lb, lbk, lcount = merged_hist(snap.get("ca_head_loop_lag_hist_seconds"))
+        db, dbk, dcount = merged_hist(snap.get("ca_head_dispatch_seconds"))
+        ts_meta = w.head_call("timeseries", names=[]).get("meta", {})
+        dropped = snap.get("ca_metrics_dropped_total", {}).get("data", {})
+        metricsplane = {
+            "loop_lag_samples": lcount,
+            "loop_lag_p50_ms": round(hist_pct(lb, lbk, lcount, 0.50) * 1e3, 3),
+            "loop_lag_p99_ms": round(hist_pct(lb, lbk, lcount, 0.99) * 1e3, 3),
+            "dispatch_rpcs": dcount,
+            "dispatch_methods": len((snap.get("ca_head_dispatch_seconds") or {}).get("data", {})),
+            "dispatch_p50_ms": round(hist_pct(db, dbk, dcount, 0.50) * 1e3, 3),
+            "dispatch_p99_ms": round(hist_pct(db, dbk, dcount, 0.99) * 1e3, 3),
+            "timeseries_series": ts_meta.get("n_series", 0),
+            "timeseries_memory_bytes": ts_meta.get("memory_bytes", 0),
+            "metrics_dropped_total": int(sum(dropped.values())),
+        }
+        log(f"metricsplane: {metricsplane}")
+    except Exception:
+        pass
+
     ca.shutdown()
-    return best_tasks, best_actor, sync_rate, logplane, drainplane, ownerplane
+    return (
+        best_tasks, best_actor, sync_rate, logplane, drainplane, ownerplane,
+        metricsplane,
+    )
 
 
 class _MemcpyProbe:
@@ -415,7 +475,7 @@ def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
 
 
 def main():
-    _, best_actor, _, logplane, drainplane, ownerplane = bench_core()
+    _, best_actor, _, logplane, drainplane, ownerplane, metricsplane = bench_core()
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -433,6 +493,8 @@ def main():
         out["drainplane"] = drainplane
     if ownerplane:
         out["ownerplane"] = ownerplane
+    if metricsplane:
+        out["metricsplane"] = metricsplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

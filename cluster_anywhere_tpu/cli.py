@@ -417,8 +417,71 @@ def cmd_logs(args):
             sys.exit(1)
 
 
-def cmd_metrics(args):
+def _node_metrics_addr(args, node_id: str):
+    """Resolve a node agent's HTTP scrape endpoint: addr files first
+    (head-free, same-host — deliberately WITHOUT _find_session's
+    head-liveness check, since scraping a node with the head dead is the
+    point), then the head's node table."""
+    import glob
+
+    from cluster_anywhere_tpu.core.config import get_config
+
+    addr_arg = getattr(args, "address", None) or "auto"
+    candidates = []
+    if os.path.isdir(addr_arg):
+        candidates.append(addr_arg)
+    elif addr_arg == "auto":
+        # newest sessions first, head alive or not
+        candidates.extend(sorted(
+            glob.glob(os.path.join(get_config().session_dir_root, "session_*")),
+            key=os.path.getmtime, reverse=True,
+        ))
+    for sdir in candidates:
+        path = os.path.join(sdir, "nodes", node_id, "metrics.addr")
+        if os.path.exists(path):
+            return open(path).read().strip()
     ca = _connect(args)
+    try:
+        for n in ca.nodes():
+            if n["node_id"] == node_id:
+                return n.get("metrics_addr")
+    finally:
+        ca.shutdown()
+    return None
+
+
+def cmd_metrics(args):
+    node_id = getattr(args, "node", None)
+    if node_id:
+        # scrape the node agent's HTTP endpoint directly — works with the
+        # head dead (that is the metrics plane's whole point)
+        import urllib.request
+
+        try:
+            addr = _node_metrics_addr(args, node_id)
+        except (RuntimeError, ConnectionError, FileNotFoundError, TimeoutError) as e:
+            print(f"ca metrics: {e}", file=sys.stderr)
+            sys.exit(1)
+        if not addr:
+            print(
+                f"ca metrics: no scrape endpoint known for node {node_id!r} "
+                f"(node down, or metrics_plane disabled)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        try:
+            with urllib.request.urlopen(addr.rstrip("/") + "/metrics", timeout=10) as r:
+                sys.stdout.write(r.read().decode())
+        except OSError as e:
+            print(f"ca metrics: scrape of {addr} failed: {e}", file=sys.stderr)
+            sys.exit(1)
+        return
+    try:
+        ca = _connect(args)
+    except (RuntimeError, ConnectionError, FileNotFoundError, TimeoutError) as e:
+        # friendly one-liner, not a traceback (the `ca logs` convention)
+        print(f"ca metrics: {e}", file=sys.stderr)
+        sys.exit(1)
     from cluster_anywhere_tpu.util import metrics
 
     if getattr(args, "grafana_out", None):
@@ -430,6 +493,142 @@ def cmd_metrics(args):
     else:
         print(metrics.prometheus_text(), end="")
     ca.shutdown()
+
+
+def cmd_profile(args):
+    """`ca profile <worker|actor|task|node|head> [--duration]`: trigger the
+    target process's in-process stack sampler and print folded stacks (plus
+    a hot-function summary); --speedscope saves the speedscope.app JSON."""
+    try:
+        ca = _connect(args)
+    except (RuntimeError, ConnectionError, FileNotFoundError, TimeoutError) as e:
+        print(f"ca profile: {e}", file=sys.stderr)
+        sys.exit(1)
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    failed = False
+    try:
+        try:
+            out = global_worker().head_call(
+                "profile", id=args.target, duration=args.duration, hz=args.hz,
+                timeout=args.duration + 30,
+            )
+        except (ValueError, RuntimeError, ConnectionError) as e:
+            print(f"ca profile: {e}", file=sys.stderr)
+            failed = True
+            return
+        from cluster_anywhere_tpu.util.profiler import top_functions
+
+        print(
+            f"# {out['target']} (node {out['node_id']}): {out['samples']} "
+            f"samples over {out['duration_s']:.1f}s"
+        )
+        folded = {}
+        for line in out["folded"].splitlines():
+            stack, _, count = line.rpartition(" ")
+            if stack:
+                folded[stack] = int(count)
+        for fn, n in top_functions(folded, limit=10):
+            pct = 100.0 * n / max(out["samples"], 1)
+            print(f"  {pct:5.1f}%  {fn}")
+        if args.speedscope:
+            with open(args.speedscope, "w") as f:
+                json.dump(out["speedscope"], f)
+            print(f"speedscope profile -> {args.speedscope}")
+        if args.folded_out:
+            with open(args.folded_out, "w") as f:
+                f.write(out["folded"] + "\n")
+            print(f"folded stacks -> {args.folded_out}")
+        elif not args.speedscope:
+            print(out["folded"])
+    finally:
+        ca.shutdown()
+        if failed:
+            sys.exit(1)
+
+
+def cmd_top(args):
+    """`ca top`: refreshing live cluster view — resource occupancy, node
+    table, and metrics-plane RATES (tasks/s, objects/s, RPC msg/s, head
+    loop lag) derived from the head's time-series store."""
+    try:
+        ca = _connect(args)
+    except (RuntimeError, ConnectionError, FileNotFoundError, TimeoutError) as e:
+        print(f"ca top: {e}", file=sys.stderr)
+        sys.exit(1)
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    w = global_worker()
+    rate_rows = [
+        ("head_tasks_pushed", "tasks/s"),
+        ("head_objects_created", "objects/s"),
+        ("head_leases_granted", "head leases/s"),
+        ("head_rpc_messages_recv", "head RPC msg/s"),
+        ("head_actor_restarts", "actor restarts/s"),
+    ]
+    gauge_rows = [
+        ("head_n_workers", "workers"),
+        ("head_n_actors", "actors"),
+        ("head_n_objects", "objects"),
+        ("head_pending_leases", "pending leases"),
+        ("head_nodes_draining", "nodes draining"),
+        ("ca_head_loop_lag_seconds", "head loop lag (s)"),
+    ]
+    names = [n for n, _ in rate_rows + gauge_rows]
+    it = 0
+    try:
+        while True:
+            it += 1
+            summary = w.head_call("stats")["stats"]
+            ts = w.head_call("timeseries", names=names, rate=True)
+            series = ts.get("series", {})
+
+            def latest(name):
+                tagged = series.get(name) or {}
+                for rec in tagged.values():
+                    if rec["points"]:
+                        return rec["points"][-1][1]
+                return None
+
+            lines = ["== ca top =="]
+            lines.append(
+                f"nodes {summary.get('n_nodes', '?')}  "
+                f"workers {summary.get('n_workers', '?')}  "
+                f"actors {summary.get('n_actors', '?')}  "
+                f"objects {summary.get('n_objects', '?')}"
+            )
+            lines.append("-- rates (tier-0 window) --")
+            for name, label in rate_rows:
+                v = latest(name)
+                lines.append(
+                    f"  {label:20s} {v:10.2f}" if v is not None
+                    else f"  {label:20s}          -"
+                )
+            lines.append("-- levels --")
+            for name, label in gauge_rows:
+                # gauges pass rate=True through untouched
+                v = latest(name)
+                lines.append(
+                    f"  {label:20s} {v:10.4g}" if v is not None
+                    else f"  {label:20s}          -"
+                )
+            meta = ts.get("meta", {})
+            lines.append(
+                f"-- retention: {meta.get('n_series', 0)} series, "
+                f"{meta.get('memory_bytes', 0) / 1024:.0f} KiB --"
+            )
+            if args.iterations and not args.no_clear:
+                pass  # finite runs print consecutively (test/pipe friendly)
+            elif not args.no_clear:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print("\n".join(lines), flush=True)
+            if args.iterations and it >= args.iterations:
+                return
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ca.shutdown()
 
 
 def cmd_debug(args):
@@ -493,6 +692,13 @@ def cmd_microbenchmark(args):
         from .microbenchmark import run_owner_plane
 
         run_owner_plane(quick=getattr(args, "quick", False))
+        return
+    if getattr(args, "metrics_plane", False):
+        # owns its own clusters (node-scrape vs head-RPC metrics A/B plus
+        # the scrape-with-the-head-down proof)
+        from .microbenchmark import run_metrics_plane
+
+        run_metrics_plane(quick=getattr(args, "quick", False))
         return
 
     import cluster_anywhere_tpu as ca
@@ -642,7 +848,49 @@ def main(argv=None):
         "--grafana-out", default=None, metavar="DIR",
         help="write Grafana dashboard JSON + provisioning stub to DIR",
     )
+    sp.add_argument(
+        "--node", default=None, metavar="NODE_ID",
+        help="scrape that node agent's /metrics endpoint directly "
+        "(head-free: works with the head down)",
+    )
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser(
+        "profile",
+        help="sampling profiler: fold a live process's stacks (ca profile "
+        "<worker|actor|task|node|head>)",
+    )
+    addr(sp)
+    sp.add_argument(
+        "target", nargs="?", default="head",
+        help="worker/actor/task/node id, or 'head' (default)",
+    )
+    sp.add_argument("--duration", type=float, default=2.0, help="seconds to sample")
+    sp.add_argument("--hz", type=float, default=100.0, help="sampling frequency")
+    sp.add_argument(
+        "--speedscope", default=None, metavar="FILE",
+        help="write speedscope.app JSON to FILE",
+    )
+    sp.add_argument(
+        "--folded-out", default=None, metavar="FILE",
+        help="write folded stacks to FILE instead of stdout",
+    )
+    sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser(
+        "top", help="live cluster view: occupancy + metrics-plane rates"
+    )
+    addr(sp)
+    sp.add_argument("--interval", type=float, default=2.0, help="refresh period")
+    sp.add_argument(
+        "--iterations", type=int, default=0,
+        help="render N frames then exit (0 = until Ctrl-C)",
+    )
+    sp.add_argument(
+        "--no-clear", action="store_true",
+        help="append frames instead of clearing the screen (pipes/logs)",
+    )
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("debug", help="attach to a remote breakpoint (rpdb)")
     addr(sp)
@@ -679,6 +927,11 @@ def main(argv=None):
         "--owner-plane", dest="owner_plane", action="store_true",
         help="owner-resident vs centralized object settlement A/B + "
         "head-down GC proof",
+    )
+    sp.add_argument(
+        "--metrics-plane", dest="metrics_plane", action="store_true",
+        help="node-scrape vs head-RPC metrics A/B: head metric traffic "
+        "per scrape + head-down scrape proof",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

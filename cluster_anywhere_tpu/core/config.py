@@ -106,6 +106,21 @@ class CAConfig:
     log_ship_batch: int = 500  # max records per shipped log_batch
     event_buffer_flush_period_s: float = 1.0
     metrics_report_period_s: float = 5.0
+    # --- metrics plane (util/timeseries.py, node-agent /metrics scrape) ---
+    # head-free scrape topology: workers ship metric deltas to their node's
+    # agent, which serves `GET /metrics` over HTTP (Prometheus exposition)
+    # and piggybacks the deltas onto node_sync ticks head-ward.  Off =
+    # legacy per-worker metrics_report RPCs straight to the head.
+    metrics_plane: bool = True
+    # head-side time-series retention: tier-0 sampling cadence (seconds) and
+    # ring length; tier 1 is timeseries_tier1_mult x coarser, same length.
+    # 0 disables retention entirely.
+    timeseries_interval_s: float = 10.0
+    timeseries_len: int = 360
+    timeseries_tier1_mult: int = 12
+    timeseries_max_series: int = 1024
+    # event-loop lag self-measurement period for the head (seconds)
+    loop_lag_period_s: float = 0.25
     # deterministic RPC fault injection, modeled on the reference's
     # RAY_testing_rpc_failure (src/ray/rpc/rpc_chaos.h): "method=N" pairs,
     # failing the first N matching RPCs.

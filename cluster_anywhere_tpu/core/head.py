@@ -81,6 +81,9 @@ class NodeRec:
     lease_used: Dict[str, dict] = field(default_factory=dict)
     # last node_sync delta version applied (delta-synced node state)
     sync_version: int = 0
+    # metrics plane: the agent's HTTP scrape endpoint (Prometheus dials it
+    # directly; `ca metrics --node` resolves through here when the head is up)
+    metrics_addr: Optional[str] = None
 
     @property
     def is_local(self) -> bool:
@@ -352,6 +355,31 @@ class Head:
         # aggregated user metrics (MetricsAgent analogue)
         self.task_events: deque = deque(maxlen=50_000)
         self.metrics: Dict[str, dict] = {}  # name -> {type, desc, data{tags_key: ...}}
+        # metrics plane: time-series retention (ring buffers, two downsample
+        # tiers) sampled off this table + head stats by the monitor loop, so
+        # dashboards/`ca top` get rates and history without Prometheus
+        from ..util.timeseries import TimeSeriesStore
+
+        ts_len = int(getattr(config, "timeseries_len", 360))
+        ts_int = float(getattr(config, "timeseries_interval_s", 10.0))
+        self.timeseries = None
+        if ts_int > 0:
+            self.timeseries = TimeSeriesStore(
+                tiers=(
+                    (ts_int, ts_len),
+                    (ts_int * int(getattr(config, "timeseries_tier1_mult", 12)), ts_len),
+                ),
+                max_series=int(getattr(config, "timeseries_max_series", 1024)),
+            )
+        self._last_ts_sample = 0.0
+        # head self-instrumentation: per-RPC-type dispatch latency and
+        # inflight-handler histograms + an event-loop lag gauge, written
+        # straight into the metrics table (this process has no flusher —
+        # it IS the aggregator).  These series are how the dispatch
+        # saturation knee (SCALE.md "Head saturation") becomes measurable
+        # instead of inferred.
+        self._dispatch_inflight = 0
+        self._self_tags_keys: Dict[str, str] = {}  # method -> cached tags_key
         # log plane: drivers subscribed to the cluster log stream (log_sub);
         # agents' log_batch notifies and the local-node tailer fan out here.
         # Bounded by drop-not-backpressure: a subscriber whose socket buffer
@@ -1287,6 +1315,9 @@ class Head:
             "name": a.name,
             "death_cause": a.death_cause,
             "node_id": a.node_id,
+            # the hosting worker: what `ca profile <actor>` resolves through
+            # (and how list_actors() users find the process to inspect)
+            "worker_id": a.worker_id,
             "method_options": a.method_options,
         }
 
@@ -1875,9 +1906,52 @@ class Head:
             "list_actors", "list_workers", "list_task_events", "list_objects",
             "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
             "get_actor", "subscribe", "publish", "task_events", "metrics_report",
-            "log_sub", "log_batch", "log_fetch",
+            "log_sub", "log_batch", "log_fetch", "timeseries", "profile",
         }
     )
+
+    # head dispatch latency: fine-grained low end (the hot handlers are
+    # tens of µs; the knee shows up as mass shifting right)
+    _DISPATCH_BOUNDS = [
+        1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0,
+    ]
+    _INFLIGHT_BOUNDS = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0]
+
+    def _self_hist_observe(
+        self, name: str, desc: str, bounds, value: float, tags_key: str
+    ) -> None:
+        """Observe into a histogram owned BY the head (this process has no
+        metric flusher — it writes the aggregation table directly, so the
+        series flows to /metrics, snapshots, and the time-series store like
+        any shipped metric)."""
+        rec = self.metrics.get(name)
+        if rec is None:
+            rec = self.metrics[name] = {
+                "type": "histogram", "desc": desc, "data": {}
+            }
+        cur = rec["data"].get(tags_key)
+        if cur is None:
+            cur = rec["data"][tags_key] = {
+                "buckets": [0] * (len(bounds) + 1), "sum": 0.0, "count": 0,
+                "bounds": list(bounds),
+            }
+        import bisect
+
+        cur["buckets"][bisect.bisect_left(bounds, value)] += 1
+        cur["sum"] += value
+        cur["count"] += 1
+
+    def _self_gauge_set(self, name: str, desc: str, value: float) -> None:
+        rec = self.metrics.get(name)
+        if rec is None:
+            rec = self.metrics[name] = {"type": "gauge", "desc": desc, "data": {}}
+        rec["data"]["[]"] = float(value)
+
+    def _method_tags_key(self, m: str) -> str:
+        tk = self._self_tags_keys.get(m)
+        if tk is None:
+            tk = self._self_tags_keys[m] = json.dumps([["method", m]])
+        return tk
 
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
@@ -1888,7 +1962,24 @@ class Head:
         self.rpc_counts[m] += 1
         if m not in self._READONLY_METHODS:
             self._dirty = True  # persisted by the debounced snapshot loop
-        await h(state, msg, reply, reply_err)
+        tk = self._method_tags_key(m)
+        self._dispatch_inflight += 1
+        self._self_hist_observe(
+            "ca_head_dispatch_inflight",
+            "handlers in flight on the head loop when each RPC dispatched "
+            "(queue-depth proxy), by method",
+            self._INFLIGHT_BOUNDS, float(self._dispatch_inflight), tk,
+        )
+        t0 = time.perf_counter()
+        try:
+            await h(state, msg, reply, reply_err)
+        finally:
+            self._dispatch_inflight -= 1
+            self._self_hist_observe(
+                "ca_head_dispatch_seconds",
+                "head handler dispatch latency by RPC method",
+                self._DISPATCH_BOUNDS, time.perf_counter() - t0, tk,
+            )
 
     async def _h_register(self, state, msg, reply, reply_err):
         role = msg["role"]
@@ -1989,6 +2080,7 @@ class Head:
                 existing.addr = msg["addr"]
                 existing.pid = msg.get("pid", existing.pid)
                 existing.last_heartbeat = time.monotonic()
+                existing.metrics_addr = msg.get("metrics_addr") or existing.metrics_addr
                 state["node_id"] = node_id
                 await self._connect_agent(existing)
                 if not existing.up:
@@ -2018,6 +2110,7 @@ class Head:
             )
         )
         state["node_id"] = node_id
+        node.metrics_addr = msg.get("metrics_addr") or None
         self.stats["nodes_joined"] += 1
         self._log_event("node_joined", node_id=node_id, resources=node.total)
         await self._connect_agent(node)
@@ -2046,6 +2139,11 @@ class Head:
                 # agent-side block occupancy (delegated vs used) for
                 # `ca status` / /api/nodes / lease_dir freshness
                 node.lease_used = msg["lease_stats"] or {}
+            if "metrics" in msg:
+                # metrics-plane piggyback: the node's queued worker deltas
+                from ..util.metrics import merge_metric_records
+
+                merge_metric_records(self.metrics, msg["metrics"])
 
     async def _h_node_sync(self, state, msg, reply, reply_err):
         """Delta-synced node state (the ray_syncer analogue, head-ward):
@@ -2070,6 +2168,14 @@ class Head:
             node.mem_pressured = (
                 bool(v[0]) if isinstance(v, (list, tuple)) else bool(v)
             )
+        if "metrics" in msg:
+            # metrics-plane piggyback: worker metric deltas the node's agent
+            # queued since its last tick ride the sync frame — the head's
+            # cluster table stays fed with ZERO standalone metric RPCs from
+            # agent-node workers
+            from ..util.metrics import merge_metric_records
+
+            merge_metric_records(self.metrics, msg["metrics"])
 
     async def _h_owner_sync(self, state, msg, reply, reply_err):
         """An owner's ledger digest (versioned delta, or full on reconnect):
@@ -3272,6 +3378,8 @@ class Head:
                     # agent pid (same-host test tooling: PreemptionSimulator
                     # sends the preemption SIGTERM straight to it)
                     "pid": n.pid,
+                    # Prometheus scrape endpoint (node-agent HTTP, head-free)
+                    "metrics_addr": n.metrics_addr,
                     "lease_blocks": self._node_lease_blocks(n),
                     "n_workers": sum(
                         1
@@ -3429,38 +3537,102 @@ class Head:
         reply(objects=out)
 
     async def _h_metrics_report(self, state, msg, reply, reply_err):
-        for m in msg.get("metrics") or []:
-            try:
-                rec = self.metrics.setdefault(
-                    m["name"],
-                    {"type": m["type"], "desc": m.get("desc", ""), "data": {}},
-                )
-                data = rec["data"]
-                key = m["tags_key"]
-                if m["type"] == "counter":
-                    data[key] = data.get(key, 0.0) + m["value"]
-                elif m["type"] == "gauge":
-                    data[key] = m["value"]
-                elif m["type"] == "histogram":
-                    nbuckets = len(m["value"]["buckets"])
-                    cur = data.setdefault(
-                        key, {"buckets": [0] * nbuckets, "sum": 0.0, "count": 0}
-                    )
-                    if len(cur["buckets"]) < nbuckets:
-                        # same name reported with different boundaries (e.g.
-                        # rolling code change): widen rather than IndexError
-                        cur["buckets"].extend([0] * (nbuckets - len(cur["buckets"])))
-                    for i, c in enumerate(m["value"]["buckets"]):
-                        cur["buckets"][i] += c
-                    cur["sum"] += m["value"]["sum"]
-                    cur["count"] += m["value"]["count"]
-                    if len(m["value"]["bounds"]) >= len(cur.get("bounds", [])):
-                        cur["bounds"] = m["value"]["bounds"]
-            except Exception:
-                continue  # one malformed record must not drop the whole batch
+        from ..util.metrics import merge_metric_records
+
+        merge_metric_records(self.metrics, msg.get("metrics"))
 
     async def _h_metrics_snapshot(self, state, msg, reply, reply_err):
         reply(metrics=self.metrics)
+
+    async def _h_timeseries(self, state, msg, reply, reply_err):
+        """Metrics-plane history: ring-buffered series at the requested tier
+        (0 = scrape resolution, 1 = coarse), optionally counter→rate derived
+        server-side.  Backs `/api/timeseries`, `util.state.timeseries()`,
+        dashboard sparklines, and `ca top`."""
+        if self.timeseries is None:
+            reply(series={}, meta={"disabled": True})
+            return
+        reply(
+            series=self.timeseries.query(
+                names=msg.get("names"),
+                prefix=msg.get("prefix"),
+                tier=int(msg.get("tier", 0)),
+                rate=bool(msg.get("rate")),
+            ),
+            meta=self.timeseries.meta(),
+        )
+
+    async def _h_profile(self, state, msg, reply, reply_err):
+        """`ca profile` routing: resolve a worker / actor / task / node /
+        "head" id to the owning process and trigger its in-process stack
+        sampler; the folded stacks + speedscope JSON stream back through
+        here.  The head samples itself off-loop (the sampler thread reads
+        sys._current_frames; the loop keeps dispatching)."""
+        ident = msg.get("id") or "head"
+        duration = float(msg.get("duration", 2.0))
+        hz = float(msg.get("hz", 100.0))
+        node = self.nodes.get(ident)
+        if ident == "head" or (node is not None and node.is_local):
+            # the head node has no separate agent: its node id profiles the
+            # head process itself (not a "no such id" error)
+            from ..util import profiler
+
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, profiler.sample_stacks, duration, hz
+            )
+            reply(
+                target="head", node_id=LOCAL_NODE,
+                folded=profiler.render_folded(res["folded"]),
+                speedscope=profiler.speedscope_json(res["folded"], "head", hz),
+                samples=res["samples"], duration_s=res["duration_s"],
+            )
+            return
+        # node id -> that node's agent process
+        if node is not None and not node.is_local:
+            if node.conn is None or node.conn.closed:
+                reply_err(ConnectionError(f"agent for node {ident!r} unreachable"))
+                return
+            try:
+                out = await node.conn.call(
+                    "profile", duration=duration, hz=hz, timeout=duration + 15
+                )
+            except Exception as e:
+                reply_err(RuntimeError(f"profile of node {ident!r} failed: {e}"))
+                return
+            reply(target=ident, node_id=ident, **{
+                k: out[k] for k in ("folded", "speedscope", "samples", "duration_s")
+            })
+            return
+        wid = ident
+        # actor id -> its worker
+        for a in self.actors.values():
+            if a.actor_id == ident or a.actor_id.startswith(ident):
+                wid = a.worker_id
+                break
+        else:
+            # task id -> the worker its most recent lifecycle event ran on
+            if ident not in self.workers:
+                for ev in reversed(self.task_events):
+                    if ev.get("task_id") == ident and ev.get("worker_id"):
+                        wid = ev["worker_id"]
+                        break
+        rec = self.workers.get(wid)
+        if rec is None or rec.state == "dead" or not rec.addr:
+            reply_err(ValueError(
+                f"no live worker/actor/task/node with id {ident!r}"
+            ))
+            return
+        try:
+            conn = await self._worker_conn(rec)
+            out = await conn.call(
+                "profile", duration=duration, hz=hz, timeout=duration + 15
+            )
+        except Exception as e:
+            reply_err(RuntimeError(f"profile of {wid!r} failed: {e}"))
+            return
+        reply(target=wid, node_id=rec.node_id, **{
+            k: out[k] for k in ("folded", "speedscope", "samples", "duration_s")
+        })
 
     async def _h_autoscaler_state(self, state, msg, reply, reply_err):
         """What the autoscaler reconciler consumes (autoscaler.proto analogue):
@@ -3610,11 +3782,78 @@ class Head:
                 # survive in the multi-job milestone)
                 self._shutdown.set()
 
+    async def _loop_lag_loop(self):
+        """Measure this loop's own scheduling lag: sleep a fixed period and
+        observe the overshoot.  Lag is THE head-saturation signal — every
+        handler that blocks the loop (big snapshot, O(n) scan, dispatch
+        flood) shows up here before it shows up as client timeouts.  Gauge =
+        latest sample (`ca_head_loop_lag_seconds`); histogram accumulates
+        the distribution for p50/p99 in bench/`ca top`."""
+        period = max(float(getattr(self.config, "loop_lag_period_s", 0.25)), 0.01)
+        loop = asyncio.get_running_loop()
+        while not self._shutdown.is_set():
+            t0 = loop.time()
+            await asyncio.sleep(period)
+            lag = max(loop.time() - t0 - period, 0.0)
+            self._self_gauge_set(
+                "ca_head_loop_lag_seconds",
+                "head asyncio event-loop scheduling lag (latest sample)",
+                lag,
+            )
+            self._self_hist_observe(
+                "ca_head_loop_lag_hist_seconds",
+                "head asyncio event-loop scheduling lag distribution",
+                self._DISPATCH_BOUNDS, lag, "[]",
+            )
+
+    def _timeseries_tick(self, wall: float) -> None:
+        """One retention sample: head stats (cumulative counters), computed
+        cluster gauges (incl. the drain/owner-plane aggregates, so the PR
+        5/6 surfaces get history, not just current values), and the whole
+        aggregated metrics table (counters, gauges, histogram _count/_sum)."""
+        store = self.timeseries
+        for k, v in self.stats.items():
+            if isinstance(v, (int, float)):
+                store.record(f"head_{k}", "[]", float(v), "counter", wall)
+        gauges = {
+            "nodes_draining": sum(
+                1 for n in self.nodes.values() if n.state == "draining"
+            ),
+            "n_nodes": sum(1 for n in self.nodes.values() if n.up),
+            "n_workers": sum(1 for w in self.workers.values() if w.state != "dead"),
+            "n_actors": len(self.actors),
+            "n_objects": len(self.objects),
+            "pending_leases": len(self.pending_leases),
+            "idle_workers": sum(
+                len(d) for n in self._alive_nodes() for d in n.idle.values()
+            ),
+            "owner_digest_entries": sum(
+                len(d) for d in self.owner_digests.values()
+            ),
+        }
+        for k, v in gauges.items():
+            store.record(f"head_{k}", "[]", float(v), "gauge", wall)
+        from .protocol import wire_stats
+
+        for k, v in wire_stats().items():
+            store.record(f"head_rpc_{k}", "[]", float(v), "counter", wall)
+        store.sample_metrics(self.metrics, wall)
+
     async def _monitor_loop(self):
         period = self.config.health_check_period_s
         while not self._shutdown.is_set():
             await asyncio.sleep(min(period, 0.2))
             now = time.monotonic()
+            if (
+                self.timeseries is not None
+                and now - self._last_ts_sample
+                >= float(getattr(self.config, "timeseries_interval_s", 10.0))
+            ):
+                self._last_ts_sample = now
+                try:
+                    self._timeseries_tick(time.time())
+                except Exception:
+                    pass  # retention must never take down the monitor
             for rec in list(self.workers.values()):
                 if rec.state == "dead":
                     continue
@@ -3807,6 +4046,7 @@ class Head:
         monitor = asyncio.ensure_future(self._monitor_loop())
         persister = asyncio.ensure_future(self._persist_loop())
         log_tail = asyncio.ensure_future(self._log_tail_loop())
+        loop_lag = asyncio.ensure_future(self._loop_lag_loop())
         # readiness marker for the driver — atomic rename: a reader must
         # never observe the file existing but empty (the pid parse treats
         # that as a dead cluster and refuses to connect)
@@ -3818,6 +4058,7 @@ class Head:
         monitor.cancel()
         persister.cancel()
         log_tail.cancel()
+        loop_lag.cancel()
         if self.dashboard is not None:
             await self.dashboard.stop()
         await self._teardown()

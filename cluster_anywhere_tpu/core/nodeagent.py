@@ -238,6 +238,18 @@ class NodeAgent:
         # (re)registration forces a full resync to the (new) head.
         self.reporter = DeltaReporter()
         self._mp_tick = 0  # re-send the pressure component while pressured
+        # metrics plane: this node's aggregated metrics table (the per-node
+        # MetricsAgent role).  Workers ship delta records here instead of to
+        # the head; the table is served over HTTP in Prometheus exposition
+        # format (head-free scrape) and the deltas piggyback onto node_sync
+        # ticks so the head's cluster-wide table stays fed for dashboards.
+        self.node_metrics: Dict[str, dict] = {}
+        self._metrics_pending: list = []
+        self.metrics_stats = {
+            "reports_total": 0, "scrapes_total": 0, "head_ship_dropped": 0,
+        }
+        self._http_server = None
+        self.metrics_addr = None
 
     # --------------------------------------------------------------- workers
     def _spawn_worker(self, wid: str, purpose: str, pool: str) -> None:
@@ -382,12 +394,168 @@ class NodeAgent:
                     os.unlink(path)
                 except OSError:
                     pass
+        elif m == "metrics_report":
+            # metrics plane ingest: a local worker's delta batch lands in the
+            # node table (scrape truth, head-free) and queues for the next
+            # node_sync tick (head dashboard truth).  The pending queue is
+            # bounded like the worker-side re-stage buffer: a long head
+            # outage drops the OLDEST deltas, never the node table.
+            from ..util.metrics import RESTAGE_CAP, merge_metric_records
+
+            records = msg.get("metrics") or []
+            merge_metric_records(self.node_metrics, records)
+            self.metrics_stats["reports_total"] += len(records)
+            self._metrics_pending.extend(records)
+            over = len(self._metrics_pending) - RESTAGE_CAP
+            if over > 0:
+                del self._metrics_pending[:over]
+                self.metrics_stats["head_ship_dropped"] += over
+                from .ownership import warn_ratelimited
+
+                warn_ratelimited(
+                    "agent-metrics-pending-cap",
+                    f"node {self.node_id}: metrics head-ship queue full, "
+                    f"dropped {over} oldest delta records",
+                )
+        elif m == "profile":
+            # sampling profiler relay target: profile THIS agent process
+            # (workers serve their own `profile`; the head resolves routing)
+            from ..util import profiler
+
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, profiler.sample_stacks,
+                float(msg.get("duration", 2.0)), float(msg.get("hz", 100.0)),
+            )
+            reply(
+                folded=profiler.render_folded(res["folded"]),
+                speedscope=profiler.speedscope_json(
+                    res["folded"], f"agent {self.node_id}", res["hz"]
+                ),
+                samples=res["samples"],
+                duration_s=res["duration_s"],
+            )
         elif m == "node_shutdown":
             self._shutdown.set()
         elif m == "ping":
             reply(node_id=self.node_id, n_workers=len(self.procs))
         else:
             reply_err(ValueError(f"unknown agent method {m}"))
+
+    # ------------------------------------------------------- metrics scrape
+    def _scrape_table(self) -> Dict[str, dict]:
+        """The node table plus the agent's own liveness counters — what a
+        Prometheus scrape of this node returns."""
+        table = dict(self.node_metrics)
+        tags = "[]"
+        table["ca_node_agent_metrics_reports_total"] = {
+            "type": "counter",
+            "desc": "worker metric delta records ingested by this node agent",
+            "data": {tags: float(self.metrics_stats["reports_total"])},
+        }
+        table["ca_node_agent_scrapes_total"] = {
+            "type": "counter",
+            "desc": "HTTP /metrics scrapes served by this node agent",
+            "data": {tags: float(self.metrics_stats["scrapes_total"])},
+        }
+        table["ca_node_agent_workers"] = {
+            "type": "gauge",
+            "desc": "worker processes currently supervised by this agent",
+            "data": {tags: float(len(self.procs))},
+        }
+        table["ca_node_agent_head_ship_dropped_total"] = {
+            "type": "counter",
+            "desc": "metric delta records dropped at this agent's bounded "
+            "head-ship queue (head unreachable too long)",
+            "data": {tags: float(self.metrics_stats["head_ship_dropped"])},
+        }
+        return table
+
+    async def _http_client(self, reader, writer):
+        """Minimal HTTP endpoint: GET /metrics (Prometheus exposition text
+        of this node's table — served with NO head involvement, so scrapes
+        survive a dead head) and GET /healthz."""
+        try:
+            req = await asyncio.wait_for(reader.readline(), 10)
+            parts = req.decode("latin1").split()
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = parts[1].split("?", 1)[0] if len(parts) >= 2 else ""
+            if len(parts) < 2 or parts[0] != "GET":
+                status, ctype, body = 405, "text/plain", b"GET only"
+            elif path == "/metrics":
+                from ..util.metrics import render_prometheus
+
+                self.metrics_stats["scrapes_total"] += 1
+                body = render_prometheus(self._scrape_table()).encode()
+                status, ctype = 200, "text/plain; version=0.0.4"
+            elif path == "/healthz":
+                status, ctype, body = 200, "text/plain", b"ok\n"
+            else:
+                status, ctype, body = 404, "text/plain", b"not found"
+            reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}[status]
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+            )
+            writer.write(body)
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _routable_host(self):
+        """This host's address on the interface that routes to the head (a
+        connected UDP socket never sends a packet; getsockname reveals the
+        chosen source address)."""
+        import socket
+
+        head = self.head_addr
+        if not head.startswith("tcp:"):
+            return None
+        head_host = head[4:].rpartition(":")[0]
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((head_host, 9))
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            return None
+
+    async def _start_metrics_http(self):
+        """Bind the scrape endpoint (host of the agent's RPC listener,
+        CA_AGENT_METRICS_PORT or ephemeral) and advertise it: in the node
+        dir for same-host tools and in the register payload for `ca
+        metrics --node` / the dashboard."""
+        host = "127.0.0.1"
+        spec = self.serve_addr_spec
+        if spec.startswith("tcp:"):
+            host = spec.split(":")[1] or "127.0.0.1"
+        port = int(os.environ.get("CA_AGENT_METRICS_PORT", "0"))
+        try:
+            self._http_server = await asyncio.start_server(
+                self._http_client, host, port
+            )
+        except OSError:
+            return  # port taken: the node runs without a scrape endpoint
+        h, p = self._http_server.sockets[0].getsockname()[:2]
+        if h in ("0.0.0.0", "::", ""):
+            # a wildcard bind must not be ADVERTISED as-is (Prometheus and
+            # `ca metrics --node` would dial 0.0.0.0): use the interface
+            # that routes to the head — the address peers reach us on
+            h = self._routable_host() or "127.0.0.1"
+        self.metrics_addr = f"http://{h}:{p}"
+        path = os.path.join(self.node_dir, "metrics.addr")
+        with open(path + ".tmp", "w") as f:
+            f.write(self.metrics_addr)
+        os.replace(path + ".tmp", path)
 
     # ------------------------------------------------------------ lifecycle
     async def _heartbeat_loop(self):
@@ -405,7 +573,18 @@ class NodeAgent:
                     # same dissemination path as load): the head's `ca
                     # status`, /api/nodes, and revocation sizing read it
                     hb["lease_stats"] = self.granter.stats()
-                    self.head.notify("node_heartbeat", **hb)
+                    pending = (
+                        self._take_pending_metrics()
+                        if self._metrics_pending else []
+                    )
+                    if pending:
+                        hb["metrics"] = pending
+                    try:
+                        self.head.notify("node_heartbeat", **hb)
+                    except Exception:
+                        if pending:
+                            self._restage_pending_metrics(pending)
+                        raise
             except Exception:
                 pass
             # reap exited worker processes and report them (the head cannot
@@ -423,6 +602,30 @@ class NodeAgent:
                     except Exception:
                         pass
 
+    def _take_pending_metrics(self) -> list:
+        pending, self._metrics_pending = self._metrics_pending, []
+        return pending
+
+    def _restage_pending_metrics(self, records: list) -> None:
+        """A head send failed after the queue was drained: put the records
+        back at the FRONT (counter order matters at the aggregator), then
+        enforce the cap with the same drop-OLDEST-and-count policy as the
+        ingest path — the restaged batch is the oldest data in the queue."""
+        from ..util.metrics import RESTAGE_CAP
+
+        self._metrics_pending[:0] = records
+        over = len(self._metrics_pending) - RESTAGE_CAP
+        if over > 0:
+            del self._metrics_pending[:over]
+            self.metrics_stats["head_ship_dropped"] += over
+            from .ownership import warn_ratelimited
+
+            warn_ratelimited(
+                "agent-metrics-pending-cap",
+                f"node {self.node_id}: metrics head-ship queue full on "
+                f"restage, dropped {over} oldest delta records",
+            )
+
     def _send_node_sync(self):
         """Versioned delta heartbeat (node_sync): only components whose
         payload changed since the last send travel; an unchanged tick is a
@@ -432,7 +635,9 @@ class NodeAgent:
         The mem-pressure component re-sends every tick WHILE pressured: the
         head clears its flag after acting on it (kill one worker per refresh
         period), so a level-triggered single send would stop the policy
-        after the first kill."""
+        after the first kill.  Queued worker metric deltas piggyback on the
+        same tick (the metrics plane's head-ward dashboard feed) — they ride
+        whatever frame the tick produces, keepalive included."""
         comps: Dict[str, Any] = {
             "load": quantize_load(node_load_sample()),
             "lease_stats": self.granter.stats(),
@@ -444,10 +649,19 @@ class NodeAgent:
             else:
                 comps["mem_pressured"] = False
         d = self.reporter.delta(comps)
-        if d is None:
-            self.head.notify("node_sync", node_id=self.node_id)
-        else:
-            self.head.notify("node_sync", node_id=self.node_id, **d)
+        extra: Dict[str, Any] = {}
+        pending = self._take_pending_metrics() if self._metrics_pending else []
+        if pending:
+            extra["metrics"] = pending
+        try:
+            if d is None:
+                self.head.notify("node_sync", node_id=self.node_id, **extra)
+            else:
+                self.head.notify("node_sync", node_id=self.node_id, **d, **extra)
+        except Exception:
+            if pending:
+                self._restage_pending_metrics(pending)
+            raise
 
     async def _log_ship_loop(self):
         """Tail this node's structured capture files and batch new records
@@ -483,6 +697,9 @@ class NodeAgent:
     async def _amain(self):
         await self.server.start()
         self.serve_addr = self.server.bound_addrs[0]
+        if getattr(self.config, "metrics_plane", True):
+            # scrape endpoint first: metrics_addr travels in the register
+            await self._start_metrics_http()
         self.head = await connect_addr(self.head_addr)
         self.head.set_push_handler(self._on_head_push)
         await self.head.call(
@@ -494,6 +711,7 @@ class NodeAgent:
             labels=self.labels,
             pid=os.getpid(),
             lease_blocks=self.granter.block_snapshot(),
+            metrics_addr=self.metrics_addr,
         )
         # readiness marker for the cluster fixture
         ready = os.path.join(self.node_dir, "agent.ready")
@@ -575,6 +793,7 @@ class NodeAgent:
                     # block snapshot lets the restarted head re-adopt the
                     # delegation (and reconcile grants made in the outage)
                     lease_blocks=self.granter.block_snapshot(),
+                    metrics_addr=self.metrics_addr,
                     timeout=5,
                 )
                 self.head = conn
@@ -588,6 +807,11 @@ class NodeAgent:
     def _teardown(self):
         import shutil
 
+        if self._http_server is not None:
+            try:
+                self._http_server.close()
+            except Exception:
+                pass
         for wid in list(self.procs):
             self._kill_worker(wid)
         shutil.rmtree(self.shm_ns_dir, ignore_errors=True)

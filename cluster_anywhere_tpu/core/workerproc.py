@@ -116,6 +116,9 @@ class WorkerProcess:
         # async actor-method tasks in flight: task_id -> asyncio.Task
         # (cancellation for coroutines is task.cancel(), not async exc)
         self._async_running: Dict[bytes, Any] = {}
+        # task_id -> rusage probe at execution start (metrics plane: the
+        # terminal event carries CPU%/RSS/arena deltas derived from it)
+        self._task_rusage0: Dict[bytes, dict] = {}
 
     # ----------------------------------------------------------- args/results
     def _resolve_arg(self, spec: dict) -> Any:
@@ -410,12 +413,37 @@ class WorkerProcess:
             # trailing clear is the backstop)
             ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
 
+    def _arena_bytes(self) -> Optional[int]:
+        """Live bytes in this worker's shm arenas (metrics-plane resource
+        attribution on terminal task events); None when unavailable."""
+        try:
+            return sum(
+                a.size - sum(sz for _, sz in a.free)
+                for a in self.worker.shm_store._arenas.values()
+            )
+        except Exception:
+            return None
+
     def _record_event(
         self, task_id: bytes, name: str, kind: str, t0: float, ok: bool,
         trace: Optional[dict] = None,
     ):
         import time as _time
 
+        extra = {}
+        p0 = self._task_rusage0.pop(task_id, None)
+        if p0 is not None:
+            # CPU%/RSS/arena sample pair bracketing the task: rides the
+            # task-event path into timeline()/`ca summary` (process-wide
+            # numbers — concurrent tasks on one worker share them)
+            from ..util import profiler
+
+            try:
+                extra["rusage"] = profiler.rusage_delta(
+                    t0, p0, self._arena_bytes()
+                )
+            except Exception:
+                pass
         tracing.record_task_event(
             task_id.hex(), name, kind,
             "FINISHED" if ok else "FAILED",
@@ -425,6 +453,7 @@ class WorkerProcess:
             actor_id=self.actor.actor_id if self.actor else None,
             start=t0,
             end=_time.time(),
+            **extra,
         )
 
     def _record_running(self, task_id: bytes, name: Optional[str], kind: str, tr: dict):
@@ -443,6 +472,9 @@ class WorkerProcess:
         num_returns = msg.get("num_returns", 1)
         task_id = msg.get("task_id") or os.urandom(16)
         t0 = _time.time()
+        from ..util import profiler as _profiler
+
+        self._task_rusage0[task_id] = _profiler.rusage_probe()
         tr = msg.get(TRACE_FIELD)
         ev_name = msg.get("method") if is_actor_call else None
         try:
@@ -537,6 +569,7 @@ class WorkerProcess:
             return out
         except SystemExit:
             self._exiting = True
+            self._task_rusage0.pop(task_id, None)
             if self.actor is not None:
                 try:
                     self.worker.head.notify("actor_exited", actor_id=self.actor.actor_id)
@@ -570,6 +603,9 @@ class WorkerProcess:
         # yields; force kills the process like any running task)
         self._running_tasks[task_id] = threading.get_ident()
         t0 = _time.time()
+        from ..util import profiler as _profiler
+
+        self._task_rusage0[task_id] = _profiler.rusage_probe()
         idx = 0
         tr = msg.get(TRACE_FIELD)
         token = None
@@ -832,6 +868,25 @@ class WorkerProcess:
                 msg["data"], msg["shape"], msg["dtype"],
             )
             reply()
+        elif m == "profile":
+            # metrics plane: in-process stack sampler (`ca profile`).  Runs
+            # on the loop's DEFAULT executor, never the task executor — the
+            # busy task being profiled is occupying that one, and the whole
+            # point is to observe it
+            from ..util import profiler
+
+            res = await self.loop.run_in_executor(
+                None, profiler.sample_stacks,
+                float(msg.get("duration", 2.0)), float(msg.get("hz", 100.0)),
+            )
+            reply(
+                folded=profiler.render_folded(res["folded"]),
+                speedscope=profiler.speedscope_json(
+                    res["folded"], f"worker {self.worker_id}", res["hz"]
+                ),
+                samples=res["samples"],
+                duration_s=res["duration_s"],
+            )
         elif m == "ping":
             reply(worker_id=self.worker_id, actor=self.actor.actor_id if self.actor else None)
         elif m == "actor_shutdown":

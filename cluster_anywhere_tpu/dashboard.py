@@ -50,6 +50,8 @@ th { color: #9aa5b1; font-weight: 600; }
 </style></head><body>
 <h1>cluster_anywhere_tpu</h1>
 <div id="res"></div>
+<h2>Metrics <span id="tsmeta" style="color:#9aa5b1;font-weight:400"></span></h2>
+<div id="sparks" style="display:flex;flex-wrap:wrap;gap:14px"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Workers</h2><table id="workers"></table>
@@ -182,9 +184,55 @@ async function refreshLogs() {
   const r = await (await fetch("/api/logs?id=" + encodeURIComponent(sel.value) + "&tail=100")).json();
   document.getElementById("logview").textContent = r.data != null ? r.data : (r.error || "");
 }
+function spark(label, pts, unit) {
+  // inline SVG sparkline over the tier-0 window (newest right)
+  const W = 180, H = 36;
+  let path = "", cur = "";
+  if (pts.length > 1) {
+    const vs = pts.map(p => p[1]);
+    const vmax = Math.max(...vs, 1e-9), t0 = pts[0][0],
+          span = Math.max(pts[pts.length-1][0] - t0, 1e-9);
+    path = pts.map((p, i) =>
+      (i ? "L" : "M") + ((p[0]-t0)/span*W).toFixed(1) + "," +
+      (H - 2 - (p[1]/vmax)*(H-6)).toFixed(1)).join(" ");
+    cur = vs[vs.length-1] >= 100 ? vs[vs.length-1].toFixed(0)
+        : vs[vs.length-1].toPrecision(3);
+  }
+  return '<div style="background:#161b22;border:1px solid #2a3038;padding:6px 8px">' +
+    '<div style="font-size:11px;color:#9aa5b1">' + esc(label) + "</div>" +
+    '<svg width="' + W + '" height="' + H + '"><path d="' + path +
+    '" fill="none" stroke="#8ab4f8" stroke-width="1.5"/></svg>' +
+    '<div style="font-size:12px" class="ok">' + cur + " " + unit + "</div></div>";
+}
+async function refreshSparks() {
+  const names = [
+    ["head_tasks_pushed", "tasks/s", 1],
+    ["head_objects_created", "obj/s", 1],
+    ["head_rpc_messages_recv", "msg/s", 1],
+    ["ca_head_loop_lag_seconds", "ms lag", 0],
+    ["head_nodes_draining", "draining", 0],
+    ["ca_owner_owner_gc", "owner gc/s", 1],
+  ];
+  const r = await (await fetch("/api/timeseries?rate=1&names=" +
+    names.map(n => n[0]).join(","))).json();
+  if (r.meta && r.meta.disabled) return;
+  let html = "";
+  names.forEach(([n, unit, isRate]) => {
+    const tagged = r.series[n];
+    if (!tagged) return;
+    let pts = Object.values(tagged)[0].points;
+    if (n === "ca_head_loop_lag_seconds") pts = pts.map(p => [p[0], p[1]*1000]);
+    if (pts.length > 1) html += spark(n.replace(/^head_|^ca_head_/, ""), pts, unit);
+  });
+  document.getElementById("sparks").innerHTML = html;
+  document.getElementById("tsmeta").textContent =
+    (r.meta.n_series||0) + " series, " +
+    ((r.meta.memory_bytes||0)/1024).toFixed(0) + " KiB retained";
+}
 document.getElementById("logsel").addEventListener("change", refreshLogs);
 refresh(); setInterval(refresh, 2000);
 refreshLogs(); setInterval(refreshLogs, 3000);
+refreshSparks(); setInterval(refreshSparks, 5000);
 </script></body></html>"""
 
 
@@ -374,6 +422,24 @@ class Dashboard:
                     }
                     for p in h.pgs.values()
                 ]
+            )
+        if path == "/api/timeseries":
+            # metrics-plane history: the head's retention store (ring
+            # buffers, two tiers), counter→rate derivable server-side
+            ts = h.timeseries
+            if ts is None:
+                return self._json({"series": {}, "meta": {"disabled": True}})
+            names = params.get("names")
+            return self._json(
+                {
+                    "series": ts.query(
+                        names=names.split(",") if names else None,
+                        prefix=params.get("prefix") or None,
+                        tier=int(params.get("tier", 0)),
+                        rate=params.get("rate") in ("1", "true"),
+                    ),
+                    "meta": ts.meta(),
+                }
             )
         if path == "/api/logplane":
             # log-plane counter snapshot: capture-side aggregates from the

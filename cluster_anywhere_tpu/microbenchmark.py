@@ -6,6 +6,7 @@ against BASELINE.md's published table.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Dict, List, Tuple
 
@@ -638,6 +639,109 @@ def run_owner_plane(quick: bool = False) -> List[Tuple[str, float, str]]:
         cluster.restart_head()
     finally:
         cluster.shutdown()
+    return results
+
+
+def run_metrics_plane(quick: bool = False) -> List[Tuple[str, float, str]]:
+    """`ca microbenchmark --metrics-plane`: A/B the metrics plane.  With the
+    plane ON, agent-node workers ship metric deltas to their node agent
+    (piggybacked head-ward on node_sync) and Prometheus scrapes the agents'
+    HTTP endpoints — a scrape costs the head ZERO RPCs.  With it OFF, every
+    worker reports straight to the head each flush and a scrape is a
+    `metrics_snapshot` head RPC.  The structural rows are head metrics-RPC
+    traffic per scrape in each mode; the final phase kills the head and
+    shows the node endpoint still serving exposition text (scrape survives
+    a dead head)."""
+    import urllib.request
+
+    from .cluster_utils import Cluster
+    from .core import api as ca
+    from .core.config import CAConfig
+    from .core.worker import global_worker
+
+    results: List[Tuple[str, float, str]] = []
+
+    def record(name: str, value: float, unit: str):
+        results.append((name, value, unit))
+        print(f"{name}: {value:,.2f} {unit}")
+
+    n_scrapes = 5 if quick else 20
+    scrape_gap = 0.25  # leaves room for flush ticks between scrapes
+
+    def node_scrape(cluster, nid: str) -> str:
+        addr = open(
+            os.path.join(cluster.session_dir, "nodes", nid, "metrics.addr")
+        ).read().strip()
+        with urllib.request.urlopen(addr + "/metrics", timeout=10) as r:
+            return r.read().decode()
+
+    def workload(plane_on: bool):
+        cfg = CAConfig()
+        cfg.metrics_plane = plane_on
+        cluster = Cluster(head_resources={"CPU": 1}, config=cfg)
+        nid = cluster.add_node(num_cpus=2)
+        cluster.connect()
+        try:
+            @ca.remote
+            def noisy(i):
+                from cluster_anywhere_tpu.util.metrics import Counter
+
+                Counter("mb_metricsplane_total", "a/b traffic source").inc()
+                return i
+
+            ca.get([noisy.remote(i) for i in range(40)], timeout=120)
+            time.sleep(2.0)  # a couple of flush ticks settle the pipeline
+            w = global_worker()
+            rc0 = w.head_call("stats")["rpc_counts"]
+            for _ in range(n_scrapes):
+                if plane_on:
+                    text = node_scrape(cluster, nid)
+                    assert "ca_node_agent" in text
+                else:
+                    w.head_call("metrics_snapshot")
+                time.sleep(scrape_gap)
+            rc1 = w.head_call("stats")["rpc_counts"]
+            per_scrape = {
+                m: (rc1.get(m, 0) - rc0.get(m, 0)) / n_scrapes
+                for m in ("metrics_snapshot", "metrics_report")
+            }
+            return per_scrape, cluster, nid
+        except BaseException:
+            cluster.shutdown()
+            raise
+
+    per_on, cluster_on, nid_on = workload(True)
+    record(
+        "metrics plane head snapshot RPCs/scrape (node scrape)",
+        per_on["metrics_snapshot"], "ops",
+    )
+    record(
+        "metrics plane head report RPCs/scrape (node scrape)",
+        per_on["metrics_report"], "ops",
+    )
+    # --- scrape with the head DOWN (the plane's reason to exist) ----------
+    try:
+        cluster_on.kill_head()
+        time.sleep(0.5)
+        text = node_scrape(cluster_on, nid_on)
+        ok = 1.0 if ("ca_node_agent_scrapes_total" in text and "# TYPE" in text) else 0.0
+        record("metrics plane scrape with head down (1=ok)", ok, "")
+        cluster_on.restart_head()
+    finally:
+        cluster_on.shutdown()
+
+    per_off, cluster_off, _ = workload(False)
+    try:
+        record(
+            "metrics plane head snapshot RPCs/scrape (head RPC)",
+            per_off["metrics_snapshot"], "ops",
+        )
+        record(
+            "metrics plane head report RPCs/scrape (head RPC)",
+            per_off["metrics_report"], "ops",
+        )
+    finally:
+        cluster_off.shutdown()
     return results
 
 

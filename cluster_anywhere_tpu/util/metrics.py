@@ -176,9 +176,44 @@ def _logplane_records() -> List[dict]:
 # drained-but-unsent records: a send that fails after the drain (head closed
 # or unreachable in the window between drain and notify) re-stages its batch
 # here instead of losing the deltas; the next flush ships them first so
-# counter order is preserved at the head aggregator
+# counter order is preserved at the head aggregator.  BOUNDED: a long outage
+# with a chatty process would otherwise grow this without limit — at the cap
+# the oldest deltas drop (counted in ca_metrics_dropped_total, warned once
+# per period) because fresh deltas carry the live picture an operator needs.
 _restage_lock = threading.Lock()
 _restaged: List[dict] = []
+RESTAGE_CAP = 10_000  # records; ~a few MB worst case
+
+# the metrics plane's own health counters (shipped like every module dict)
+METRICS_STATS = {"dropped_total": 0, "agent_shipped": 0, "head_shipped": 0}
+_metrics_shipped: Dict[str, int] = {}
+_METRICS_DESCS = {
+    "dropped_total": "metric delta records dropped at the bounded re-stage buffer",
+    "agent_shipped": "metric delta records shipped to this node's agent",
+    "head_shipped": "metric delta records shipped directly to the head",
+}
+
+
+def _metrics_records() -> List[dict]:
+    return _counter_deltas("ca_metrics_", METRICS_STATS, _metrics_shipped, _METRICS_DESCS)
+
+
+def _restage(batch: List[dict]) -> None:
+    """Re-stage an unsent batch, enforcing the cap (drop-oldest)."""
+    with _restage_lock:
+        _restaged.extend(batch)
+        over = len(_restaged) - RESTAGE_CAP
+        if over > 0:
+            del _restaged[:over]
+            METRICS_STATS["dropped_total"] += over
+    if over > 0:
+        from ..core.ownership import warn_ratelimited
+
+        warn_ratelimited(
+            "metrics-restage-cap",
+            f"metrics re-stage buffer full: dropped {over} oldest delta "
+            f"records (head/agent unreachable too long)",
+        )
 
 # samplers run at the top of every flush (e.g. jax device-memory gauges);
 # registered via register_flush_hook
@@ -190,13 +225,34 @@ def register_flush_hook(fn: Callable[[], None]) -> None:
     _flush_hooks.append(fn)
 
 
+def _agent_ship_addr() -> Optional[str]:
+    """This process's node-agent metrics sink, when the metrics plane is on.
+    Agent-spawned workers carry CA_AGENT_ADDR; head-node workers and drivers
+    have no agent and keep the direct head path."""
+    from ..core.config import get_config
+
+    if not getattr(get_config(), "metrics_plane", True):
+        return None
+    import os
+
+    return os.environ.get("CA_AGENT_ADDR") or None
+
+
 def flush_once():
-    """Ship pending deltas to the head (called by the background flusher; also
-    directly from tests for determinism)."""
+    """Ship pending deltas (called by the background flusher; also directly
+    from tests for determinism).  Metrics-plane routing: workers with a node
+    agent ship to IT (the agent aggregates the node table for head-free
+    Prometheus scrape and piggybacks the deltas onto its node_sync ticks);
+    everyone else ships straight to the head.  The agent path works with the
+    head DOWN — that is the point."""
     from ..core.worker import try_global_worker
 
     w = try_global_worker()
-    if w is None or w.head is None or w.head.closed:
+    if w is None:
+        return
+    agent_addr = _agent_ship_addr()
+    head_ok = w.head is not None and not w.head.closed
+    if agent_addr is None and not head_ok:
         return
     for hook in list(_flush_hooks):
         try:
@@ -217,23 +273,44 @@ def flush_once():
     batch.extend(_owner_records())
     batch.extend(_drain_records())
     batch.extend(_logplane_records())
+    batch.extend(_metrics_records())
     if not batch:
         return
 
-    def _send():
+    async def _send_agent():
+        try:
+            conn = await w.conn_to(agent_addr)
+            conn.notify("metrics_report", metrics=batch)
+            METRICS_STATS["agent_shipped"] += len(batch)
+        except Exception:
+            # agent unreachable (crashing node): fall back to the head so a
+            # lone agent death doesn't blind the whole node's metrics
+            _send_head()
+
+    def _send_head():
+        if w.head is None or w.head.closed:
+            _restage(batch)
+            return
         try:
             w.head.notify("metrics_report", metrics=batch)
+            METRICS_STATS["head_shipped"] += len(batch)
         except Exception:
             # head died between drain and send: the deltas are already out of
             # the metric objects — re-stage them or they are lost for good
-            with _restage_lock:
-                _restaged.extend(batch)
+            _restage(batch)
+
+    def _send():
+        if agent_addr is not None:
+            from ..core.protocol import spawn_bg
+
+            spawn_bg(_send_agent())
+        else:
+            _send_head()
 
     try:
         w.loop.call_soon_threadsafe(_send)
     except RuntimeError:
-        with _restage_lock:
-            _restaged.extend(batch)
+        _restage(batch)
 
 
 class Metric:
@@ -385,6 +462,46 @@ class Histogram(Metric):
              "tags_key": k, "value": {**v, "bounds": self.bounds}}
             for k, v in pending.items()
         ]
+
+
+# ------------------------------------------------------------- aggregation
+
+
+def merge_metric_records(table: Dict[str, dict], records) -> None:
+    """Merge a batch of delta records into an aggregation table (the shape
+    the head keeps in `self.metrics` and node agents keep per node:
+    name -> {type, desc, data{tags_key: value|hist}}).  Counter deltas add,
+    gauges replace, histogram buckets/sum/count accumulate.  One malformed
+    record must not drop the whole batch."""
+    for m in records or []:
+        try:
+            rec = table.setdefault(
+                m["name"],
+                {"type": m["type"], "desc": m.get("desc", ""), "data": {}},
+            )
+            data = rec["data"]
+            key = m["tags_key"]
+            if m["type"] == "counter":
+                data[key] = data.get(key, 0.0) + m["value"]
+            elif m["type"] == "gauge":
+                data[key] = m["value"]
+            elif m["type"] == "histogram":
+                nbuckets = len(m["value"]["buckets"])
+                cur = data.setdefault(
+                    key, {"buckets": [0] * nbuckets, "sum": 0.0, "count": 0}
+                )
+                if len(cur["buckets"]) < nbuckets:
+                    # same name reported with different boundaries (e.g.
+                    # rolling code change): widen rather than IndexError
+                    cur["buckets"].extend([0] * (nbuckets - len(cur["buckets"])))
+                for i, c in enumerate(m["value"]["buckets"]):
+                    cur["buckets"][i] += c
+                cur["sum"] += m["value"]["sum"]
+                cur["count"] += m["value"]["count"]
+                if len(m["value"]["bounds"]) >= len(cur.get("bounds", [])):
+                    cur["bounds"] = m["value"]["bounds"]
+        except Exception:
+            continue
 
 
 # ---------------------------------------------------------------- inspection

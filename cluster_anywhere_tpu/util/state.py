@@ -183,6 +183,71 @@ def owner_plane() -> Dict[str, Any]:
     }
 
 
+def timeseries(
+    names: Optional[List[str]] = None,
+    *,
+    prefix: Optional[str] = None,
+    tier: int = 0,
+    rate: bool = False,
+) -> Dict[str, Any]:
+    """Metrics-plane history from the head's retention store: ring-buffered
+    series at `tier` 0 (scrape resolution, default 10 s x 360) or 1 (coarse,
+    default 2 min x 360), as {"series": {name: {tags_key: {"kind",
+    "points": [[ts, value], ...]}}}, "meta": {...}}.  `rate=True` derives
+    per-second rates from counter series server-side (gauges pass through).
+    `meta` carries tier shapes, series count, and the store's memory
+    footprint."""
+    return _head(
+        "timeseries", names=names, prefix=prefix, tier=tier, rate=rate
+    )
+
+
+def profile(
+    target: str = "head", *, duration: float = 2.0, hz: float = 100.0
+) -> Dict[str, Any]:
+    """Trigger the in-process sampling profiler on a worker / actor / task /
+    node-agent / the head ("head").  Returns {"target", "node_id", "folded"
+    (flamegraph.pl text), "speedscope" (speedscope.app JSON), "samples",
+    "duration_s"}.  The sampled process keeps serving while the sampler
+    thread reads its stacks."""
+    return _head("profile", id=target, duration=duration, hz=hz)
+
+
+def metrics_plane() -> Dict[str, Any]:
+    """Metrics-plane summary: per-node scrape endpoints, head loop-lag and
+    dispatch-histogram status, retention-store meta, and the plane's own
+    ship/drop counters — the one-call health check for the scrape topology."""
+    from .metrics import get_metrics_snapshot
+
+    ts = _head("timeseries", names=[])
+    snap = {}
+    try:
+        snap = get_metrics_snapshot()
+    except Exception:
+        pass
+    counters: Dict[str, float] = {}
+    for name in (
+        "ca_metrics_dropped_total", "ca_metrics_agent_shipped",
+        "ca_metrics_head_shipped",
+    ):
+        rec = snap.get(name)
+        if rec and rec.get("data"):
+            counters[name] = float(sum(rec["data"].values()))
+    lag = snap.get("ca_head_loop_lag_seconds", {}).get("data", {})
+    dispatch = snap.get("ca_head_dispatch_seconds", {}).get("data", {})
+    return {
+        "scrape_endpoints": {
+            n["node_id"]: n.get("metrics_addr")
+            for n in list_nodes()
+            if n["alive"] and not n.get("is_head_node")
+        },
+        "loop_lag_s": next(iter(lag.values()), None),
+        "dispatch_methods": len(dispatch),
+        "retention": ts.get("meta", {}),
+        "counters": counters,
+    }
+
+
 # ------------------------------------------------------------------ timeline
 
 _PHASE_ORDER = {
@@ -412,6 +477,9 @@ __all__ = [
     "summarize_objects",
     "lease_plane",
     "owner_plane",
+    "metrics_plane",
+    "timeseries",
+    "profile",
     "timeline",
     "get_log",
     "get_log_records",
