@@ -1,0 +1,30 @@
+"""Static analysis for the cluster: `ca lint`.
+
+The wire protocol is schema-by-convention — handlers dispatch on a string
+method name (`head._handle` does `getattr(self, "_h_" + m)`), call sites name
+methods as string literals, and handlers read `msg["field"]` — so nothing in
+the type system catches a typo'd method, a field nobody sends, or a handler no
+caller reaches.  The reference gets all of that for free from protobuf
+(`src/ray/protobuf/*.proto`); we get it from this package instead: a stdlib
+`ast` analyzer with two passes.
+
+Pass 1 (contract.py + rpc_rules.py) extracts every RPC handler table and every
+call site into a machine-readable contract (docs/PROTOCOL_CONTRACT.json) and
+cross-checks them: unknown methods, dead handlers, required-but-unsent fields,
+sent-but-unread fields.
+
+Pass 2 (async_rules.py) audits the event-loop code: blocking calls inside
+`async def`, fire-and-forget `create_task`/`ensure_future` whose failures
+would vanish, and read-modify-write of shared state split across an `await`.
+
+Findings flow through a checked-in baseline (analysis/baseline.json): accepted
+pre-existing findings don't fail CI, new findings do, and baseline entries
+whose code no longer exists fail too — the baseline only shrinks.  Intentional
+dynamics are annotated in source with `# ca-lint: ignore[rule]` pragmas, which
+beat baseline entries (visible at the site, not in a side file).
+
+No dependencies beyond the standard library: the analyzer must run anywhere
+the repo checks out, including CI images without the runtime deps.
+"""
+
+from .engine import Finding, run_lint  # noqa: F401

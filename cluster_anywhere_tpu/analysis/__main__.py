@@ -1,0 +1,7 @@
+"""`python -m cluster_anywhere_tpu.analysis` == `ca lint`."""
+
+import sys
+
+from .lint import main
+
+sys.exit(main())

@@ -657,6 +657,18 @@ def cmd_debug(args):
         ca.shutdown()
 
 
+def cmd_lint(args):
+    """Static analysis over this checkout (no cluster needed): `ca lint`,
+    `ca lint --update-baseline`, `ca lint --contract`, `ca lint --format
+    json` — see cluster_anywhere_tpu/analysis/."""
+    from cluster_anywhere_tpu.analysis.lint import main as lint_main
+
+    rest = args.rest
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    raise SystemExit(lint_main(rest))
+
+
 def cmd_dashboard(args):
     """Print the running cluster's dashboard URL."""
     import os
@@ -721,6 +733,13 @@ def cmd_microbenchmark(args):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv[:1] == ["lint"]:
+        # hand the whole tail to the lint parser: argparse REMAINDER would
+        # reject leading option tokens (`ca lint --format json`)
+        from cluster_anywhere_tpu.analysis.lint import main as lint_main
+
+        raise SystemExit(lint_main(argv[1:]))
     p = argparse.ArgumentParser(prog="ca", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -891,6 +910,14 @@ def main(argv=None):
         help="append frames instead of clearing the screen (pipes/logs)",
     )
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser(
+        "lint",
+        help="static analysis: RPC contract checker + asyncio hazard "
+        "analyzer (see `ca lint --help`)",
+    )
+    sp.add_argument("rest", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("debug", help="attach to a remote breakpoint (rpdb)")
     addr(sp)

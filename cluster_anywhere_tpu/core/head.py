@@ -1905,7 +1905,7 @@ class Head:
             "client_addr", "lease_dir",
             "list_actors", "list_workers", "list_task_events", "list_objects",
             "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
-            "get_actor", "subscribe", "publish", "task_events", "metrics_report",
+            "get_actor", "task_events", "metrics_report",
             "log_sub", "log_batch", "log_fetch", "timeseries", "profile",
         }
     )
@@ -2000,6 +2000,12 @@ class Head:
         self.subscribers.setdefault(f"shm_free:{client_id}", []).append(state["writer"])
         if role == "driver":
             self._driver_clients.add(client_id)
+            # actor address pubs (create/restart) keep the driver's
+            # _actor_addr_cache warm.  Subscribed here, server-side, like the
+            # shm_free channel: `ca lint` found the old client-side
+            # `subscribe` RPC had no caller, so these pubs fanned out to
+            # nobody and every driver paid a get_actor refresh per restart
+            self.subscribers.setdefault("actors", []).append(state["writer"])
         self._departed_clients.pop(client_id, None)  # it's back: not dead
         if msg.get("addr") or msg.get("addr_tcp"):
             self.client_addrs[client_id] = {
@@ -2475,12 +2481,9 @@ class Head:
             reply(blob=blob)
 
     # pubsub ---------------------------------------------------------------
-    async def _h_subscribe(self, state, msg, reply, reply_err):
-        self.subscribers.setdefault(msg["ch"], []).append(state["writer"])
-        reply()
-
-    async def _h_publish(self, state, msg, reply, reply_err):
-        self._pub(msg["ch"], msg.get("data"))
+    # (the old `subscribe`/`publish` RPC handlers are gone: no call site ever
+    # existed — rpc-dead-handler — and client-facing pubsub happens by
+    # server-side subscription at register: shm_free:<cid> and `actors`)
 
     # log plane -------------------------------------------------------------
     async def _h_log_sub(self, state, msg, reply, reply_err):
@@ -2990,6 +2993,9 @@ class Head:
         (local_object_manager.h spill).  The old shm slice is reclaimed
         immediately when nothing holds a zero-copy view of it; otherwise the
         reclaim waits for the last pin to drop."""
+        self.stats["objects_spilled_bytes"] = (
+            self.stats.get("objects_spilled_bytes", 0) + int(msg.get("size") or 0)
+        )
         if msg.get("decided"):
             # ownership plane: the OWNER already made the free-now-vs-defer
             # call against its ledger's pin state; this notify just keeps
@@ -3717,6 +3723,13 @@ class Head:
         # drop this client's pubsub channel and its holder entries (incl. the
         # "<cid>#v" value pins) so departed readers can't pin objects forever
         self.subscribers.pop(f"shm_free:{cid}", None)
+        writer = state.get("writer")
+        if writer is not None:
+            # departed drivers leave the broadcast channels (`actors`), or
+            # the lists grow a dead writer per driver lifetime
+            for subs in self.subscribers.values():
+                if writer in subs:
+                    subs.remove(writer)
         pin_id = f"{cid}#v"
         transit_prefix = f"t:{cid}:"
         # cnt:<cid>: containment edges die with the client too — its
@@ -4043,10 +4056,15 @@ class Head:
             )
         except Exception as e:
             self._log_event("dashboard_failed", error=repr(e))
-        monitor = asyncio.ensure_future(self._monitor_loop())
-        persister = asyncio.ensure_future(self._persist_loop())
-        log_tail = asyncio.ensure_future(self._log_tail_loop())
-        loop_lag = asyncio.ensure_future(self._loop_lag_loop())
+        # named + exception-logged: a dead monitor/persist loop is a head
+        # that stops detecting node death or persisting state — it must
+        # warn the moment it dies, not at GC time
+        from ..util.aio import spawn_logged
+
+        monitor = spawn_logged(self._monitor_loop(), "head-monitor")
+        persister = spawn_logged(self._persist_loop(), "head-persist")
+        log_tail = spawn_logged(self._log_tail_loop(), "head-log-tail")
+        loop_lag = spawn_logged(self._loop_lag_loop(), "head-loop-lag")
         # readiness marker for the driver — atomic rename: a reader must
         # never observe the file existing but empty (the pid parse treats
         # that as a dead cluster and refuses to connect)

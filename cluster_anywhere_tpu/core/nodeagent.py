@@ -436,6 +436,7 @@ class NodeAgent:
             )
         elif m == "node_shutdown":
             self._shutdown.set()
+        # operator liveness probe: ca-lint: ignore[rpc-dead-handler]
         elif m == "ping":
             reply(node_id=self.node_id, n_workers=len(self.procs))
         else:
@@ -684,7 +685,9 @@ class NodeAgent:
             if not records:
                 continue
             try:
-                self.head.notify("log_batch", node_id=self.node_id, records=records)
+                # records carry their own node stamp; a top-level node_id
+                # was wire bytes nothing read (ca lint rpc-unread-field)
+                self.head.notify("log_batch", records=records)
             except Exception:
                 LOG_STATS["dropped_total"] += len(records)
 

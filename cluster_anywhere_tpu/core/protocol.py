@@ -114,13 +114,39 @@ def spawn_bg(coro) -> asyncio.Task:
 
 
 class RpcChaos:
-    """Counts down per-method failure budgets from config.testing_rpc_failure."""
+    """Counts down per-method failure budgets from config.testing_rpc_failure.
+
+    Method names are validated against the generated RPC contract
+    (docs/PROTOCOL_CONTRACT.json, `ca lint --contract`) at parse time: a
+    typo'd method in a chaos spec used to simply never fire — the test
+    "passed" while injecting nothing.  Unknown names now raise immediately.
+    """
 
     def __init__(self, spec: str):
         self._budget: Dict[str, int] = {}
         for part in filter(None, (spec or "").split(",")):
             method, _, n = part.partition("=")
             self._budget[method.strip()] = int(n or 1)
+        if self._budget:
+            self._validate_methods()
+
+    def _validate_methods(self) -> None:
+        from ..analysis.contract import load_contract  # lazy: cold path only
+
+        doc = load_contract()
+        if doc is None:
+            return  # no checked-out contract (installed package): best effort
+        known = set(doc.get("methods") or ())
+        if not known:
+            return
+        unknown = sorted(set(self._budget) - known)
+        if unknown:
+            raise ValueError(
+                f"CA_TESTING_RPC_FAILURE names unknown RPC method(s) "
+                f"{unknown}: not in the extracted protocol contract "
+                f"({len(known)} methods; regenerate with `ca lint "
+                f"--contract` if the protocol changed)"
+            )
 
     def maybe_fail(self, method: str):
         left = self._budget.get(method)

@@ -920,10 +920,19 @@ class Worker:
         if ch == "actors":
             data = msg.get("data") or {}
             aid = data.get("actor_id")
-            if aid and data.get("addr") and not self.client_mode:
+            if (
+                aid and data.get("addr") and not self.client_mode
+                and data.get("state") == "alive"
+            ):
                 # remote clients can't use pub'd (unix) addrs; they refresh
-                # through get_actor, which maps to the TCP dual
+                # through get_actor, which maps to the TCP dual.  Only an
+                # alive incarnation may land in the cache: dead/restarting
+                # pubs can still carry the old worker's addr
                 self._actor_addr_cache[aid] = (data["addr"], data.get("incarnation", 0))
+            elif aid and data.get("state") in ("restarting", "dead"):
+                # drop the stale route immediately instead of waiting for a
+                # failed dial to trigger the get_actor refresh
+                self._actor_addr_cache.pop(aid, None)
         elif ch == f"shm_free:{self.client_id}":
             data = msg.get("data") or {}
             name = data.get("shm_name")
@@ -1737,6 +1746,7 @@ class Worker:
                     msg["data"], msg["shape"], msg["dtype"],
                 )
                 reply()
+            # operator liveness probe: ca-lint: ignore[rpc-dead-handler]
             elif m == "ping":
                 reply(worker_id=self.client_id)
             else:
@@ -2962,9 +2972,12 @@ class Worker:
                     freed += size
                     continue
                 # the registry learns asynchronously (snapshot/pull routing)
+                # (no `freed` field: the head never read it — the owner's
+                # ledger is the pin authority, the registry only needs the
+                # path; ca lint rpc-unread-field)
                 self._notify_threadsafe(
                     "obj_spilled", oid=oid_b, path=path, size=size,
-                    decided=True, freed=not pinned,
+                    decided=True,
                 )
                 if pinned:
                     # memory comes back on the last value-pin drop

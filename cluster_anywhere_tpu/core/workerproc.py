@@ -887,11 +887,10 @@ class WorkerProcess:
                 samples=res["samples"],
                 duration_s=res["duration_s"],
             )
+        # operator liveness probe (BlockingClient / manual socket debugging):
+        # ca-lint: ignore[rpc-dead-handler]
         elif m == "ping":
             reply(worker_id=self.worker_id, actor=self.actor.actor_id if self.actor else None)
-        elif m == "actor_shutdown":
-            reply()
-            await self._graceful_exit()
         elif m == "cancel":
             self._h_cancel_task(msg)
             reply()

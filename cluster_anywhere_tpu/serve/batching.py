@@ -12,6 +12,8 @@ import asyncio
 import functools
 from typing import Any, Callable, List, Optional
 
+from ..util.aio import spawn_logged
+
 
 class _BatchQueue:
     def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
@@ -39,7 +41,9 @@ class _BatchQueue:
         if not self.queue:
             return
         batch, self.queue = self.queue, []
-        asyncio.get_running_loop().create_task(self._run(batch))
+        # _run settles every batch future itself; spawn_logged guards the
+        # residual failure modes (a fut.set_* race) from vanishing silently
+        spawn_logged(self._run(batch), "serve-batch-run")
 
     async def _run(self, batch):
         items = [item for item, _ in batch]

@@ -13,6 +13,8 @@ import traceback
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
+from ..util.aio import spawn_logged
+
 
 class Request:
     """What ingress callables receive for HTTP requests (a compact stand-in
@@ -136,7 +138,7 @@ class ProxyActor:
             except Exception:
                 pass
             return
-        asyncio.get_running_loop().create_task(self._dispatch(req, writer))
+        spawn_logged(self._dispatch(req, writer), "serve-proxy-dispatch")
 
     # request-size guards (ADVICE r1: unbounded header/body reads let a
     # client exhaust proxy memory); generous defaults, overridable per proxy

@@ -1,0 +1,53 @@
+"""Fire-and-forget asyncio tasks that can't vanish or fail silently.
+
+The event loop holds only weak references to tasks: a bare
+`create_task`/`ensure_future` whose result is dropped can be garbage-
+collected mid-flight, and an exception it raises is parked on the Task until
+GC prints "Task exception was never retrieved" — minutes later, with no
+context.  `ca lint`'s async-dropped-task rule flags such sites; this is the
+helper they should use instead.
+
+spawn_logged(coro, name) pins the task in a process-global set, names it
+(visible in `ca profile` stacks and asyncio debug), and logs any exception
+through the ownership plane's rate-limited warner with the given name — so a
+crashed background loop is one grep away instead of silent.
+
+Distinct from core.protocol.spawn_bg, which pins but deliberately does not
+log: the protocol dispatch path wraps every handler in its own try/except
+and reports errors to the peer, so a second report there would be noise.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+_tasks: set = set()
+
+
+def spawn_logged(coro, name: str) -> "asyncio.Task":
+    """Schedule `coro` as a named, pinned task whose exception (if any) is
+    logged instead of parked.  Returns the Task for callers that also want
+    to cancel/await it; dropping the return value is safe."""
+    task = asyncio.ensure_future(coro)
+    try:
+        task.set_name(f"ca:{name}")
+    except AttributeError:  # pragma: no cover - py<3.8
+        pass
+    _tasks.add(task)
+    task.add_done_callback(lambda t: _reap(t, name))
+    return task
+
+
+def _reap(task: "asyncio.Task", name: str) -> None:
+    _tasks.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()  # marks the exception retrieved
+    if exc is None:
+        return
+    from ..core.ownership import warn_ratelimited  # lazy: avoid import cycle
+
+    warn_ratelimited(
+        f"task-{name}",
+        f"background task {name!r} died: {exc!r}",
+    )

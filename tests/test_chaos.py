@@ -123,14 +123,14 @@ def test_rpc_chaos_counts_logical_sends_inside_batch_envelopes(tmp_path):
         srv = P.Server(path, handler)
         await srv.start()
         conn = await P.connect_addr(path)
-        reset_rpc_chaos("blip=3")
+        reset_rpc_chaos("kv_put=3")
         batch_before = P.WIRE_STATS["batch_frames_sent"]
         failed = 0
         # one synchronous burst: everything that survives chaos is corked
         # into a single envelope flushed on the next loop iteration
         for i in range(10):
             try:
-                conn.notify("blip", seq=i)
+                conn.notify("kv_put", seq=i)
             except ConnectionError:
                 failed += 1
         deadline = asyncio.get_running_loop().time() + 5
@@ -141,7 +141,7 @@ def test_rpc_chaos_counts_logical_sends_inside_batch_envelopes(tmp_path):
         # the 7 survivors shared envelope frames (proves they were batched)
         assert P.WIRE_STATS["batch_frames_sent"] > batch_before
         # the budget is spent: later sends of the method go through
-        conn.notify("blip", seq=99)
+        conn.notify("kv_put", seq=99)
         while len(got) < 8 and asyncio.get_running_loop().time() < deadline:
             await asyncio.sleep(0.01)
         assert got[-1]["seq"] == 99
