@@ -28,7 +28,24 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .engine import Finding
+from .engine import Finding, dotted_name as _dotted
+
+RULES = {
+    "async-blocking-call": (
+        "a synchronous blocking call (time.sleep, subprocess, socket dials, "
+        "unawaited .wait()/.result()) inside async def stalls every "
+        "connection the event loop serves"
+    ),
+    "async-dropped-task": (
+        "create_task/ensure_future whose Task is dropped at statement level "
+        "can be GC'd mid-flight and parks its exception — use "
+        "util.aio.spawn_logged or hold the Task"
+    ),
+    "async-await-race": (
+        "read-modify-write of self.* state split across an await: another "
+        "task can interleave between the read and the write"
+    ),
+}
 
 # module.attr callables that block the loop outright
 _BLOCKING_DOTTED = {
@@ -54,17 +71,6 @@ _BLOCKING_METHODS_UNAWAITED = {
 _SPAWN_NAMES = {"create_task", "ensure_future"}
 # wrappers that pin the task and guard its exception; calling them bare is fine
 _SAFE_SPAWN_WRAPPERS = {"spawn_bg", "spawn_logged"}
-
-
-def _dotted(node) -> Optional[str]:
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
 
 
 def _self_attr_reads(expr) -> Set[str]:
@@ -135,6 +141,10 @@ def _iter_own_nodes(fn):
     while stack:
         node = stack.pop()
         yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # a nested def that is a DIRECT statement of the body lands on
+            # the stack itself; its body is that function's own concern
+            continue
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
                 continue

@@ -20,6 +20,19 @@ from typing import Dict, Iterable, List, Optional, Tuple
 # directories never scanned (relative path components)
 _SKIP_DIRS = {"__pycache__", ".git", "tests", "build", "dist"}
 
+
+def dotted_name(node) -> Optional[str]:
+    """Flatten `a.b.c` Attribute chains to "a.b.c"; None for anything whose
+    base isn't a plain Name.  Shared by every rule module."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
 # the marker may share a comment with prose ("# operator probe: ca-lint: …")
 PRAGMA_RE = re.compile(r"#.*?ca-lint:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
 
@@ -75,10 +88,19 @@ class SourceFile:
 
     def suppressed(self, finding: Finding) -> bool:
         """A pragma on the finding's line (or the line above it, for sites
-        too long to carry a trailing comment) suppresses matching rules."""
-        for ln in (finding.line, finding.line - 1):
+        too long to carry a trailing comment) suppresses matching rules.
+        Findings anchored at a decorated `def` climb the decorator stack so
+        a pragma above `@decorator` lines still scopes to the def."""
+        def hit(ln: int) -> bool:
             rules = self.pragmas.get(ln)
-            if rules is not None and (not rules or finding.rule in rules):
+            return rules is not None and (not rules or finding.rule in rules)
+
+        if hit(finding.line) or hit(finding.line - 1):
+            return True
+        ln = finding.line - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("@"):
+            ln -= 1
+            if hit(ln):
                 return True
         return False
 
@@ -165,9 +187,34 @@ def baseline_path(root: str) -> str:
     return os.path.join(root, "cluster_anywhere_tpu", "analysis", "baseline.json")
 
 
+# the single pass registry: name -> rule module (each exports check() over
+# the file list — "rpc" over the extracted contract — plus a RULES dict).
+# ALL_PASSES, all_rules(), and run_lint() all derive from this one table.
+_PASS_MODULES = {
+    "rpc": "rpc_rules",
+    "async": "async_rules",
+    "res": "resource_rules",
+    "await": "await_rules",
+    "cancel": "cancel_rules",
+}
+ALL_PASSES = tuple(_PASS_MODULES)
+
+
+def _pass_module(name: str):
+    import importlib
+
+    return importlib.import_module(f".{_PASS_MODULES[name]}", __package__)
+
+
+def all_rules() -> Dict[str, Dict[str, str]]:
+    """pass name -> {rule name -> one-line description}, for `ca lint
+    --rules` and the generated ARCHITECTURE table."""
+    return {name: dict(_pass_module(name).RULES) for name in ALL_PASSES}
+
+
 def run_lint(
     root: Optional[str] = None,
-    passes: Iterable[str] = ("rpc", "async"),
+    passes: Iterable[str] = ALL_PASSES,
     baseline_file: Optional[str] = None,
 ) -> dict:
     """Run the analyzer over the repo.  Returns a report dict:
@@ -176,7 +223,13 @@ def run_lint(
      "new": [Finding...], "stale": [baseline entries...],
      "suppressed": int, "contract": Contract, "ok": bool}
     """
-    from . import async_rules, contract, rpc_rules
+    from . import contract
+
+    passes = tuple(passes)
+    unknown = sorted(set(passes) - set(_PASS_MODULES))
+    if unknown:
+        # a typo'd pass name must not silently run zero checks and pass CI
+        raise ValueError(f"unknown lint pass(es) {unknown}; valid: {ALL_PASSES}")
 
     root = root or default_root()
     files = collect_files(root)
@@ -189,10 +242,10 @@ def run_lint(
             ))
 
     extracted = contract.extract_contract(files)
-    if "rpc" in passes:
-        findings.extend(rpc_rules.check(extracted))
-    if "async" in passes:
-        findings.extend(async_rules.check(files))
+    for name in ALL_PASSES:
+        if name in passes:
+            mod = _pass_module(name)
+            findings.extend(mod.check(extracted if name == "rpc" else files))
 
     by_file = {sf.relpath: sf for sf in files}
     kept: List[Finding] = []

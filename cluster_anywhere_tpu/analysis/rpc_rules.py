@@ -25,6 +25,28 @@ from typing import Dict, List, Set
 from .contract import RESERVED_FIELDS, Contract
 from .engine import Finding
 
+RULES = {
+    "rpc-unknown-method": (
+        "a call site names an RPC method no peer surface handles — a typo'd "
+        "string surfaces only as a runtime reply_err, or as nothing at all"
+    ),
+    "rpc-dead-handler": (
+        "a handler no call site anywhere reaches: dead code, or the caller "
+        "was refactored away unnoticed"
+    ),
+    "rpc-missing-field": (
+        "a literal call site omits a field every handler for the method "
+        "reads unconditionally — a guaranteed KeyError when it fires"
+    ),
+    "rpc-unread-field": (
+        "a literal call site sends a field no handler for the method reads "
+        "— wire bytes for nothing, usually a renamed or half-removed field"
+    ),
+    "parse-error": (
+        "a file under analysis does not parse, so no pass can see it"
+    ),
+}
+
 
 def check(contract: Contract) -> List[Finding]:
     findings: List[Finding] = []

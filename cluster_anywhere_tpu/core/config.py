@@ -87,6 +87,14 @@ class CAConfig:
     # self-drain — running tasks get this long to finish before the deadline
     # kill; actors and sole-copy objects migrate to survivors inside it
     drain_deadline_s: float = 30.0
+    # bounded-IO defaults (util/aio.py): every control-plane dial goes
+    # through aio.dial() with this connect bound — on preemptible VMs a peer
+    # can vanish mid-handshake and an unbounded connect parks the caller
+    # forever; io_timeout_s bounds single request/response reads and
+    # writer drains (NOT persistent-connection read loops, which idle
+    # legitimately)
+    dial_timeout_s: float = 15.0
+    io_timeout_s: float = 60.0
 
     # --- tasks / actors ---
     default_max_retries: int = 3

@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import scheduling
 from .config import CAConfig
 from .errors import ActorDiedError, ObjectStoreFullError, PlacementGroupError
-from .protocol import Connection, Server, connect_addr, spawn_bg, write_frame
+from .protocol import Connection, Server, spawn_bg, write_frame
 
 LOCAL_NODE = "n0"
 
@@ -760,6 +760,8 @@ class Head:
         async def _ask_agent():
             try:
                 await node.conn.call("spawn_worker", wid=wid, purpose=purpose, pool=pool)
+            except asyncio.CancelledError:
+                raise  # head shutdown: not a spawn failure
             except Exception:
                 rec.state = "dead"
                 fut = self._register_waiters.pop(wid, None)
@@ -775,7 +777,9 @@ class Head:
     async def _worker_conn(self, rec: WorkerRec) -> Connection:
         conn = self._worker_conns.get(rec.worker_id)
         if conn is None or conn.closed:
-            conn = await connect_addr(rec.addr)
+            from ..util.aio import dial  # lazy: util/__init__ reaches into core
+
+            conn = await dial(rec.addr, purpose=f"worker {rec.worker_id}")
             self._worker_conns[rec.worker_id] = conn
         return conn
 
@@ -1301,6 +1305,8 @@ class Head:
             self._log_event(
                 "actor_alive", actor_id=a.actor_id, worker_id=a.worker_id, node_id=a.node_id
             )
+        except asyncio.CancelledError:
+            raise  # head shutdown mid-create: not an actor death
         except Exception as e:
             a.state = "dead"
             a.death_cause = f"actor __init__ failed: {e!r}"
@@ -1421,8 +1427,12 @@ class Head:
 
     # ---------------------------------------------------------------- nodes
     async def _connect_agent(self, node: NodeRec):
+        from ..util.aio import dial  # lazy: util/__init__ reaches into core
+
         try:
-            node.conn = await connect_addr(node.addr)
+            node.conn = await dial(node.addr, purpose=f"agent {node.node_id}")
+        except asyncio.CancelledError:
+            raise  # head shutdown: must not declare the node dead
         except Exception as e:
             self._log_event("agent_connect_failed", node_id=node.node_id, error=repr(e))
             await self._on_node_death(node)
@@ -1621,6 +1631,8 @@ class Head:
                 if a.node_id == node.node_id and a.state == "alive":
                     await self._migrate_actor(a, node)
             await self._evacuate_objects(node)
+        except asyncio.CancelledError:
+            raise  # the finally still arms/skips the quiesce check
         except Exception as e:
             self._log_event(
                 "drain_evacuate_failed", node_id=node.node_id, error=repr(e)
@@ -1720,6 +1732,12 @@ class Head:
                         raise ConnectionError("short read evacuating object")
                     f.write(data)
                     off += len(data)
+        except asyncio.CancelledError:
+            try:
+                os.unlink(path)  # don't leak the partial segment either way
+            except OSError:
+                pass
+            raise
         except Exception as e:
             try:
                 os.unlink(path)
@@ -3602,6 +3620,8 @@ class Head:
                 out = await node.conn.call(
                     "profile", duration=duration, hz=hz, timeout=duration + 15
                 )
+            except asyncio.CancelledError:
+                raise
             except Exception as e:
                 reply_err(RuntimeError(f"profile of node {ident!r} failed: {e}"))
                 return
@@ -3633,6 +3653,8 @@ class Head:
             out = await conn.call(
                 "profile", duration=duration, hz=hz, timeout=duration + 15
             )
+        except asyncio.CancelledError:
+            raise
         except Exception as e:
             reply_err(RuntimeError(f"profile of {wid!r} failed: {e}"))
             return
@@ -4054,6 +4076,8 @@ class Head:
                 getattr(self.config, "head_host", "127.0.0.1"),
                 int(os.environ.get("CA_DASHBOARD_PORT", "0")),
             )
+        except asyncio.CancelledError:
+            raise
         except Exception as e:
             self._log_event("dashboard_failed", error=repr(e))
         # named + exception-logged: a dead monitor/persist loop is a head

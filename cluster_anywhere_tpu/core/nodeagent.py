@@ -37,7 +37,7 @@ from typing import Any, Dict, Optional
 from .config import CAConfig, set_config
 from .head import read_shm_chunk
 from .ownership import DeltaReporter, quantize_load
-from .protocol import Server, connect_addr, spawn_bg
+from .protocol import Server, spawn_bg
 
 
 def node_load_sample() -> Dict[str, float]:
@@ -501,7 +501,11 @@ class NodeAgent:
                 f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
             )
             writer.write(body)
-            await writer.drain()
+            from ..util.aio import drain  # lazy: util/__init__ reaches into core
+
+            await drain(writer, timeout=10)
+        except asyncio.CancelledError:
+            raise  # agent shutdown: the finally still closes the socket
         except Exception:
             pass
         finally:
@@ -703,7 +707,9 @@ class NodeAgent:
         if getattr(self.config, "metrics_plane", True):
             # scrape endpoint first: metrics_addr travels in the register
             await self._start_metrics_http()
-        self.head = await connect_addr(self.head_addr)
+        from ..util.aio import dial  # lazy: util/__init__ reaches into core
+
+        self.head = await dial(self.head_addr, purpose="head")
         self.head.set_push_handler(self._on_head_push)
         await self.head.call(
             "register",
@@ -755,6 +761,8 @@ class NodeAgent:
                 "drain_node", node_id=self.node_id, reason="preemption",
                 timeout=5,
             )
+        except asyncio.CancelledError:
+            raise
         except Exception:
             # no head to evacuate through: the warning buys nothing — exit
             # so workers die with the process group, not mid-RPC later
@@ -781,8 +789,11 @@ class NodeAgent:
             elif now - down_since > grace:
                 self._shutdown.set()
                 return
+            conn = None
             try:
-                conn = await connect_addr(self.head_addr)
+                from ..util.aio import dial  # lazy: util/__init__ → core
+
+                conn = await dial(self.head_addr, purpose="head", timeout=5)
                 conn.set_push_handler(self._on_head_push)
                 await conn.call(
                     "register",
@@ -799,12 +810,22 @@ class NodeAgent:
                     metrics_addr=self.metrics_addr,
                     timeout=5,
                 )
+                # the restarted head has no delta state for this node: the
+                # next node_sync must be a full resync.  Reset BEFORE
+                # adopting the connection so a failure here still closes
+                # `conn` below instead of stranding a half-registered head.
+                self.reporter.reset()
                 self.head = conn
                 down_since = None
-                # the restarted head has no delta state for this node: the
-                # next node_sync must be a full resync
-                self.reporter.reset()
+            except asyncio.CancelledError:
+                if conn is not None:
+                    await conn.close()
+                raise  # agent shutdown beats head-watching
             except Exception:
+                if conn is not None:
+                    # registering failed: a leaked half-open socket per retry
+                    # tick adds up fast while the head flaps
+                    await conn.close()
                 await asyncio.sleep(0.5)
 
     def _teardown(self):

@@ -46,6 +46,10 @@ class PlacementGroup:
             try:
                 await w.head.call("pg_wait", pg_id=pg_hex)
                 w.memory_store.put_value(oid, True)
+            except asyncio.CancelledError:
+                # loop shutdown: unblock ref waiters, then stay cancelled
+                w.memory_store.put_error(oid, ConnectionError("pg_wait cancelled"))
+                raise
             except BaseException as e:  # noqa: BLE001 - propagate via the ref
                 w.memory_store.put_error(oid, e)
 

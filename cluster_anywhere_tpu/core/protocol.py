@@ -171,13 +171,24 @@ def reset_rpc_chaos(spec: str = ""):
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    """Read one length-prefixed frame; None on clean EOF.
+
+    Deliberately unbounded: every caller is a persistent-connection read
+    loop (Connection._read_loop, Server._on_client) where waiting forever
+    for the NEXT frame is the correct idle state.  Request/response
+    contexts that must not trust the peer use util.aio.read_frame, which
+    bounds this with config.io_timeout_s."""
     try:
+        # ca-lint: ignore[async-unbounded-io] — persistent read loop (see docstring)
         hdr = await reader.readexactly(4)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     (length,) = _LEN.unpack(hdr)
     if length > MAX_FRAME:
         raise ValueError(f"frame too large: {length}")
+    # body follows the header immediately; a peer that sent 4 length bytes
+    # and then stalls is torn down by the health plane, not a per-read timer
+    # ca-lint: ignore[async-unbounded-io]
     body = await reader.readexactly(length)
     msg = msgpack.unpackb(body, raw=False, strict_map_key=False)
     WIRE_STATS["frames_recv"] += 1
@@ -395,6 +406,8 @@ class Connection:
                             fut.set_result(msg)
                     elif self._on_push is not None:
                         await self._on_push(msg)
+        except asyncio.CancelledError:
+            raise  # close() cancels the read loop; the finally still settles
         except Exception:
             pass
         finally:
@@ -468,17 +481,14 @@ class Connection:
             flush_writer(self.writer)  # corked frames out before the FIN
             self.writer.close()
             await self.writer.wait_closed()
+        except asyncio.CancelledError:
+            raise  # the transport close already went out; don't stall shutdown
         except Exception:
             pass
 
     @property
     def closed(self) -> bool:
         return self._closed
-
-
-async def connect_unix(path: str) -> Connection:
-    reader, writer = await asyncio.open_unix_connection(path)
-    return Connection(reader, writer)
 
 
 def parse_addr(addr: str):
@@ -493,15 +503,28 @@ def parse_addr(addr: str):
 
 async def connect_addr(addr: str) -> Connection:
     """Dial a scheme-prefixed address (TCP_NODELAY on tcp: small RPC frames
-    must not sit in Nagle buffers)."""
+    must not sit in Nagle buffers).
+
+    RAW primitive, deliberately unbounded: production call sites route
+    through util.aio.dial(), which wraps this in asyncio.wait_for with
+    config.dial_timeout_s and counts/warns on timeouts."""
     parsed = parse_addr(addr)
     if parsed[0] == "unix":
+        # ca-lint: ignore[async-unbounded-io] — raw dial primitive (see docstring)
         reader, writer = await asyncio.open_unix_connection(parsed[1])
     else:
+        # ca-lint: ignore[async-unbounded-io] — raw dial primitive (see docstring)
         reader, writer = await asyncio.open_connection(parsed[1], parsed[2])
-        sock = writer.get_extra_info("socket")
-        if sock is not None:
-            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        try:
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except BaseException:
+            # the socket dialed but configuring it failed (or the dial's
+            # wait_for deadline cancelled us right here): don't leak the
+            # transport
+            writer.close()
+            raise
     return Connection(reader, writer)
 
 
@@ -631,7 +654,12 @@ class Server:
             pass
         finally:
             if self.on_disconnect is not None:
-                await self.on_disconnect(state)
+                # masking-safe: a cancelled server task must still run the
+                # disconnect bookkeeping AND close the transport below
+                # (lazy import: util/__init__ reaches back into core)
+                from ..util.aio import finally_await
+
+                await finally_await(self.on_disconnect(state), "on-disconnect")
             try:
                 flush_writer(writer)
                 writer.close()
@@ -653,6 +681,8 @@ class Server:
 
         try:
             await self.handler(state, msg, reply, reply_err)
+        except asyncio.CancelledError:
+            raise  # loop shutdown: don't convert cancellation into a reply
         except Exception as e:  # handler bug: report to client
             reply_err(e)
 

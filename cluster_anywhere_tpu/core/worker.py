@@ -43,7 +43,6 @@ from .protocol import (
     WIRE_STATS,
     Connection,
     MsgTemplate,
-    connect_addr,
     spawn_bg,
 )
 from .ownership import OWNER_STATS, OwnerLedger
@@ -376,6 +375,8 @@ class LeasePool:
                     return
                 await asyncio.sleep(0.5)
                 continue
+            except asyncio.CancelledError:
+                raise  # shutdown: don't convert cancellation into waiter errors
             except Exception as e:
                 # unrecoverable admission errors (e.g. removed placement
                 # group) must surface on the waiting tasks, not spin forever
@@ -481,6 +482,8 @@ class LeasePool:
         async def _dial():
             try:
                 await self.worker.conn_to(lease.addr)
+            except asyncio.CancelledError:
+                raise  # the finally still clears _dialing
             except Exception:
                 lease.dead = True
                 # granter-aware give-back (head or agent); unreachable
@@ -854,7 +857,9 @@ class Worker:
             # server (core_worker.h); without one, every driver-owned ref
             # resolution would fall back to polling the head
             await self._start_p2p_server()
-        self.head = await connect_addr(self.head_sock)
+        from ..util.aio import dial  # lazy: util/__init__ reaches into core
+
+        self.head = await dial(self.head_sock, purpose="head")
         self.head.set_push_handler(self._on_push)
         reply = await self.head.call(
             "register",
@@ -1098,8 +1103,10 @@ class Worker:
         """Redial and re-register with the head (gcs_client_reconnection
         analogue).  Sets _head_fenced if the head refuses us (it declared
         this worker dead — the process must exit, not retry)."""
+        from ..util.aio import dial  # lazy: util/__init__ reaches into core
+
         try:
-            conn = await connect_addr(self.head_sock)
+            conn = await dial(self.head_sock, purpose="head")
         except OSError:
             return False
         conn.set_push_handler(self._on_push)
@@ -1119,10 +1126,13 @@ class Worker:
                 remote=self.client_mode,
                 timeout=5,
             )
+        except asyncio.CancelledError:
+            await conn.close()
+            raise  # shutdown mid-redial: release the socket, stay cancelled
         except Exception as e:
+            await conn.close()  # before anything that could raise (str(e) can)
             if "declared dead" in str(e):
                 self._head_fenced = True
-            await conn.close()
             return False
         self.head = conn
         # the restarted head lost its subscriber table: re-join the stream
@@ -1146,6 +1156,8 @@ class Worker:
         try:
             r = await self.head.call("lease_dir", timeout=5)
             entries = (r.get("nodes") or []) if r.get("delegation", True) else []
+        except asyncio.CancelledError:
+            raise
         except Exception:
             entries = entries or []  # keep stale; back off one TTL either way
         self._lease_dir_cache = (now, entries)
@@ -1173,6 +1185,8 @@ class Worker:
             try:
                 conn = await self.conn_to(ent["addr"])
                 r = await conn.call("lease_grant", pool=pool, timeout=5)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 continue  # agent gone: the head's node-death path reclaims
             blk = (ent.get("pools") or {}).get(pool)
@@ -1429,6 +1443,8 @@ class Worker:
                 raise ConnectionError(f"owner {owner} not dialable")
             conn = await self.conn_to(addr)
             self._notify_owner_refs(conn, wins)
+        except asyncio.CancelledError:
+            raise
         except Exception:
             # owner unreachable or dead: the head is the failover authority
             # (it adopts the owner's ledger from the last synced digest)
@@ -1809,6 +1825,8 @@ class Worker:
                     else e.value
                 )
                 spec = await self._pack_with_transit_async(value, ttl_pin=True)
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 return {"found": False}
             return {"found": True, **spec}
@@ -1888,6 +1906,8 @@ class Worker:
                     addr = reply.get("addr") or reply.get("addr_tcp") or None
                 else:  # cross-node: unix sockets don't travel
                     addr = reply.get("addr_tcp") or reply.get("addr") or None
+        except asyncio.CancelledError:
+            raise
         except Exception:
             addr = None
         self._owner_addr_cache[owner] = (
@@ -1928,7 +1948,9 @@ class Worker:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._connecting[addr] = fut
         try:
-            conn = await connect_addr(addr)
+            from ..util.aio import dial  # lazy: util/__init__ reaches into core
+
+            conn = await dial(addr, purpose="peer")
             conn.set_push_handler(self._on_peer_push)
             self._conns[addr] = conn
             fut.set_result(conn)
@@ -2073,6 +2095,12 @@ class Worker:
 
                 err = pickle.loads(reply["stream_error"])
             st.on_end(err)
+        except asyncio.CancelledError:
+            # unblock consumers before propagating the cancellation — a
+            # swallowed cancel here would hang shutdown, a silent one would
+            # hang the stream's readers
+            st.on_end(TaskError("stream pump cancelled"))
+            raise
         except BaseException as e:
             st.on_end(e if isinstance(e, CAError) else TaskError(repr(e)))
         finally:
@@ -2204,6 +2232,8 @@ class Worker:
                     name, size = await self._client_upload_chunks_async(
                         oid, total, chunks
                     )
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 continue
             e.shm_name = name
@@ -2306,6 +2336,8 @@ class Worker:
                         reply = await owner_conn.call(
                             "owner_locate", oid=oid_b, timeout=10
                         )
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         owner_conn = None
                         if dialing:
@@ -2335,6 +2367,8 @@ class Worker:
                     asked_head = True
                     try:
                         reply = await self.head.call("obj_locate", oid=oid_b)
+                    except asyncio.CancelledError:
+                        raise
                     except Exception:
                         reply = {}
                     if (
@@ -2358,6 +2392,8 @@ class Worker:
                             cr = await self.head.call(
                                 "client_addr", client_id=owner
                             )
+                        except asyncio.CancelledError:
+                            raise
                         except Exception:
                             cr = {}
                         if cr.get("dead"):
@@ -3167,6 +3203,8 @@ class Worker:
                 "owner_transit_done", token=token, oids=oids,
                 cid=self.client_id, register=register,
             )
+        except asyncio.CancelledError:
+            raise
         except Exception:
             # dead owner: the head adopted its ledger — settle there
             self._transit_done_head(token, oids, register)
@@ -3492,6 +3530,11 @@ class Worker:
                 await self.head.call("register_function", fn_id=fn_id, blob=blob)
                 self.fn_manager.mark_exported(fn_id)
             specs, kwspecs = await self._build_args(args, kwargs)
+        except asyncio.CancelledError:
+            # unblock get() waiters, then stay cancelled (a swallowed cancel
+            # here would wedge worker shutdown mid-submission)
+            self._store_error(oids, TaskCancelledError("submission cancelled"))
+            raise
         except BaseException as e:
             self._store_error(oids, e)
             return
@@ -3506,6 +3549,9 @@ class Worker:
         while True:
             try:
                 lease = await pool.acquire()
+            except asyncio.CancelledError:
+                self._store_error(oids, TaskCancelledError("submission cancelled"))
+                raise
             except BaseException as e:
                 self._store_error(oids, e)
                 return
@@ -3837,6 +3883,9 @@ class Worker:
         aid = actor_id.hex()
         try:
             specs, kwspecs = await self._build_args(args, kwargs)
+        except asyncio.CancelledError:
+            self._store_error(oids, TaskCancelledError("submission cancelled"))
+            raise
         except BaseException as e:
             self._store_error(oids, e)
             return
@@ -3998,7 +4047,9 @@ class Worker:
                 task.cancel()
                 try:
                     await task
-                except (asyncio.CancelledError, Exception):
+                # awaiting a task WE just cancelled: its CancelledError is
+                # the expected completion signal, not our own cancellation
+                except (asyncio.CancelledError, Exception):  # ca-lint: ignore[async-swallowed-cancel]
                     pass
             if self.head is not None:
                 await self.head.close()

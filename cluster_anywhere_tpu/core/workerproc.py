@@ -531,7 +531,11 @@ class WorkerProcess:
                             coro_task.cancel()
                         try:
                             value = await coro_task
-                        except asyncio.CancelledError:
+                        # the CancelledError is coro_task's (ca.cancel /
+                        # precancel landed on the CHILD task), not this
+                        # dispatch task's: converting it to the cancel
+                        # protocol's reply is the designed behavior
+                        except asyncio.CancelledError:  # ca-lint: ignore[async-swallowed-cancel]
                             raise TaskCancelledError("task was cancelled")
                         finally:
                             self._async_running.pop(task_id, None)
@@ -576,6 +580,8 @@ class WorkerProcess:
                 except Exception:
                     pass
             return self._error_results(num_returns, TaskError("actor exited via exit_actor()"))
+        except asyncio.CancelledError:
+            raise  # worker shutdown: the peer sees the drop, not a "result"
         except BaseException as e:
             self._record_event(
                 task_id,
@@ -833,11 +839,15 @@ class WorkerProcess:
             try:
                 await self._spawn_actor(msg)
                 reply()
+            except asyncio.CancelledError:
+                raise
             except BaseException as e:
                 reply_err(TaskError(repr(e), traceback.format_exc()))
         elif m == "fetch_object":
             try:
                 reply(packed=await self._fetch_object(msg["oid"]))
+            except asyncio.CancelledError:
+                raise
             except BaseException as e:
                 reply_err(e)
         elif m == "owner_locate":
@@ -935,6 +945,8 @@ class WorkerProcess:
                 else:
                     fn = await self._fetch_function(msg["fn_id"])
             return fn
+        except asyncio.CancelledError:
+            raise
         except BaseException as e:
             err = self._error_results(1, e)[0]["e"]
             return {"results": [], "stream_end": True, "count": 0, "stream_error": err}

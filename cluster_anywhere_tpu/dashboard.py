@@ -278,7 +278,10 @@ class Dashboard:
                         clen = min(int(v.strip()), 1 << 20)
                     except ValueError:
                         clen = 0
-            body = await reader.readexactly(clen) if clen else b""
+            body = (
+                await asyncio.wait_for(reader.readexactly(clen), 10)
+                if clen else b""
+            )
             if method == "POST":
                 status, ctype, resp = self._route_post(path, body)
             elif path.split("?", 1)[0] == "/api/logs":
@@ -288,6 +291,8 @@ class Dashboard:
             else:
                 status, ctype, resp = self._route(path)
             await self._respond(writer, status, ctype, resp)
+        except asyncio.CancelledError:
+            raise  # dashboard shutdown: the finally still closes the socket
         except Exception:
             pass
         finally:
@@ -303,7 +308,9 @@ class Dashboard:
             f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
         )
         writer.write(body)
-        await writer.drain()
+        from .util.aio import drain
+
+        await drain(writer, timeout=10)
 
     def _route(self, path: str):
         if "?" in path:

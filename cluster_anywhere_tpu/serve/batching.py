@@ -57,6 +57,13 @@ class _BatchQueue:
             for (_, fut), out in zip(batch, outs):
                 if not fut.done():
                     fut.set_result(out)
+        except asyncio.CancelledError as e:
+            # fail the waiters, then stay cancelled: swallowing here would
+            # wedge replica shutdown with a batch forever "in flight"
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            raise
         except BaseException as e:
             for _, fut in batch:
                 if not fut.done():

@@ -13,7 +13,7 @@ import traceback
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
-from ..util.aio import spawn_logged
+from ..util.aio import drain, spawn_logged
 
 
 class Request:
@@ -129,6 +129,12 @@ class ProxyActor:
         req = None
         try:
             req = await self._read_request(reader)
+        except asyncio.CancelledError:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            raise  # proxy shutdown: release the socket, stay cancelled
         except Exception:
             pass
         if req is None:
@@ -145,10 +151,14 @@ class ProxyActor:
     MAX_HEADER_LINE = 16 * 1024
     MAX_HEADERS = 128
     MAX_BODY = 64 * 1024 * 1024
+    # ... and a time guard: a client that dials and then goes silent must
+    # not pin a proxy coroutine (and its fd) forever.  TimeoutError rides
+    # the same close-and-drop path as a malformed request.
+    READ_TIMEOUT_S = 30.0
 
     async def _read_request(self, reader) -> Optional[Request]:
         try:
-            line = await reader.readline()
+            line = await asyncio.wait_for(reader.readline(), self.READ_TIMEOUT_S)
         except (asyncio.LimitOverrunError, ValueError):
             return None
         if not line or len(line) > self.MAX_HEADER_LINE:
@@ -161,7 +171,7 @@ class ProxyActor:
         n_lines = 0  # count lines, not dict keys: repeated names must still trip the cap
         while True:
             try:
-                h = await reader.readline()
+                h = await asyncio.wait_for(reader.readline(), self.READ_TIMEOUT_S)
             except (asyncio.LimitOverrunError, ValueError):
                 return None
             if h in (b"\r\n", b"\n", b""):
@@ -179,7 +189,7 @@ class ProxyActor:
         if n < 0 or n > self.MAX_BODY:
             return None
         if n:
-            body = await reader.readexactly(n)
+            body = await asyncio.wait_for(reader.readexactly(n), self.READ_TIMEOUT_S)
         parsed = urlparse(target)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         return Request(method.upper(), unquote(parsed.path), query, headers, body)
@@ -212,6 +222,12 @@ class ProxyActor:
                 None, lambda: handle.remote(req).result(timeout_s=60)
             )
             await self._respond(writer, 200, result)
+        except asyncio.CancelledError:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            raise  # proxy shutdown: don't dress cancellation up as a 500
         except Exception as e:
             traceback.print_exc()
             await self._respond(writer, 500, {"error": repr(e)})
@@ -224,7 +240,7 @@ class ProxyActor:
             b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
             b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
         )
-        await writer.drain()
+        await drain(writer)
         q: _queue.Queue = _queue.Queue(maxsize=64)
         _END = object()
 
@@ -249,7 +265,9 @@ class ProxyActor:
             else:
                 data = _json.dumps(item, default=str)
             writer.write(f"data: {data}\n\n".encode())
-            await writer.drain()
+            # bounded: a consumer that stops reading mid-stream must not pin
+            # this coroutine (and the replica's generator) forever
+            await drain(writer)
         try:
             writer.close()
         except Exception:
@@ -272,8 +290,14 @@ class ProxyActor:
                 f"Content-Length: {len(body)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + body
             )
-            await writer.drain()
+            await drain(writer)
             writer.close()
+        except asyncio.CancelledError:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            raise
         except Exception:
             pass
 

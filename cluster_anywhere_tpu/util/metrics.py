@@ -282,6 +282,8 @@ def flush_once():
             conn = await w.conn_to(agent_addr)
             conn.notify("metrics_report", metrics=batch)
             METRICS_STATS["agent_shipped"] += len(batch)
+        except asyncio.CancelledError:
+            raise  # shutdown: drop the batch rather than re-route it
         except Exception:
             # agent unreachable (crashing node): fall back to the head so a
             # lone agent death doesn't blind the whole node's metrics

@@ -1,0 +1,18 @@
+#!/bin/sh
+# Git pre-push hook: run `ca lint --changed` over the files this branch
+# touches (plus untracked) before anything leaves the machine.  The whole
+# tree is still analyzed (the RPC contract is cross-file); only the reported
+# finding set narrows to your diff, so the loop stays a few seconds.
+#
+# Install (from the repo root):
+#   ln -sf ../../scripts/pre-push.sh .git/hooks/pre-push
+#
+# Bypass for a single push (e.g. landing a lint-rule change that flags
+# pre-existing code you are fixing in the next commit):
+#   git push --no-verify
+set -e
+cd "$(dirname "$0")/.."
+# hooks run from .git/hooks via symlink; fall back to git's toplevel when
+# invoked some other way
+[ -d cluster_anywhere_tpu ] || cd "$(git rev-parse --show-toplevel)"
+exec python3 -m cluster_anywhere_tpu.analysis.lint --changed
