@@ -474,8 +474,34 @@ def _device_probe_ok(timeout_s: Optional[float] = None) -> bool:
         return False
 
 
+def bench_transfer_plane():
+    """The transfer-plane A/B rows (serial vs windowed pull on a latency-
+    injected link, 1 vs 2 sources, f32 vs int8/bf16 quantized ring) as a
+    BENCH-json block, so the trajectory captures the data-plane speedups
+    from this round on.  Quick mode: the structural ratios are the point
+    (speedups, occupancy, head RPCs/object), not absolute MB/s on this
+    noisy host."""
+    from cluster_anywhere_tpu.microbenchmark import run_transfer_plane
+
+    rows = run_transfer_plane(quick=True)
+    out = {}
+    for name, value, _unit in rows:
+        key = (
+            name.replace(" ", "_").replace("(", "").replace(")", "")
+            .replace(",", "").replace("=", "").replace("/", "_per_")
+        )
+        out[key] = round(value, 3)
+    log(f"transferplane: {out}")
+    return out
+
+
 def main():
     _, best_actor, _, logplane, drainplane, ownerplane, metricsplane = bench_core()
+    transferplane = {}
+    try:
+        transferplane = bench_transfer_plane()
+    except Exception as e:
+        log(f"transfer plane bench failed: {e!r}")
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -495,6 +521,8 @@ def main():
         out["ownerplane"] = ownerplane
     if metricsplane:
         out["metricsplane"] = metricsplane
+    if transferplane:
+        out["transferplane"] = transferplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

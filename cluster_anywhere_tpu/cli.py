@@ -285,6 +285,20 @@ def cmd_status(args):
                 print(f"  {k}: {op[k]}")
     except Exception:
         pass  # pre-plane head (rolling upgrade): status stays usable
+    # transfer plane: pull volume, window occupancy, failovers, and the
+    # quantized ring's wire savings — the bulk-byte data plane at a glance
+    try:
+        from .util.state import transfer_plane
+
+        tp = transfer_plane()
+        if tp["counters"].get("pulls") or tp["counters"].get("quant_ops"):
+            print("== transfer plane (cluster-aggregated) ==")
+            for k, v in sorted(tp["counters"].items()):
+                print(f"  {k}: {v}")
+            print(f"  window_occupancy: {tp['window_occupancy']:.2f}")
+            print(f"  objects_transferred: {tp['objects_transferred']}")
+    except Exception:
+        pass
     ca.shutdown()
 
 
@@ -712,6 +726,13 @@ def cmd_microbenchmark(args):
 
         run_metrics_plane(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "transfer", False):
+        # owns its own clusters (serial vs windowed pulls on a latency-
+        # injected link, 1 vs 2 sources, f32 vs int8/bf16 quantized ring)
+        from .microbenchmark import run_transfer_plane
+
+        run_transfer_plane(quick=getattr(args, "quick", False))
+        return
 
     import cluster_anywhere_tpu as ca
 
@@ -959,6 +980,11 @@ def main(argv=None):
         "--metrics-plane", dest="metrics_plane", action="store_true",
         help="node-scrape vs head-RPC metrics A/B: head metric traffic "
         "per scrape + head-down scrape proof",
+    )
+    sp.add_argument(
+        "--transfer", action="store_true",
+        help="bulk-transfer A/B: serial vs windowed pulls (latency-injected "
+        "link), 1 vs 2 sources, f32 vs int8/bf16 quantized ring",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

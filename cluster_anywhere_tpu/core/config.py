@@ -70,6 +70,26 @@ class CAConfig:
     # --- multi-node ---
     head_host: str = "127.0.0.1"  # TCP bind host for the head (cross-host: 0.0.0.0)
     transfer_chunk_bytes: int = 4 * 1024**2  # node-to-node object pull chunk
+    # --- transfer plane (windowed multi-source bulk pulls) ---
+    # pull_chunk RPCs kept in flight per source during a node-to-node object
+    # pull / client upload / head evacuation (1 = the old serial
+    # request-response ping-pong); the same window applies per holder when a
+    # pull fans out across multiple live copies
+    transfer_window: int = 4
+    # when the directory reports several live copies, split the byte range
+    # across them and pull concurrently (failed sources re-assign their
+    # remaining chunks to survivors instead of failing the transfer)
+    transfer_multi_source: bool = True
+    # host collective ring default payload encoding ("" = f32 wire bytes,
+    # untouched default; "int8"/"bf16" = EQuARX-style block-quantized ring).
+    # Per-call allreduce(..., quantize=...) overrides the group default.
+    collective_quantize: str = ""
+    # elements per quantization block (one f32 scale per block on the wire)
+    collective_quant_block: int = 4096
+    # test/bench hook: per-pull_chunk serving delay (seconds) — simulates a
+    # high-latency link so the windowed-pull A/B measures pipelining, not
+    # this host's memcpy speed.  0 = off (production).
+    testing_transfer_delay_s: float = 0.0
     # delta-synced node state (ray_syncer analogue): agents send versioned
     # component deltas (node_sync) instead of full per-tick heartbeats; an
     # idle node's tick is a bare keepalive.  Off = legacy full node_heartbeat.

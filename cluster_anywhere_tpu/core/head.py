@@ -1719,19 +1719,53 @@ class Head:
         path = os.path.join("/dev/shm", name)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         chunk = self.config.transfer_chunk_bytes
-        try:
-            with open(path, "wb") as f:
-                off = 0
-                while off < rec.size:
+        window = max(1, int(getattr(self.config, "transfer_window", 4)))
+        from collections import deque as _deque
+
+        pending = _deque(
+            (off, min(chunk, rec.size - off))
+            for off in range(0, rec.size, chunk)
+        )
+
+        failed: list = []
+
+        async def _lane(fd: int) -> None:
+            # windowed evacuation: drain deadlines are real — the serial
+            # ping-pong wasted most of the window on round-trip latency.
+            # One lane's failure aborts the transfer, so siblings stop at
+            # the flag instead of draining the rest of a doomed object.
+            while pending and not failed:
+                off, ln = pending.popleft()
+                try:
                     r = await node.conn.call(
-                        "pull_chunk", shm_name=src, off=off,
-                        len=min(chunk, rec.size - off), timeout=30,
+                        "pull_chunk", shm_name=src, off=off, len=ln,
+                        timeout=30,
                     )
                     data = r["data"]
-                    if not data:
+                    if len(data) != ln:
                         raise ConnectionError("short read evacuating object")
-                    f.write(data)
-                    off += len(data)
+                except BaseException as e:
+                    failed.append(e)
+                    raise
+                os.pwrite(fd, data, off)  # out-of-order completions are fine
+
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+            try:
+                if rec.size:
+                    os.ftruncate(fd, rec.size)
+                    # return_exceptions: every lane must settle before the
+                    # fd closes (a plain gather leaves siblings pwriting a
+                    # closed fd after the first failure)
+                    results = await asyncio.gather(
+                        *(_lane(fd) for _ in range(min(window, len(pending)))),
+                        return_exceptions=True,
+                    )
+                    for e in results:
+                        if isinstance(e, BaseException):
+                            raise e
+            finally:
+                os.close(fd)
         except asyncio.CancelledError:
             try:
                 os.unlink(path)  # don't leak the partial segment either way
@@ -2926,17 +2960,43 @@ class Head:
         return node.addr if node is not None and node.up else None
 
     def _locate_fields(self, rec: ObjectRec, caller_node: str) -> dict:
+        # every live holder, so a puller can split the byte range across
+        # copies (windowed multi-source pulls).  The primary leads; the
+        # legacy single-source fields stay for mixed-version pullers.
+        # The caller's own copy is never offered as a pull source — if it
+        # were readable the caller would not be asking.
+        sources = []
+        primary_addr = self._pull_addr_for(rec.node_id)
+        if primary_addr is not None:
+            name = rec.shm_name or (
+                f"spill:{rec.spill_path}" if rec.spill_path else None
+            )
+            if name:
+                sources.append(
+                    {"node": rec.node_id, "shm_name": name,
+                     "pull_addr": primary_addr}
+                )
+        for nid, name in rec.copies.items():
+            addr = self._pull_addr_for(nid)
+            if addr is not None and nid != caller_node:
+                sources.append(
+                    {"node": nid, "shm_name": name, "pull_addr": addr}
+                )
         if rec.node_id != caller_node and caller_node in rec.copies:
+            # prefer the caller's local copy — but KEEP the sources list, so
+            # a stale local copy (evicted under the directory's feet) still
+            # fails over to the live remote holders instead of erroring
             return {
                 "found": True, "shm_name": rec.copies[caller_node],
                 "size": rec.size, "owner": rec.owner, "node": caller_node,
-                "pull_addr": None,
+                "pull_addr": None, "sources": sources,
             }
         return {
             "found": True, "shm_name": rec.shm_name, "size": rec.size,
             "owner": rec.owner, "node": rec.node_id,
-            "pull_addr": self._pull_addr_for(rec.node_id),
+            "pull_addr": primary_addr,
             "spill_path": rec.spill_path,
+            "sources": sources,
         }
 
     async def _h_obj_locate(self, state, msg, reply, reply_err):
@@ -3093,6 +3153,11 @@ class Head:
         """Serve a chunk of one of n0's objects for node-to-node transfer
         (object_manager.h chunked push analogue; the head doubles as n0's
         object server since n0 has no agent)."""
+        delay = getattr(self.config, "testing_transfer_delay_s", 0.0)
+        if delay:
+            # test/bench hook: simulated link latency, so the windowed-pull
+            # A/B measures pipelining rather than loopback memcpy speed
+            await asyncio.sleep(delay)
         reply(data=read_shm_chunk(
             self.session_name, self._pull_maps, msg["shm_name"], msg["off"], msg["len"]
         ))

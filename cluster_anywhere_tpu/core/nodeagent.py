@@ -358,6 +358,10 @@ class NodeAgent:
             else:
                 reply(data=data, off=off, node_id=self.node_id)
         elif m == "pull_chunk":
+            delay = getattr(self.config, "testing_transfer_delay_s", 0.0)
+            if delay:
+                # test/bench hook: simulated link latency (see head twin)
+                await asyncio.sleep(delay)
             reply(data=read_shm_chunk(
                 self.session_name, self._pull_maps, msg["shm_name"], msg["off"], msg["len"]
             ))

@@ -92,6 +92,33 @@ def drain_stats() -> Dict[str, int]:
     return dict(DRAIN_STATS)
 
 
+# Transfer-plane counters (shipped as ca_transfer_* by util/metrics).  The
+# bulk-byte data plane: windowed node-to-node object pulls, multi-source
+# range splitting, client-mode uploads, and the quantized collective ring's
+# wire savings.  window_peak_sum / pulls = average per-transfer peak of
+# concurrent pull_chunk RPCs (the structural proof the window is open:
+# serial pulls peak at exactly 1).
+TRANSFER_STATS: Dict[str, int] = {
+    "pulls": 0,                 # node-to-node object transfers completed
+    "bytes_pulled": 0,          # object bytes received over pull_chunk
+    "chunks_pulled": 0,         # pull_chunk responses applied
+    "window_peak_sum": 0,       # sum over pulls of peak in-flight RPCs
+    "sources_used": 0,          # holders that served >=1 chunk, summed
+    "multi_source_pulls": 0,    # pulls that drew from >1 holder
+    "source_failovers": 0,      # sources dropped mid-pull (range re-assigned)
+    "pull_retry_rounds": 0,     # re-locate rounds after every source failed
+    "bytes_uploaded": 0,        # client-mode put bytes streamed to the head
+    "copy_notify_deferred": 0,  # obj_copy notifies queued for re-send
+    "quant_bytes_saved": 0,     # f32-equivalent minus wire bytes, quantized ring
+    "quant_ops": 0,             # quantized collective ops completed
+}
+
+
+def transfer_stats() -> Dict[str, int]:
+    """Snapshot of this process's transfer-plane counters."""
+    return dict(TRANSFER_STATS)
+
+
 def global_worker() -> "Worker":
     if _global_worker is None:
         raise RuntimeError("not initialized — call init() first")
@@ -680,6 +707,11 @@ class Worker:
         # housekeeping once the head is back (lifetime already settled —
         # only the registry record and remote copies remain to clean)
         self._deferred_releases: List[list] = []
+        # obj_copy notifies that found the head down/unreachable: re-sent by
+        # housekeeping so the directory eventually learns about pulled
+        # copies (multi-source pulls split across them; eviction reclaims
+        # them by name)
+        self._deferred_copy_notifies: List[tuple] = []
         self._last_owner_sync = 0.0
         self._last_ledger_sweep = 0.0
         self._last_borrow_prune = 0.0
@@ -1051,6 +1083,28 @@ class Worker:
                     n: t for n, t in self._draining_nodes.items() if t > now
                 }
             self.reference_counter.flush()
+            if (
+                self._deferred_copy_notifies
+                and self.head is not None
+                and not self.head.closed
+            ):
+                # transfer plane: copies the directory missed (notify raced
+                # a head restart).  Dropped-meanwhile copies are skipped —
+                # advertising a freed slice would feed multi-source pulls a
+                # dead source.
+                pend, self._deferred_copy_notifies = (
+                    self._deferred_copy_notifies, [],
+                )
+                for oid_b, name in pend:
+                    if not self.shm_store.is_local(name):
+                        continue
+                    try:
+                        self.head.notify(
+                            "obj_copy", oid=oid_b, node=self.node_id,
+                            shm_name=name,
+                        )
+                    except Exception:
+                        self._deferred_copy_notifies.append((oid_b, name))
             if self.owner_ledger is not None:
                 self._owner_plane_tick(now)
             if (
@@ -1760,6 +1814,7 @@ class Worker:
                 self.coll_deliver(
                     msg["group"], msg["key"], msg["src"],
                     msg["data"], msg["shape"], msg["dtype"],
+                    msg.get("meta"),
                 )
                 reply()
             # operator liveness probe: ca-lint: ignore[rpc-dead-handler]
@@ -1832,17 +1887,20 @@ class Worker:
             return {"found": True, **spec}
         return {"found": False}
 
-    def coll_deliver(self, group: str, key: str, src: int, data, shape, dtype):
+    def coll_deliver(
+        self, group: str, key: str, src: int, data, shape, dtype, meta=None
+    ):
         """Landing half of the p2p collective transport: a peer rank pushed
-        a tensor chunk; wake any coll_wait blocked on it."""
+        a tensor chunk; wake any coll_wait blocked on it.  `meta` rides
+        along untouched (quantized payloads carry their scales/shape there;
+        the transport stays encoding-agnostic)."""
         with self._coll_cond:
-            self._coll_mail[(group, key, int(src))] = (data, tuple(shape), dtype)
+            self._coll_mail[(group, key, int(src))] = (
+                data, tuple(shape or ()), dtype, meta,
+            )
             self._coll_cond.notify_all()
 
-    def coll_wait(self, group: str, key: str, src: int, timeout: float):
-        """Block (rank thread) until the (group, key, src) chunk arrives."""
-        import numpy as _np
-
+    def _coll_take(self, group: str, key: str, src: int, timeout: float):
         deadline = time.monotonic() + timeout
         k = (group, key, int(src))
         with self._coll_cond:
@@ -1853,37 +1911,75 @@ class Worker:
                         f"collective recv timed out waiting for {k}"
                     )
                 self._coll_cond.wait(min(remaining, 1.0))
-            data, shape, dtype = self._coll_mail.pop(k)
+            return self._coll_mail.pop(k)
+
+    def coll_wait(self, group: str, key: str, src: int, timeout: float):
+        """Block (rank thread) until the (group, key, src) chunk arrives."""
+        import numpy as _np
+
+        data, shape, dtype, _meta = self._coll_take(group, key, src, timeout)
         return _np.frombuffer(data, dtype=dtype).reshape(shape)
+
+    def coll_wait_raw(self, group: str, key: str, src: int, timeout: float):
+        """Raw-payload twin of coll_wait: returns (payload bytes, meta dict)
+        without imposing an array interpretation — the quantized collective
+        ring decodes its own wire format."""
+        data, _shape, _dtype, meta = self._coll_take(group, key, src, timeout)
+        return data, (meta or {})
 
     def coll_clear(self, group: str):
         with self._coll_cond:
             for k in [k for k in self._coll_mail if k[0] == group]:
                 del self._coll_mail[k]
 
-    def coll_push_to(
+    def coll_push_start(
         self, addr: str, group: str, key: str, src: int, arr, timeout: float
     ):
         """Sending half: push one tensor chunk directly into a peer rank's
-        mailbox over the worker TCP/unix dual — no head, no object store."""
+        mailbox over the worker TCP/unix dual — no head, no object store.
+        Returns a concurrent future immediately (double-buffered ring
+        pipelining: the caller overlaps this send with its own receive and
+        joins later).  The payload is serialized HERE, on the caller's
+        thread, so the caller may mutate `arr` the moment this returns."""
         import numpy as np
 
         arr = np.ascontiguousarray(arr)
+        return self._coll_send_start(
+            addr, group, key, src, arr.tobytes(), list(arr.shape),
+            str(arr.dtype), None, timeout,
+        )
 
+    def coll_push_raw_start(
+        self, addr: str, group: str, key: str, src: int,
+        payload: bytes, meta: dict, timeout: float,
+    ):
+        """Raw-payload twin of coll_push_start (quantized ring steps)."""
+        return self._coll_send_start(
+            addr, group, key, src, payload, [], "raw", meta, timeout
+        )
+
+    def _coll_send_start(
+        self, addr, group, key, src, data, shape, dtype, meta, timeout
+    ):
         async def _send():
             conn = await self.conn_to(addr)
-            await conn.call(
-                "coll_push",
-                group=group,
-                key=key,
-                src=int(src),
-                data=arr.tobytes(),
-                shape=list(arr.shape),
-                dtype=str(arr.dtype),
-                timeout=timeout,
+            fields = dict(
+                group=group, key=key, src=int(src), data=data,
+                shape=shape, dtype=dtype, timeout=timeout,
             )
+            if meta is not None:
+                fields["meta"] = meta
+            await conn.call("coll_push", **fields)
 
-        self.run_coro(_send(), timeout=timeout)
+        return asyncio.run_coroutine_threadsafe(_send(), self.loop)
+
+    def coll_push_to(
+        self, addr: str, group: str, key: str, src: int, arr, timeout: float
+    ):
+        """Blocking send (broadcast/send paths, where nothing overlaps)."""
+        self.coll_push_start(addr, group, key, src, arr, timeout).result(
+            timeout
+        )
 
     async def _owner_addr_async(self, owner: Optional[str]) -> Optional[str]:
         """Resolve (and cache) the serving address of an object owner.
@@ -2176,28 +2272,68 @@ class Worker:
     def _client_upload_chunks(self, oid: ObjectID, total: int, chunks) -> Tuple[str, int]:
         return self.run_coro(self._client_upload_chunks_async(oid, total, chunks))
 
-    async def _client_upload_chunks_async(
-        self, oid: ObjectID, total: int, chunks
-    ) -> Tuple[str, int]:
-        oid_b = oid.binary()
-        await self.head.call("client_put_begin", oid=oid_b, size=total)
-        limit = self.config.transfer_chunk_bytes
+    def _upload_packets(self, chunks, limit: int):
+        """Yield (off, bytes) packets straight off each chunk's memory: no
+        concat buffer, no O(N^2) drain — one bytes() copy per packet
+        (msgpack needs it) is the only extra traffic."""
         off = 0
         for c in chunks:
-            # windowed sends straight off each chunk's memory: no concat
-            # buffer, no O(N^2) drain — one bytes() copy per packet (msgpack
-            # needs it) is the only extra traffic
             mv = memoryview(c)
             if mv.ndim != 1 or mv.itemsize != 1:
                 mv = mv.cast("B")
             pos = 0
             while pos < len(mv):
                 n = min(limit, len(mv) - pos)
-                await self.head.call(
-                    "client_put_chunk", oid=oid_b, off=off, data=bytes(mv[pos : pos + n])
-                )
+                yield off, bytes(mv[pos : pos + n])
                 off += n
                 pos += n
+
+    async def _client_upload_chunks_async(
+        self, oid: ObjectID, total: int, chunks
+    ) -> Tuple[str, int]:
+        """Client-mode put upload with the transfer window applied: up to
+        config.transfer_window client_put_chunk RPCs stay in flight (each
+        packet carries its offset, so completion order is irrelevant —
+        the head writes them into the mmap'd segment out of order)."""
+        oid_b = oid.binary()
+        await self.head.call("client_put_begin", oid=oid_b, size=total)
+        limit = self.config.transfer_chunk_bytes
+        window = max(1, int(getattr(self.config, "transfer_window", 4)))
+        inflight: set = set()
+        try:
+            for off, data in self._upload_packets(chunks, limit):
+                while len(inflight) >= window:
+                    done, inflight = await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    err = None
+                    for d in done:
+                        # consume EVERY done task's exception (several sends
+                        # can fail in one wait — leaving any unretrieved
+                        # logs 'Task exception was never retrieved'), then
+                        # surface the first
+                        e = None if d.cancelled() else d.exception()
+                        err = err or e
+                    if err is not None:
+                        raise err
+                inflight.add(
+                    asyncio.ensure_future(
+                        self.head.call(
+                            "client_put_chunk", oid=oid_b, off=off, data=data
+                        )
+                    )
+                )
+                TRANSFER_STATS["bytes_uploaded"] += len(data)
+            if inflight:
+                await asyncio.gather(*inflight)
+                inflight = set()
+        except BaseException:
+            for f in inflight:
+                if not f.done():
+                    f.cancel()
+                elif not f.cancelled():
+                    f.exception()  # consumed: no never-retrieved warnings
+            raise
         r = await self.head.call("client_put_seal", oid=oid_b)
         return r["name"], total
 
@@ -2679,15 +2815,32 @@ class Worker:
     # ----------------------------------------------- node-to-node transfer
     async def _ensure_local_shm(self, oid_b: bytes, shm_name: Optional[str] = None, size: int = 0):
         """Make a shm object local to this node, pulling it in chunks from
-        the node holding the primary copy if necessary (the client side of
+        the node(s) holding live copies if necessary (the client side of
         the reference's ObjectManager pull protocol).  Returns (local
         shm_name, size).  Concurrent pulls of the same object share one
-        transfer."""
-        if shm_name is not None and self.shm_store.is_local(shm_name):
-            return shm_name, size
-        fut = self._pulls.get(oid_b)
-        if fut is not None:
-            return await fut
+        transfer; a CANCELLED leader must not poison the surviving waiters
+        — they inherit only the leader's real failures, and retry (becoming
+        the new leader) when the shared future died of cancellation."""
+        while True:
+            if shm_name is not None and self.shm_store.is_local(shm_name):
+                return shm_name, size
+            fut = self._pulls.get(oid_b)
+            if fut is None:
+                break
+            try:
+                # shield: a waiter's own cancellation must not cancel the
+                # SHARED future out from under every other waiter
+                return await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                if fut.cancelled() or (
+                    fut.done()
+                    and isinstance(fut.exception(), asyncio.CancelledError)
+                ):
+                    # the LEADER was cancelled (its getter timed out or its
+                    # task died) — the transfer never completed and never
+                    # really failed.  Loop: take over as the new leader.
+                    continue
+                raise  # WE were cancelled: propagate our own cancellation
         fut = asyncio.get_running_loop().create_future()
         self._pulls[oid_b] = fut
         try:
@@ -2703,6 +2856,28 @@ class Worker:
         finally:
             del self._pulls[oid_b]
 
+    def _pull_sources(self, reply: dict) -> List[dict]:
+        """Dialable holders for a located object: the directory's `sources`
+        list (primary first, then secondary copies), de-duplicated, with a
+        legacy single-source fallback for mixed-version heads.  With
+        transfer_multi_source off only the primary is used."""
+        srcs: List[dict] = []
+        seen = set()
+        for s in reply.get("sources") or ():
+            addr, name = s.get("pull_addr"), s.get("shm_name")
+            if addr and name and (addr, name) not in seen:
+                seen.add((addr, name))
+                srcs.append({"addr": addr, "shm_name": name})
+        if not srcs:
+            name = reply.get("shm_name")
+            if reply.get("spill_path"):
+                name = "spill:" + reply["spill_path"]
+            if name and reply.get("pull_addr"):
+                srcs.append({"addr": reply["pull_addr"], "shm_name": name})
+        if not getattr(self.config, "transfer_multi_source", True):
+            srcs = srcs[:1]
+        return srcs
+
     async def _pull_object(self, oid_b: bytes):
         reply = await self.head.call("obj_locate", oid=oid_b)
         if not reply.get("found"):
@@ -2713,49 +2888,154 @@ class Worker:
         name = reply.get("shm_name")
         if reply.get("spill_path"):
             name = "spill:" + reply["spill_path"]
-        if name is None:
-            raise ObjectLostError(f"object {oid_b.hex()} has no readable location")
-        if self.shm_store.is_local(name):
+        if name is not None and self.shm_store.is_local(name):
             return name, total  # a copy (or local spill file) on this node
-        pull_addr = reply.get("pull_addr")
-        if not pull_addr:
-            raise ObjectLostError(
-                f"object {oid_b.hex()} is on node {reply.get('node')} with no "
-                f"reachable object server"
-            )
+        if name is None and not reply.get("sources"):
+            raise ObjectLostError(f"object {oid_b.hex()} has no readable location")
         oid = ObjectID(oid_b)
         local_name, mv = self.shm_store.create_for_import(oid, total)
         try:
-            conn = await self.conn_to(pull_addr)
-            chunk = self.config.transfer_chunk_bytes
-            off = 0
-            while off < total:
-                n = min(chunk, total - off)
-                r = await conn.call(
-                    "pull_chunk", shm_name=name, off=off, len=n,
-                    timeout=self.config.push_timeout_s,
-                )
-                data = r["data"]
-                if not data:
-                    # short read: size metadata disagrees with the served
-                    # file — fail loudly instead of spinning
-                    raise ObjectLostError(
-                        f"short read pulling {oid_b.hex()}: got 0 bytes at "
-                        f"{off}/{total}"
-                    )
-                mv[off : off + len(data)] = data
-                off += len(data)
+            await self._pull_into(oid_b, mv, total, reply)
         except BaseException:
             mv.release()
             self.shm_store.abort_import(local_name)  # aborted pull: reclaim
             raise
         mv.release()
         self.shm_store.seal_done(local_name)
-        try:
-            self.head.notify("obj_copy", oid=oid_b, node=self.node_id, shm_name=local_name)
-        except Exception:
-            pass
+        self._notify_obj_copy(oid_b, local_name)
         return local_name, total
+
+    async def _pull_into(self, oid_b: bytes, mv, total: int, reply: dict):
+        """Windowed, multi-source chunk transfer into an import arena slice.
+
+        Up to config.transfer_window pull_chunk RPCs stay in flight PER
+        SOURCE (the reference ObjectManager's windowed pull discipline)
+        instead of one serial request-response round-trip at a time, and
+        completed chunks land out of order (each carries its offset).  When
+        the directory reports several live copies, every holder's lanes
+        drain one shared chunk queue, so the byte range splits across
+        sources by throughput.  A failing source re-queues its in-flight
+        chunk and drops out (failover, not fatal); when every source died
+        with chunks left, the object is re-located and the pull resumes —
+        only the missing chunks are re-fetched."""
+        chunk = self.config.transfer_chunk_bytes
+        window = max(1, int(getattr(self.config, "transfer_window", 4)))
+        pending: deque = deque(
+            (off, min(chunk, total - off)) for off in range(0, total, chunk)
+        )
+        inflight = 0
+        peak = 0
+        completed = 0
+        served: set = set()  # sources that landed >= 1 chunk
+        last_err: Optional[BaseException] = None
+
+        async def _lane(src: dict) -> None:
+            nonlocal inflight, peak, completed
+            conn = await self.conn_to(src["addr"])
+            while pending:
+                off, ln = pending.popleft()
+                inflight += 1
+                peak = max(peak, inflight)
+                try:
+                    r = await conn.call(
+                        "pull_chunk", shm_name=src["shm_name"], off=off,
+                        len=ln, timeout=self.config.push_timeout_s,
+                    )
+                    data = r["data"]
+                    if len(data) != ln:
+                        # short read: size metadata disagrees with the
+                        # served file — treat the source as bad
+                        raise ObjectLostError(
+                            f"short read pulling {oid_b.hex()}: got "
+                            f"{len(data)} of {ln} bytes at {off}/{total}"
+                        )
+                except BaseException:
+                    # the chunk is NOT lost: back on the queue for the
+                    # surviving lanes/sources (or the next locate round)
+                    pending.appendleft((off, ln))
+                    raise
+                finally:
+                    inflight -= 1
+                mv[off : off + ln] = data
+                completed += 1
+                TRANSFER_STATS["bytes_pulled"] += ln
+                TRANSFER_STATS["chunks_pulled"] += 1
+                served.add(src["addr"])
+
+        async def _source(src: dict) -> None:
+            nonlocal last_err
+            lanes = min(window, max(1, len(pending)))
+            results = await asyncio.gather(
+                *(_lane(src) for _ in range(lanes)), return_exceptions=True
+            )
+            errs = [e for e in results if isinstance(e, BaseException)]
+            for e in errs:
+                if isinstance(e, asyncio.CancelledError):
+                    raise e
+            if errs:
+                # the source dropped out and its re-queued chunks were (or
+                # will be) re-assigned — failover, whether the survivors
+                # already drained them or a re-locate round picks them up
+                last_err = errs[0]
+                TRANSFER_STATS["source_failovers"] += 1
+
+        stalled = 0
+        rounds = 0
+        while pending:
+            sources = self._pull_sources(reply)
+            if not sources:
+                raise ObjectLostError(
+                    f"object {oid_b.hex()} is on node {reply.get('node')} "
+                    f"with no reachable object server"
+                ) from last_err
+            before = completed
+            # _source never raises except on cancellation, so a plain gather
+            # is a barrier that propagates cancellation and nothing else
+            await asyncio.gather(*(_source(s) for s in sources))
+            if not pending:
+                break
+            rounds += 1
+            stalled = stalled + 1 if completed == before else 0
+            TRANSFER_STATS["pull_retry_rounds"] += 1
+            if stalled >= 3 or rounds >= 16:
+                raise ObjectLostError(
+                    f"pull of {oid_b.hex()} failed after {rounds} rounds "
+                    f"({len(pending)} chunks missing): {last_err!r}"
+                ) from last_err
+            await asyncio.sleep(0.2 * stalled)
+            # every source died mid-transfer: ask the directory again — a
+            # survivor copy / relocated spill can finish the remainder
+            reply = await self.head.call("obj_locate", oid=oid_b)
+            if not reply.get("found"):
+                raise ObjectLostError(
+                    f"object {oid_b.hex()} lost mid-pull "
+                    f"({len(pending)} chunks missing)"
+                ) from last_err
+        TRANSFER_STATS["pulls"] += 1
+        TRANSFER_STATS["window_peak_sum"] += peak if peak else 1
+        TRANSFER_STATS["sources_used"] += len(served)
+        if len(served) > 1:
+            TRANSFER_STATS["multi_source_pulls"] += 1
+
+    def _notify_obj_copy(self, oid_b: bytes, local_name: str) -> None:
+        """Record the freshly pulled copy in the head's directory so later
+        pulls can multi-source from this node.  A failed notify DEFERS for
+        housekeeping re-send (the obj_release idiom) instead of being
+        swallowed: losing it silently meant the head never learned about
+        the copy — invisible to multi-source splitting and never reclaimed
+        by name on eviction."""
+        head = self.head
+        if head is not None and not head.closed:
+            try:
+                head.notify(
+                    "obj_copy", oid=oid_b, node=self.node_id,
+                    shm_name=local_name,
+                )
+                return
+            except Exception:
+                pass
+        TRANSFER_STATS["copy_notify_deferred"] += 1
+        self._deferred_copy_notifies.append((oid_b, local_name))
 
     def ensure_local_shm_blocking(self, oid_b: bytes, shm_name: str, size: int = 0) -> str:
         """Thread-safe blocking wrapper (used by executor threads resolving

@@ -873,9 +873,11 @@ class WorkerProcess:
             reply(**self.worker.serve_owner_pin(msg["oid"], msg["as_id"]))
         elif m == "coll_push":
             # p2p collective transport: land the chunk in the rank mailbox
+            # (meta rides along for quantized payloads — scales, block size)
             self.worker.coll_deliver(
                 msg["group"], msg["key"], msg["src"],
                 msg["data"], msg["shape"], msg["dtype"],
+                msg.get("meta"),
             )
             reply()
         elif m == "profile":

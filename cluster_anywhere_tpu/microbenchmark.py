@@ -745,6 +745,174 @@ def run_metrics_plane(quick: bool = False) -> List[Tuple[str, float, str]]:
     return results
 
 
+def run_transfer_plane(quick: bool = False) -> List[Tuple[str, float, str]]:
+    """`ca microbenchmark --transfer`: A/B the bulk-transfer data plane.
+
+    (1) Serial vs windowed object pulls on a LATENCY-INJECTED link
+    (config.testing_transfer_delay_s per served chunk, so the number
+    measures pipelining, not this host's memcpy speed), with the structural
+    columns — window occupancy (avg per-pull peak in-flight pull_chunk
+    RPCs) and head RPCs per pulled object (must not grow with the window).
+    (2) 1-source vs 2-source pulls of an object with two live copies.
+    (3) f32 vs int8/bf16 quantized host collective ring at 64 MB
+    (effective bytes/s = input bytes reduced per second)."""
+    from .cluster_utils import Cluster
+    from .core import api as ca
+    from .core.config import CAConfig
+    from .core.scheduling_strategies import NodeAffinitySchedulingStrategy
+    from .core.worker import TRANSFER_STATS, global_worker
+
+    results: List[Tuple[str, float, str]] = []
+
+    def record(name: str, value: float, unit: str):
+        results.append((name, value, unit))
+        print(f"{name}: {value:,.2f} {unit}")
+
+    delay = 0.02
+    chunk = 256 * 1024
+    nobj = 2 if quick else 4
+    size = 4 * 1024**2 if quick else 8 * 1024**2
+
+    def pull_bench(window: int, two_sources: bool = False, multi: bool = True):
+        cfg = CAConfig()
+        cfg.transfer_window = window
+        cfg.transfer_chunk_bytes = chunk
+        cfg.testing_transfer_delay_s = delay
+        cfg.transfer_multi_source = multi
+        cluster = Cluster(head_resources={"CPU": 1}, config=cfg)
+        n1 = cluster.add_node(num_cpus=2)
+        n2 = cluster.add_node(num_cpus=2) if two_sources else None
+        cluster.connect()
+        cluster.wait_for_nodes(3 if two_sources else 2)
+        try:
+            @ca.remote
+            def produce(n):
+                import numpy as _np
+
+                return _np.frombuffer(_np.random.bytes(n), dtype=_np.uint8)
+
+            @ca.remote
+            def touch(a):
+                return int(a[0]) + int(a[-1])
+
+            na = NodeAffinitySchedulingStrategy
+            refs = [
+                produce.options(scheduling_strategy=na(n1)).remote(size)
+                for _ in range(nobj)
+            ]
+            ca.wait(refs, num_returns=len(refs), timeout=300)
+            if two_sources:
+                # a consumer on n2 pulls each object once: the directory now
+                # lists two live copies per object
+                ca.get(
+                    [
+                        touch.options(scheduling_strategy=na(n2)).remote(r)
+                        for r in refs
+                    ],
+                    timeout=600,
+                )
+                time.sleep(1.0)  # obj_copy notifies land
+            w = global_worker()
+            rc0 = w.head_call("stats")["rpc_counts"]
+            s0 = dict(TRANSFER_STATS)
+            t0 = time.perf_counter()
+            outs = ca.get(refs, timeout=600)  # the driver pulls each object
+            dt = time.perf_counter() - t0
+            assert len(outs) == nobj and all(o.nbytes == size for o in outs)
+            rc1 = w.head_call("stats")["rpc_counts"]
+            d = {k: TRANSFER_STATS[k] - s0[k] for k in TRANSFER_STATS}
+            head_per_obj = sum(
+                rc1.get(m, 0) - rc0.get(m, 0)
+                for m in ("obj_locate", "obj_pin")
+            ) / nobj
+            occupancy = d["window_peak_sum"] / max(1, d["pulls"])
+            return nobj * size / dt, occupancy, head_per_obj, d
+        finally:
+            cluster.shutdown()
+
+    bps, occ, head_rpc, _ = pull_bench(window=1)
+    record("transfer pull serial (window=1)", bps / 1e6, "MB/s")
+    record("transfer pull serial window occupancy", occ, "rpcs")
+    record("transfer pull serial head RPCs/object", head_rpc, "ops")
+    bps_w, occ_w, head_rpc_w, _ = pull_bench(window=4)
+    record("transfer pull windowed (window=4)", bps_w / 1e6, "MB/s")
+    record("transfer pull windowed window occupancy", occ_w, "rpcs")
+    record("transfer pull windowed head RPCs/object", head_rpc_w, "ops")
+    record("transfer pull windowed speedup", bps_w / bps, "x")
+    bps_1, _, _, d1 = pull_bench(window=4, two_sources=True, multi=False)
+    record("transfer pull 1-source (2 copies live)", bps_1 / 1e6, "MB/s")
+    bps_2, _, _, d2 = pull_bench(window=4, two_sources=True, multi=True)
+    record("transfer pull 2-source (2 copies live)", bps_2 / 1e6, "MB/s")
+    record("transfer pull multi-source speedup", bps_2 / bps_1, "x")
+    record(
+        "transfer pull 2-source pulls drawing from both holders",
+        d2["multi_source_pulls"], "pulls",
+    )
+
+    # --- quantized collective ring (f32 vs int8 vs bf16) ------------------
+    from .parallel import collectives as coll
+
+    owns = not ca.is_initialized()
+    if owns:
+        ca.init(num_cpus=4)
+
+    @ca.remote
+    class Rank(coll.CollectiveActorMixin):
+        def bench(self, nbytes, reps, group, quantize):
+            import numpy as _np
+
+            arr = _np.frombuffer(_np.random.bytes(nbytes), dtype=_np.float32)
+            coll.allreduce(arr, group_name=group, quantize=quantize)  # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = coll.allreduce(arr, group_name=group, quantize=quantize)
+            dt = time.perf_counter() - t0
+            assert out.shape == arr.shape
+            return reps * nbytes / dt
+
+    from .core.actor import kill as _kill
+
+    # quick still runs 32 MB: below ~16 MB the per-hop fixed costs (loop
+    # latency, frame handling) flatten the quantized-vs-f32 ratio into noise
+    nbytes = 32 * 1024**2 if quick else 64 * 1024**2
+    reps = 2 if quick else 3
+    ratios = {}
+    for world in (2,) if quick else (2, 4):
+        ranks = [Rank.remote() for _ in range(world)]
+        coll.create_collective_group(
+            ranks, world, list(range(world)), group_name=f"tq{world}"
+        )
+        base = None
+        for qmode in (None, "int8", "bf16"):
+            per_rank = ca.get(
+                [
+                    r.bench.remote(nbytes, reps, f"tq{world}", qmode)
+                    for r in ranks
+                ],
+                timeout=900,
+            )
+            eff = min(per_rank)
+            label = qmode or "f32"
+            record(
+                f"ring allreduce {label} ({world} ranks, {nbytes >> 20} MB)",
+                eff / 1e9, "GB/s per rank",
+            )
+            if qmode is None:
+                base = eff
+            else:
+                ratios[(world, qmode)] = eff / base
+                record(
+                    f"ring allreduce {label} speedup vs f32 ({world} ranks)",
+                    eff / base, "x",
+                )
+        coll.destroy_group_on(ranks, f"tq{world}")
+        for r in ranks:
+            _kill(r)
+    if owns:
+        ca.shutdown()
+    return results
+
+
 def head_saturation(quick: bool = False) -> List[Tuple[str, float, str]]:
     """`ca microbenchmark --saturation`: find where the single head's asyncio
     loop saturates (VERDICT r3 weak #6 — the directory/refcount/lease/pubsub
@@ -840,6 +1008,7 @@ def main(
     collective: bool = False,
     lease_plane: bool = False,
     owner_plane: bool = False,
+    transfer: bool = False,
 ):
     if saturation:
         head_saturation(quick=quick)
@@ -853,6 +1022,8 @@ def main(
         run_lease_plane(quick=quick)
     elif owner_plane:
         run_owner_plane(quick=quick)
+    elif transfer:
+        run_transfer_plane(quick=quick)
     else:
         run_microbenchmarks(quick=quick)
 
@@ -868,4 +1039,5 @@ if __name__ == "__main__":
         collective="--collective" in sys.argv,
         lease_plane="--lease-plane" in sys.argv,
         owner_plane="--owner-plane" in sys.argv,
+        transfer="--transfer" in sys.argv,
     )

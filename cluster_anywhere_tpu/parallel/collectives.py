@@ -23,11 +23,155 @@ from __future__ import annotations
 
 import pickle
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 _groups: Dict[str, Any] = {}
+
+
+# ---------------------------------------------------------------------------
+# block quantization (EQuARX-style wire encodings for the host ring)
+# ---------------------------------------------------------------------------
+# Wire format (rides the coll_push `meta` dict, transport-agnostic):
+#   int8: payload = int8 elements, row-major in blocks of `bk` elements
+#         (last block zero-padded); meta = {"qm": "int8", "n": element
+#         count, "bk": block size, "sc": one f32 LE scale per block —
+#         value = q * scale, scale = max|block| / 127}.
+#   bf16: payload = uint16 elements (f32 truncated to the upper 16 bits,
+#         round-to-nearest-even); meta = {"qm": "bf16", "n": count}.
+# Mixed-version ranks negotiate by construction: quantization is selected
+# per CALL (or per group via config.collective_quantize), every rank of a
+# group executes the same call, and a rank that cannot decode `qm` raises
+# rather than silently reducing garbage.
+
+QUANT_MODES = ("int8", "bf16")
+
+# Accelerated encode/decode kernels.  The per-hop quantize/dequantize is
+# the quantized ring's entire CPU cost (the wire savings come free), and
+# separate numpy ufunc passes touch the chunk ~6 times; a fused XLA kernel
+# (jax pinned to the HOST CPU backend — never the accelerator) does it in
+# ~2 memory passes, and ml_dtypes casts bf16 at memcpy speed (its byte
+# layout is exactly this wire format's RTNE truncation).  Both probe once
+# and degrade to pure numpy, which stays the semantic reference.
+_INT8_KERNELS: Any = None  # (encode, decode) | False once probed
+
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _BF16 = np.dtype(_ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+
+def _int8_kernels():
+    global _INT8_KERNELS
+    if _INT8_KERNELS is None:
+        try:
+            from functools import partial
+
+            import jax
+            import jax.numpy as jnp
+
+            @partial(jax.jit, static_argnums=(1,), backend="cpu")
+            def _enc(flat, block):
+                b = flat.reshape(-1, block)
+                scale = jnp.max(jnp.abs(b), axis=1) / 127.0
+                inv = jnp.where(
+                    scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0
+                )
+                q = jnp.round(b * inv[:, None]).astype(jnp.int8)
+                return q.reshape(-1), scale
+
+            @partial(jax.jit, static_argnums=(2,), backend="cpu")
+            def _dec(q, scale, block):
+                return (
+                    q.reshape(-1, block).astype(jnp.float32) * scale[:, None]
+                ).reshape(-1)
+
+            _INT8_KERNELS = (_enc, _dec)
+        except Exception:
+            _INT8_KERNELS = False
+    return _INT8_KERNELS or None
+
+
+def quantize_chunk(flat, mode: str, block: int) -> Tuple[bytes, dict]:
+    """Encode a float vector for the wire.  Returns (payload, meta); the
+    pair round-trips through dequantize_chunk with the documented error
+    bound (int8: per element <= max|block| / 254 + float rounding)."""
+    flat = np.ascontiguousarray(flat, dtype=np.float32).reshape(-1)
+    n = flat.size
+    if mode == "bf16":
+        if _BF16 is not None:
+            return flat.astype(_BF16).tobytes(), {"qm": "bf16", "n": n}
+        u = flat.view(np.uint32)
+        q = ((u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1)))
+             >> np.uint32(16)).astype(np.uint16)
+        return q.tobytes(), {"qm": "bf16", "n": n}
+    if mode != "int8":
+        raise ValueError(f"unsupported quantization mode {mode!r}")
+    block = max(1, int(block))
+    nb = (n + block - 1) // block
+    padded = flat
+    if nb * block != n:
+        padded = np.zeros(nb * block, np.float32)
+        padded[:n] = flat
+    kern = _int8_kernels()
+    if kern is not None and n:
+        q, scale = kern[0](padded, block)
+        return np.asarray(q).tobytes(), {
+            "qm": "int8", "n": n, "bk": block,
+            "sc": np.asarray(scale, dtype=np.float32).tobytes(),
+        }
+    b = padded.reshape(nb, block)
+    scale = np.abs(b).max(axis=1)
+    scale /= np.float32(127.0)
+    inv = np.where(scale > 0.0, np.float32(1.0) / np.where(
+        scale > 0.0, scale, np.float32(1.0)), np.float32(0.0))
+    q = np.rint(b * inv[:, None]).astype(np.int8)
+    return q.tobytes(), {
+        "qm": "int8", "n": n, "bk": block,
+        "sc": scale.astype(np.float32).tobytes(),
+    }
+
+
+def dequantize_chunk(payload: bytes, meta: dict) -> np.ndarray:
+    """Decode a quantize_chunk wire pair back to float32."""
+    qm = meta.get("qm")
+    n = int(meta.get("n", 0))
+    if qm == "bf16":
+        if _BF16 is not None:
+            return np.frombuffer(payload, dtype=_BF16)[:n].astype(np.float32)
+        u = np.frombuffer(payload, dtype=np.uint16).astype(np.uint32)
+        return (u << np.uint32(16)).view(np.float32)[:n]
+    if qm == "int8":
+        block = int(meta["bk"])
+        scale = np.frombuffer(meta["sc"], dtype=np.float32)
+        q = np.frombuffer(payload, dtype=np.int8)
+        kern = _int8_kernels()
+        if kern is not None and n:
+            return np.asarray(kern[1](q, scale, block))[:n]
+        out = (
+            q.astype(np.float32).reshape(scale.size, block) * scale[:, None]
+        ).reshape(-1)
+        return out[:n]
+    raise ValueError(
+        f"peer sent unknown quantized payload {qm!r} — mixed-version group? "
+        f"(this build decodes {QUANT_MODES})"
+    )
+
+
+def _resolve_quant(quantize: Optional[str]) -> Optional[str]:
+    """Normalize a per-call/per-group quantize selector: None/''/'f32'/
+    'none' = the untouched f32 path; 'int8'/'bf16' = quantized ring."""
+    if quantize in (None, "", "f32", "none"):
+        return None
+    if quantize not in QUANT_MODES:
+        raise ValueError(
+            f"quantize must be one of {QUANT_MODES} (or None for f32), "
+            f"got {quantize!r}"
+        )
+    return quantize
 
 
 # ---------------------------------------------------------------------------
@@ -168,7 +312,15 @@ class HostCollectiveGroup:
             return stack.mean(axis=0)
         raise ValueError(f"unsupported op {op}")
 
-    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+    def allreduce(
+        self, tensor: np.ndarray, op: str = "sum",
+        quantize: Optional[str] = None,
+    ) -> np.ndarray:
+        if _resolve_quant(quantize) is not None:
+            raise ValueError(
+                "quantized allreduce needs the p2p 'host' transport; this "
+                "group uses the 'kv' rendezvous backend (remote clients)"
+            )
         ns = self._ns("allreduce")
         self._seq += 1
         if self.rank == 0:
@@ -250,12 +402,25 @@ class P2PCollectiveGroup:
 
     _TIMEOUT = 60.0
 
-    def __init__(self, world_size: int, rank: int, group_name: str = "default"):
+    def __init__(
+        self, world_size: int, rank: int, group_name: str = "default",
+        quantize: Optional[str] = None,
+    ):
         if not 0 <= rank < world_size:
             raise ValueError(f"rank {rank} out of range for world_size {world_size}")
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        # group-default wire encoding for allreduce: explicit arg wins, else
+        # config.collective_quantize; per-CALL quantize= overrides both.
+        # Must agree across ranks — it is part of the group contract, like
+        # the backend (a mixed group would decode garbage; unknown modes
+        # raise at the receiver).
+        if quantize is None:
+            from ..core.config import get_config
+
+            quantize = getattr(get_config(), "collective_quantize", "") or None
+        self._quantize = _resolve_quant(quantize)
         self._seq = 0
         self._p2p_send_seq: Dict[int, int] = {}
         self._p2p_recv_seq: Dict[int, int] = {}
@@ -318,8 +483,26 @@ class P2PCollectiveGroup:
             self._peer(dst), self.group_name, key, self.rank, arr, self._TIMEOUT
         )
 
+    def _push_start(self, dst: int, key: str, arr: np.ndarray):
+        """Non-blocking send for the pipelined ring: serialize now, ship in
+        the background, join via .result() after the overlapped receive."""
+        return self._worker().coll_push_start(
+            self._peer(dst), self.group_name, key, self.rank, arr, self._TIMEOUT
+        )
+
+    def _push_raw_start(self, dst: int, key: str, payload: bytes, meta: dict):
+        return self._worker().coll_push_raw_start(
+            self._peer(dst), self.group_name, key, self.rank, payload, meta,
+            self._TIMEOUT,
+        )
+
     def _wait(self, key: str, src: int) -> np.ndarray:
         return self._worker().coll_wait(
+            self.group_name, key, src, self._TIMEOUT
+        )
+
+    def _wait_raw(self, key: str, src: int):
+        return self._worker().coll_wait_raw(
             self.group_name, key, src, self._TIMEOUT
         )
 
@@ -348,8 +531,21 @@ class P2PCollectiveGroup:
         else:
             raise ValueError(f"unsupported op {op}")
 
-    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+    def allreduce(
+        self, tensor: np.ndarray, op: str = "sum",
+        quantize: Optional[str] = None,
+    ) -> np.ndarray:
+        """Ring allreduce, double-buffered: each step STARTS its send (bytes
+        serialized before the call returns) and then blocks on the incoming
+        chunk, so rank r's send of chunk i overlaps its receive of chunk i —
+        instead of the strict send-ack-then-wait alternation.  quantize=
+        "int8"/"bf16" selects the EQuARX-style block-quantized wire payload
+        (per-call; the group/config default applies when omitted); the f32
+        path below is bit-for-bit the untouched default."""
         arr = np.asarray(tensor)
+        mode = self._quantize if quantize is None else _resolve_quant(quantize)
+        if mode is not None:
+            return self._allreduce_quantized(arr, op, mode)
         n = self.world_size
         self._seq += 1
         acc_dt = self._acc_dtype(arr.dtype, op)
@@ -365,21 +561,81 @@ class P2PCollectiveGroup:
         for s in range(n - 1):
             send_idx = (self.rank - s) % n
             recv_idx = (self.rank - s - 1) % n
-            self._push(right, f"{seq}/rs{s}", chunks[send_idx])
+            pend = self._push_start(right, f"{seq}/rs{s}", chunks[send_idx])
             incoming = self._wait(f"{seq}/rs{s}", src=left)
             self._combine(chunks[recv_idx], incoming.reshape(chunks[recv_idx].shape), op)
+            pend.result(self._TIMEOUT)
         # ring allgather of the reduced chunks
         for s in range(n - 1):
             send_idx = (self.rank + 1 - s) % n
             recv_idx = (self.rank - s) % n
-            self._push(right, f"{seq}/ag{s}", chunks[send_idx])
+            pend = self._push_start(right, f"{seq}/ag{s}", chunks[send_idx])
             chunks[recv_idx] = self._wait(f"{seq}/ag{s}", src=left).reshape(
                 chunks[recv_idx].shape
             ).copy()
+            pend.result(self._TIMEOUT)
         out = np.concatenate([c.reshape(-1) for c in chunks]).reshape(arr.shape)
         if op == "mean":
             out = out / n
         return self._mean_result_dtype(out, arr.dtype, op)
+
+    def _allreduce_quantized(
+        self, arr: np.ndarray, op: str, mode: str
+    ) -> np.ndarray:
+        """Block-quantized ring (EQuARX, arxiv 2506.17615): reduce-scatter
+        quantizes each outgoing chunk (quantize-on-send), dequantizes the
+        incoming one, reduces in f32, and requantizes at the next hop; the
+        allgather phase quantizes each fully-reduced chunk ONCE at its
+        owner and forwards the wire bytes verbatim, so every rank decodes
+        identical values.  Wire bytes per hop: n/4 + 4/block scales (int8)
+        or n/2 (bf16) versus the f32 ring's n bytes."""
+        if not np.issubdtype(arr.dtype, np.floating):
+            raise ValueError(
+                f"quantized allreduce needs a floating tensor, got {arr.dtype}"
+            )
+        from ..core.config import get_config
+        from ..core.worker import TRANSFER_STATS
+
+        block = int(getattr(get_config(), "collective_quant_block", 4096))
+        n = self.world_size
+        self._seq += 1
+        saved = 0
+        if n == 1:
+            payload, meta = quantize_chunk(arr.reshape(-1), mode, block)
+            out = dequantize_chunk(payload, meta)  # same error model as n>1
+            TRANSFER_STATS["quant_ops"] += 1
+            return out.reshape(arr.shape).astype(arr.dtype, copy=False)
+        seq = self._seq
+        left, right = (self.rank - 1) % n, (self.rank + 1) % n
+        flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
+        chunks = [c.copy() for c in np.array_split(flat, n)]
+        for s in range(n - 1):
+            send_idx = (self.rank - s) % n
+            recv_idx = (self.rank - s - 1) % n
+            payload, meta = quantize_chunk(chunks[send_idx], mode, block)
+            saved += chunks[send_idx].nbytes - len(payload) - len(meta.get("sc", b""))
+            pend = self._push_raw_start(right, f"{seq}/qrs{s}", payload, meta)
+            pdata, pmeta = self._wait_raw(f"{seq}/qrs{s}", src=left)
+            self._combine(chunks[recv_idx], dequantize_chunk(pdata, pmeta), op)
+            pend.result(self._TIMEOUT)
+        own = (self.rank + 1) % n
+        payload, meta = quantize_chunk(chunks[own], mode, block)
+        # adopt the decoded form locally so this rank's result matches what
+        # every peer reconstructs from the forwarded bytes
+        chunks[own] = dequantize_chunk(payload, meta)
+        for s in range(n - 1):
+            recv_idx = (self.rank - s) % n
+            saved += 4 * int(meta.get("n", 0)) - len(payload) - len(meta.get("sc", b""))
+            pend = self._push_raw_start(right, f"{seq}/qag{s}", payload, meta)
+            payload, meta = self._wait_raw(f"{seq}/qag{s}", src=left)
+            chunks[recv_idx] = dequantize_chunk(payload, meta)
+            pend.result(self._TIMEOUT)
+        out = np.concatenate(chunks)
+        if op == "mean":
+            out = out / n
+        TRANSFER_STATS["quant_ops"] += 1
+        TRANSFER_STATS["quant_bytes_saved"] += max(0, saved)
+        return out.reshape(arr.shape).astype(arr.dtype, copy=False)
 
     @staticmethod
     def _mean_result_dtype(out: np.ndarray, in_dtype: np.dtype, op: str):
@@ -457,13 +713,16 @@ class P2PCollectiveGroup:
 
 
 def init_collective_group(
-    world_size: int, rank: int, backend: str = "host", group_name: str = "default"
+    world_size: int, rank: int, backend: str = "host",
+    group_name: str = "default", quantize: Optional[str] = None,
 ):
     """backend='host'/'gloo': p2p transport (direct worker-to-worker bytes).
     backend='kv': the KV-rendezvous transport (required when ANY member is a
     remote client, which cannot serve direct connections).  The backend is
     per-GROUP, never per-rank: a silent per-rank fallback would build a
-    mixed-transport group whose halves share no rendezvous and deadlock."""
+    mixed-transport group whose halves share no rendezvous and deadlock.
+    `quantize` sets the group's default allreduce wire encoding (see
+    allreduce; must agree across ranks, like the backend)."""
     if backend not in ("host", "gloo", "kv"):
         raise ValueError(
             "out-of-graph groups support the 'host' (p2p) and 'kv' backends; "
@@ -471,6 +730,10 @@ def init_collective_group(
             "(cluster_anywhere_tpu.parallel.collectives.xla)"
         )
     if backend == "kv":
+        if _resolve_quant(quantize) is not None:
+            raise ValueError(
+                "quantized collectives need the p2p 'host' backend"
+            )
         g: Any = HostCollectiveGroup(world_size, rank, group_name)
     else:
         from ..core.worker import global_worker
@@ -485,7 +748,7 @@ def init_collective_group(
                 "backend='kv' instead — transports cannot be mixed within "
                 "a group"
             )
-        g = P2PCollectiveGroup(world_size, rank, group_name)
+        g = P2PCollectiveGroup(world_size, rank, group_name, quantize=quantize)
     _groups[group_name] = g
     return g
 
@@ -555,8 +818,15 @@ def destroy_collective_group(group_name: str = "default"):
         g.close()
 
 
-def allreduce(tensor, op: str = "sum", group_name: str = "default"):
-    return get_group(group_name).allreduce(tensor, op)
+def allreduce(
+    tensor, op: str = "sum", group_name: str = "default",
+    quantize: Optional[str] = None,
+):
+    """quantize="int8"/"bf16" selects the block-quantized ring payload for
+    this call (p2p 'host' groups only); None defers to the group's default
+    (init arg / config.collective_quantize), which itself defaults to the
+    exact f32 wire path."""
+    return get_group(group_name).allreduce(tensor, op, quantize=quantize)
 
 
 def allgather(tensor, group_name: str = "default"):
@@ -588,6 +858,49 @@ def recv(src_rank: int, group_name: str = "default"):
 # ---------------------------------------------------------------------------
 
 
+def quantized_psum(
+    x, axis_name: str, quantize: str = "int8", block: int = 2048
+):
+    """In-graph quantized gradient sync (EQuARX analogue for the tensor
+    plane), CPU-testable under JAX_PLATFORMS=cpu (works under vmap/shard_map
+    axis names).
+
+    int8: each rank block-quantizes its contribution once (per-block f32
+    scales), ranks exchange the INT8 payloads (all_gather moves world x 1
+    byte per element per link vs psum's ~2 x 4 bytes — a wire win up to
+    world ~8) plus the tiny scale vectors, and every rank dequantize-sums
+    locally — so the result is sum_r Dq(Q(x_r)), the same error model as
+    the host quantized ring.  bf16: psum over bf16-cast operands (half the
+    wire bytes, bf16 accumulation).  quantize=None/'f32' is exact psum."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    mode = _resolve_quant(quantize)
+    if mode is None:
+        return lax.psum(x, axis_name)
+    if mode == "bf16":
+        return lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.size
+    block = max(1, min(int(block), max(n, 1)))
+    nb = max(1, (n + block - 1) // block)
+    pad = nb * block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    b = flat.reshape(nb, block)
+    scale = jnp.max(jnp.abs(b), axis=1) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    inv = jnp.where(scale > 0, 1.0 / safe, 0.0)
+    q = jnp.round(b * inv[:, None]).astype(jnp.int8)
+    qs = lax.all_gather(q, axis_name)      # [world, nb, block] int8 wire
+    ss = lax.all_gather(scale, axis_name)  # [world, nb] f32 scales (tiny)
+    out = (qs.astype(jnp.float32) * ss[:, :, None]).sum(axis=0).reshape(-1)
+    if pad:
+        out = out[:n]
+    return out.reshape(shape).astype(x.dtype)
+
+
 class xla:
     """In-graph collectives over mesh axes — the TPU tensor plane."""
 
@@ -596,6 +909,9 @@ class xla:
         from jax import lax
 
         return lax.psum(x, axis_name)
+
+    # quantized gradient sync (module-level quantized_psum re-exported)
+    quantized_psum = staticmethod(quantized_psum)
 
     @staticmethod
     def allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):

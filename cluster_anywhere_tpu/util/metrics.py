@@ -94,6 +94,23 @@ _OWNER_DESCS = {
 }
 
 
+_transfer_shipped: Dict[str, int] = {}
+_TRANSFER_DESCS = {
+    "pulls": "node-to-node object transfers completed by this process",
+    "bytes_pulled": "object bytes received over pull_chunk",
+    "chunks_pulled": "pull_chunk responses applied to import arenas",
+    "window_peak_sum": "sum over pulls of the peak in-flight pull_chunk RPCs",
+    "sources_used": "holders that served >=1 chunk, summed over pulls",
+    "multi_source_pulls": "pulls that drew bytes from more than one holder",
+    "source_failovers": "sources dropped mid-pull (their range re-assigned)",
+    "pull_retry_rounds": "re-locate rounds after every source failed",
+    "bytes_uploaded": "client-mode put bytes streamed to the head",
+    "copy_notify_deferred": "obj_copy notifies deferred for re-send",
+    "quant_bytes_saved": "f32-equivalent bytes minus wire bytes, quantized ring",
+    "quant_ops": "quantized collective ops completed",
+}
+
+
 _lease_shipped: Dict[str, int] = {}
 _LEASE_DESCS = {
     "local_grants": "leases granted node-locally by agents (lease blocks)",
@@ -151,6 +168,18 @@ def _owner_records() -> List[dict]:
     from ..core.ownership import OWNER_STATS
 
     return _counter_deltas("ca_owner_", OWNER_STATS, _owner_shipped, _OWNER_DESCS)
+
+
+def _transfer_records() -> List[dict]:
+    """Transfer-plane counters (core/worker.py TRANSFER_STATS) as
+    ca_transfer_* records: windowed/multi-source pull volume, window
+    occupancy, failovers, and the quantized ring's wire savings — the series
+    behind `ca microbenchmark --transfer`'s structural claims."""
+    from ..core.worker import TRANSFER_STATS
+
+    return _counter_deltas(
+        "ca_transfer_", TRANSFER_STATS, _transfer_shipped, _TRANSFER_DESCS
+    )
 
 
 def _drain_records() -> List[dict]:
@@ -271,6 +300,7 @@ def flush_once():
     batch.extend(_wire_records())
     batch.extend(_lease_records())
     batch.extend(_owner_records())
+    batch.extend(_transfer_records())
     batch.extend(_drain_records())
     batch.extend(_logplane_records())
     batch.extend(_metrics_records())

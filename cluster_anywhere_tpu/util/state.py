@@ -183,6 +183,36 @@ def owner_plane() -> Dict[str, Any]:
     }
 
 
+def transfer_plane() -> Dict[str, Any]:
+    """Transfer-plane summary: cluster-aggregated ca_transfer_* counters
+    (windowed/multi-source pull volume, window occupancy, source failovers,
+    quantized-ring wire savings) plus the head's transfer registry stats —
+    the one-call view of the bulk-byte data plane."""
+    from .metrics import get_metrics_snapshot
+
+    r = _head("stats")
+    stats = r["stats"]
+    counters: Dict[str, int] = {}
+    try:
+        for name, rec in get_metrics_snapshot().items():
+            if name.startswith("ca_transfer_"):
+                counters[name[len("ca_transfer_"):]] = int(
+                    sum(rec.get("data", {}).values())
+                )
+    except Exception:
+        pass
+    pulls = counters.get("pulls", 0)
+    return {
+        "counters": counters,
+        # avg per-transfer peak of concurrent pull_chunk RPCs (>1 = the
+        # window is really open; serial pulls peak at exactly 1)
+        "window_occupancy": (
+            counters.get("window_peak_sum", 0) / pulls if pulls else 0.0
+        ),
+        "objects_transferred": stats.get("objects_transferred", 0),
+    }
+
+
 def timeseries(
     names: Optional[List[str]] = None,
     *,

@@ -1,0 +1,392 @@
+"""Transfer-plane tests: windowed multi-source object pulls (failover,
+chaos, shared-pull cancellation), deferred obj_copy directory notifies, and
+the quantized collective ring (numerical tolerance, f32 bit-exactness,
+in-graph quantized_psum).
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.cluster_utils import Cluster
+from cluster_anywhere_tpu.core.config import CAConfig
+from cluster_anywhere_tpu.core.protocol import reset_rpc_chaos
+from cluster_anywhere_tpu.core.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+from cluster_anywhere_tpu.core.worker import TRANSFER_STATS, global_worker
+from cluster_anywhere_tpu.parallel import collectives as coll
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    reset_rpc_chaos("")
+    yield
+    reset_rpc_chaos("")
+
+
+def _stats():
+    return dict(TRANSFER_STATS)
+
+
+def _delta(before, after=None):
+    after = after or TRANSFER_STATS
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
+def _transfer_cluster(nodes=1, **cfg_overrides):
+    cfg = CAConfig()
+    cfg.transfer_chunk_bytes = 256 * 1024
+    cfg.transfer_window = 4
+    for k, v in cfg_overrides.items():
+        setattr(cfg, k, v)
+    c = Cluster(head_resources={"CPU": 1}, config=cfg)
+    nids = [c.add_node(num_cpus=2) for _ in range(nodes)]
+    c.connect()
+    c.wait_for_nodes(nodes + 1)
+    return c, nids
+
+
+@ca.remote
+def _produce(n):
+    return np.arange(n, dtype=np.float64)
+
+
+@ca.remote
+def _consume(a):
+    return float(a.sum())
+
+
+def _on(nid):
+    return {"scheduling_strategy": NodeAffinitySchedulingStrategy(nid)}
+
+
+def test_windowed_pull_bit_exact_and_occupancy():
+    """A multi-chunk remote pull keeps >1 pull_chunk RPC in flight (the
+    window is really open) and the bytes land bit-exact out of order."""
+    c, (n1,) = _transfer_cluster(nodes=1)
+    try:
+        ref = _produce.options(**_on(n1)).remote(1_000_000)  # 8 MB, 31 chunks
+        before = _stats()
+        arr = ca.get(ref, timeout=60)
+        assert np.array_equal(arr, np.arange(1_000_000, dtype=np.float64))
+        d = _delta(before)
+        assert d["pulls"] == 1
+        assert d["chunks_pulled"] >= 30
+        # the structural windowing claim: peak in-flight RPCs > 1 (serial
+        # pulls peak at exactly 1)
+        assert d["window_peak_sum"] > 1
+    finally:
+        c.shutdown()
+
+
+def test_windowed_pull_chaos_retry_bit_exact():
+    """pull_chunk RPC failures injected mid-object: the failed chunks are
+    re-queued and re-fetched by surviving lanes — the assembled bytes stay
+    bit-exact and nothing surfaces to the caller."""
+    c, (n1,) = _transfer_cluster(nodes=1)
+    try:
+        ref = _produce.options(**_on(n1)).remote(1_000_000)
+        ca.wait([ref], timeout=60)
+        before = _stats()
+        reset_rpc_chaos("pull_chunk=3")  # kills 3 of the 4 window lanes
+        arr = ca.get(ref, timeout=60)
+        assert np.array_equal(arr, np.arange(1_000_000, dtype=np.float64))
+        d = _delta(before)
+        assert d["pulls"] == 1
+        assert d["chunks_pulled"] >= 30  # every chunk eventually landed
+    finally:
+        reset_rpc_chaos("")
+        c.shutdown()
+
+
+def test_multi_source_pull_uses_both_holders():
+    """When the directory reports two live copies, the byte range splits
+    across them (both holders serve chunks of one pull)."""
+    c, (n1, n2) = _transfer_cluster(nodes=2)
+    try:
+        ref = _produce.options(**_on(n1)).remote(1_000_000)
+        want = ca.get(_consume.options(**_on(n2)).remote(ref), timeout=60)
+        time.sleep(1.0)  # obj_copy notify lands in the directory
+        before = _stats()
+        arr = ca.get(ref, timeout=60)
+        assert float(arr.sum()) == want
+        d = _delta(before)
+        assert d["pulls"] == 1
+        assert d["sources_used"] == 2
+        assert d["multi_source_pulls"] == 1
+    finally:
+        c.shutdown()
+
+
+def test_multi_source_failover_on_bad_source():
+    """A source that fails every chunk (here: a directory entry whose shm
+    segment does not exist) is dropped and its range re-assigned to the
+    surviving holder — failover, not a failed transfer."""
+    c, (n1, n2) = _transfer_cluster(nodes=2)
+    try:
+        ref = _produce.options(**_on(n1)).remote(1_000_000)
+        ca.wait([ref], timeout=60)
+        w = global_worker()
+        # forge a "copy" on n2 pointing at a nonexistent segment: the
+        # directory now advertises two sources, one of them poison
+        sess = w.session_name
+        w.run_coro(
+            w.head.call(
+                "obj_copy", oid=ref.id.binary(), node=n2,
+                shm_name=f"{sess}/{n2}/bogus_copy",
+            )
+        )
+        before = _stats()
+        arr = ca.get(ref, timeout=60)
+        assert np.array_equal(arr, np.arange(1_000_000, dtype=np.float64))
+        d = _delta(before)
+        assert d["pulls"] == 1
+        assert d["source_failovers"] >= 1
+    finally:
+        c.shutdown()
+
+
+def test_pull_survives_holder_killed_mid_transfer():
+    """Multi-source pull with one holder SIGKILLed while the transfer is in
+    flight: the survivor finishes the range, bytes bit-exact."""
+    c, (n1, n2) = _transfer_cluster(
+        nodes=2, testing_transfer_delay_s=0.05, transfer_window=2
+    )
+    try:
+        ref = _produce.options(**_on(n1)).remote(1_000_000)
+        want = ca.get(_consume.options(**_on(n2)).remote(ref), timeout=60)
+        time.sleep(1.0)  # copy registered: two live sources
+        out = {}
+
+        def puller():
+            try:
+                out["arr"] = ca.get(ref, timeout=120)
+            except BaseException as e:  # surfaced by the assert below
+                out["err"] = e
+
+        t = threading.Thread(target=puller)
+        t.start()
+        time.sleep(0.3)  # transfer is mid-flight (31 chunks x 50ms / lanes)
+        c.remove_node(n1)  # SIGKILL the primary holder
+        t.join(timeout=150)
+        assert not t.is_alive()
+        assert "err" not in out, f"pull failed: {out['err']!r}"
+        assert float(out["arr"].sum()) == want
+        assert np.array_equal(
+            out["arr"], np.arange(1_000_000, dtype=np.float64)
+        )
+    finally:
+        c.shutdown()
+
+
+def test_shared_pull_leader_cancel_does_not_poison_waiters():
+    """Regression (shared-pull cancellation poisoning): the first puller of
+    an object is cancelled mid-transfer; a second coroutine awaiting the
+    shared pull future must RETRY the pull (becoming the new leader), not
+    inherit the leader's CancelledError."""
+    c, (n1,) = _transfer_cluster(
+        nodes=1, testing_transfer_delay_s=0.05, transfer_window=2,
+        transfer_chunk_bytes=128 * 1024,
+    )
+    try:
+        ref = _produce.options(**_on(n1)).remote(250_000)  # 2 MB, 16 chunks
+        ca.wait([ref], timeout=60)
+        w = global_worker()
+        reply = w.run_coro(w.head.call("obj_locate", oid=ref.id.binary()))
+        assert reply["found"]
+        oid_b, name, size = ref.id.binary(), reply["shm_name"], reply["size"]
+        leader = asyncio.run_coroutine_threadsafe(
+            w._ensure_local_shm(oid_b, name, size), w.loop
+        )
+        time.sleep(0.2)  # leader is mid-transfer (~0.4s total)
+        waiter = asyncio.run_coroutine_threadsafe(
+            w._ensure_local_shm(oid_b, name, size), w.loop
+        )
+        time.sleep(0.1)  # waiter is parked on the shared future
+        leader.cancel()
+        local_name, got_size = waiter.result(timeout=60)
+        assert got_size == size
+        assert w.shm_store.is_local(local_name)
+        # and the pulled bytes are the real object
+        assert float(ca.get(ref, timeout=60).sum()) == float(
+            np.arange(250_000, dtype=np.float64).sum()
+        )
+    finally:
+        c.shutdown()
+
+
+def test_obj_copy_notify_deferred_and_resent():
+    """Satellite regression: a failed obj_copy notify after a successful
+    pull used to be swallowed (`except Exception: pass`) — the head never
+    learned about the copy.  It now defers, counts, and housekeeping
+    re-sends: the directory eventually lists the puller's node."""
+    c, (n1,) = _transfer_cluster(nodes=1)
+    try:
+        ref = _produce.options(**_on(n1)).remote(1_000_000)
+        ca.wait([ref], timeout=60)
+        before = _stats()
+        reset_rpc_chaos("obj_copy=1")  # the post-pull notify fails once
+        arr = ca.get(ref, timeout=60)
+        assert arr[-1] == 999_999
+        d = _delta(before)
+        assert d["copy_notify_deferred"] == 1
+        # housekeeping re-sends (chaos budget spent): the head's directory
+        # learns about the driver-node copy — a locate from this node now
+        # short-circuits to the local copy (node == ours, nothing to pull)
+        w = global_worker()
+        deadline = time.monotonic() + 15
+        reply = {}
+        while time.monotonic() < deadline:
+            reply = w.run_coro(w.head.call("obj_locate", oid=ref.id.binary()))
+            if reply.get("node") == w.node_id and not reply.get("pull_addr"):
+                break
+            time.sleep(0.2)
+        assert reply.get("node") == w.node_id and not reply.get("pull_addr")
+    finally:
+        reset_rpc_chaos("")
+        c.shutdown()
+
+
+def test_windowed_client_upload_bit_exact():
+    """Client-mode puts stream through the windowed upload path (out-of-
+    order client_put_chunk completions) and read back bit-exact."""
+    cfg = CAConfig()
+    cfg.transfer_chunk_bytes = 128 * 1024
+    cfg.transfer_window = 4
+    if ca.is_initialized():
+        ca.shutdown()
+    c = Cluster(head_resources={"CPU": 2}, config=cfg)
+    try:
+        ca.init(address=c.head_tcp, config=cfg)
+        arr = np.arange(500_000, dtype=np.float64)  # 4 MB, 31 packets
+        before = _stats()
+        ref = ca.put(arr)
+        got = ca.get(_consume.remote(ref), timeout=60)
+        assert got == float(arr.sum())
+        assert _delta(before)["bytes_uploaded"] >= arr.nbytes
+    finally:
+        if ca.is_initialized():
+            ca.shutdown()
+        c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# quantized collective ring
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_roundtrip_error_bound():
+    """Block quantization error bound: per element <= max|block| / 254
+    (one half int8 step at scale = max|block|/127), padding and zero/empty
+    blocks exact."""
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal(10_000) * rng.uniform(0.01, 100)).astype(
+        np.float32
+    )
+    block = 512
+    payload, meta = coll.quantize_chunk(x, "int8", block)
+    y = coll.dequantize_chunk(payload, meta)
+    assert y.shape == x.shape
+    for i in range(0, x.size, block):
+        b = x[i : i + block]
+        bound = np.abs(b).max() / 254 * (1 + 1e-5)
+        assert np.abs(y[i : i + block] - b).max() <= bound
+    # zeros quantize to exactly zero; empty and non-multiple sizes round-trip
+    for arr in (
+        np.zeros(700, np.float32),
+        np.array([], np.float32),
+        rng.standard_normal(513).astype(np.float32),
+    ):
+        p, m = coll.quantize_chunk(arr, "int8", block)
+        z = coll.dequantize_chunk(p, m)
+        assert z.shape == arr.shape
+        if arr.size and not arr.any():
+            assert not z.any()
+    # bf16 is a pure dtype narrowing: relative error < 2^-8
+    p, m = coll.quantize_chunk(x, "bf16", block)
+    yb = coll.dequantize_chunk(p, m)
+    assert np.abs((yb - x) / np.where(x == 0, 1, x)).max() < 2**-8
+
+
+def test_quantized_allreduce_tolerance_and_f32_bit_exact(ca_cluster_module):
+    """The p2p ring with quantize='int8'/'bf16' lands within the block-
+    quantization error bound, all ranks agree bit-for-bit, and the DEFAULT
+    f32 path is untouched (exact sum)."""
+
+    @ca.remote
+    class Rank(coll.CollectiveActorMixin):
+        def go(self, x, quantize):
+            return coll.allreduce(x, group_name="tq", quantize=quantize)
+
+    ranks = [Rank.remote() for _ in range(2)]
+    coll.create_collective_group(ranks, 2, [0, 1], group_name="tq")
+    try:
+        rng = np.random.default_rng(3)
+        xs = [rng.standard_normal(5000).astype(np.float32) for _ in range(2)]
+        exact = xs[0] + xs[1]
+        outs = ca.get([r.go.remote(x, None) for r, x in zip(ranks, xs)],
+                      timeout=120)
+        assert np.array_equal(outs[0], exact)
+        assert np.array_equal(outs[1], exact)
+        # int8: reduce-scatter quantizes each rank's contribution once plus
+        # one requantization of the reduced chunk — bound ~3 half-steps of
+        # the largest block scale
+        scale = np.abs(np.stack(xs)).max() / 127.0
+        outs8 = ca.get([r.go.remote(x, "int8") for r, x in zip(ranks, xs)],
+                       timeout=120)
+        assert np.abs(outs8[0] - exact).max() <= 3.0 * scale
+        assert np.array_equal(outs8[0], outs8[1])  # forwarded bytes verbatim
+        outsb = ca.get([r.go.remote(x, "bf16") for r, x in zip(ranks, xs)],
+                       timeout=120)
+        assert np.abs(outsb[0] - exact).max() <= 2**-7 * np.abs(exact).max() + 1e-4
+        assert np.array_equal(outsb[0], outsb[1])
+    finally:
+        coll.destroy_group_on(ranks, "tq")
+        for r in ranks:
+            ca.kill(r)
+
+
+def test_quantize_requires_p2p_backend(ca_cluster_module):
+    g = coll.HostCollectiveGroup(1, 0, "kvq")
+    with pytest.raises(ValueError, match="p2p 'host'"):
+        g.allreduce(np.zeros(4, np.float32), quantize="int8")
+    with pytest.raises(ValueError):
+        coll.init_collective_group(1, 0, backend="kv", group_name="kvq2",
+                                   quantize="int8")
+
+
+def test_quantized_psum_cpu():
+    """In-graph quantized_psum under JAX_PLATFORMS=cpu: int8 matches the
+    quantize-once-per-rank reference within float rounding; f32 mode is
+    exact psum; bf16 stays within half-precision tolerance."""
+    import jax
+
+    rng = np.random.default_rng(11)
+    xs = rng.standard_normal((4, 1000)).astype(np.float32)
+    out = np.asarray(
+        jax.vmap(
+            lambda v: coll.quantized_psum(v, "r", "int8", 256), axis_name="r"
+        )(xs)
+    )
+    ref = sum(
+        coll.dequantize_chunk(*coll.quantize_chunk(x, "int8", 256))
+        for x in xs
+    )
+    assert np.allclose(out[0], ref, atol=1e-5)
+    assert all(np.array_equal(out[i], out[0]) for i in range(4))
+    exact = np.asarray(
+        jax.vmap(lambda v: coll.quantized_psum(v, "r", None), axis_name="r")(xs)
+    )
+    plain = np.asarray(
+        jax.vmap(lambda v: jax.lax.psum(v, "r"), axis_name="r")(xs)
+    )
+    assert np.array_equal(exact, plain)  # f32 mode IS plain psum, bit-exact
+    outb = np.asarray(
+        jax.vmap(lambda v: coll.quantized_psum(v, "r", "bf16"), axis_name="r")(xs)
+    )
+    assert np.abs(outb[0] - xs.sum(0)).max() <= 2**-6 * np.abs(xs.sum(0)).max() + 1e-3
