@@ -174,31 +174,10 @@ def bench_core():
         w = global_worker()
         snap = w.head_call("metrics_snapshot")["metrics"]
 
-        def merged_hist(rec):
-            """Merge a histogram's tagged cells into (bounds, buckets, count)."""
-            bounds, buckets, count = [], [], 0
-            for cell in (rec or {}).get("data", {}).values():
-                b = cell.get("bounds", [])
-                if len(b) > len(bounds):
-                    bounds = b
-                    buckets = [0] * (len(b) + 1)
-                for i, c in enumerate(cell["buckets"]):
-                    if i < len(buckets):
-                        buckets[i] += c
-                count += cell["count"]
-            return bounds, buckets, count
-
-        def hist_pct(bounds, buckets, count, q):
-            """Percentile upper bound from cumulative buckets (s)."""
-            if not count:
-                return 0.0
-            target = q * count
-            cum = 0
-            for i, c in enumerate(buckets):
-                cum += c
-                if cum >= target:
-                    return bounds[i] if i < len(bounds) else bounds[-1] * 2
-            return bounds[-1] * 2 if bounds else 0.0
+        from cluster_anywhere_tpu.util.metrics import (
+            histogram_quantile as hist_pct,
+            merged_histogram as merged_hist,
+        )
 
         lb, lbk, lcount = merged_hist(snap.get("ca_head_loop_lag_hist_seconds"))
         db, dbk, dcount = merged_hist(snap.get("ca_head_dispatch_seconds"))
@@ -495,6 +474,22 @@ def bench_transfer_plane():
     return out
 
 
+def bench_serve_plane():
+    """Serving-plane envelope rows (open-loop SSE req/s + TTFT/p99, shedding
+    and prefix-cache A/Bs, drain-under-load zero-drop proof) as a BENCH-json
+    block, so the trajectory captures the serve path the way it captured the
+    lease/owner/transfer planes."""
+    from cluster_anywhere_tpu.microbenchmark import run_serve_plane
+
+    rows = run_serve_plane(quick=True)
+    out = {}
+    for name, value, unit in rows:
+        key = name.replace("serve ", "").replace(" ", "_").replace("-", "_")
+        out[key] = round(value, 3)
+    log(f"serveplane: {out}")
+    return out
+
+
 def main():
     _, best_actor, _, logplane, drainplane, ownerplane, metricsplane = bench_core()
     transferplane = {}
@@ -502,6 +497,11 @@ def main():
         transferplane = bench_transfer_plane()
     except Exception as e:
         log(f"transfer plane bench failed: {e!r}")
+    serveplane = {}
+    try:
+        serveplane = bench_serve_plane()
+    except Exception as e:
+        log(f"serve plane bench failed: {e!r}")
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -523,6 +523,8 @@ def main():
         out["metricsplane"] = metricsplane
     if transferplane:
         out["transferplane"] = transferplane
+    if serveplane:
+        out["serveplane"] = serveplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

@@ -299,6 +299,38 @@ def cmd_status(args):
             print(f"  objects_transferred: {tp['objects_transferred']}")
     except Exception:
         pass
+    # serving plane: per-deployment target/actual replicas, last autoscale
+    # decision, drain state, and the admission/prefix/backpressure counters
+    try:
+        from .util.state import serve_plane
+
+        sp = serve_plane()
+        if sp["deployments"] or sp["counters"]:
+            print("== serving plane ==")
+            for app, deps in sorted(sp["deployments"].items()):
+                for dep, d in sorted(deps.items()):
+                    drain_note = (
+                        f" draining={len(d['draining_replicas'])}"
+                        if d.get("draining_replicas") else ""
+                    )
+                    scale = d.get("last_scale")
+                    scale_note = (
+                        f" last_scale={scale['direction']} "
+                        f"{scale['from']}->{scale['to']} "
+                        f"(avg_ongoing={scale['avg_ongoing']})"
+                        if scale else ""
+                    )
+                    print(
+                        f"  {app}/{dep}: {d['actual_replicas']}/"
+                        f"{d['target_replicas']} replicas ({d['status']})"
+                        f"{drain_note}{scale_note}"
+                    )
+            for k, v in sorted(sp["counters"].items()):
+                print(f"  {k}: {v}")
+            for k, v in sorted(sp["quantiles"].items()):
+                print(f"  {k}: {v:.4g}" if isinstance(v, float) else f"  {k}: {v}")
+    except Exception:
+        pass
     ca.shutdown()
 
 
@@ -733,6 +765,13 @@ def cmd_microbenchmark(args):
 
         run_transfer_plane(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "serve_plane", False):
+        # owns its own clusters (open-loop SSE envelope, shedding and
+        # prefix-cache A/Bs, drain-under-load zero-drop proof)
+        from .microbenchmark import run_serve_plane
+
+        run_serve_plane(quick=getattr(args, "quick", False))
+        return
 
     import cluster_anywhere_tpu as ca
 
@@ -985,6 +1024,11 @@ def main(argv=None):
         "--transfer", action="store_true",
         help="bulk-transfer A/B: serial vs windowed pulls (latency-injected "
         "link), 1 vs 2 sources, f32 vs int8/bf16 quantized ring",
+    )
+    sp.add_argument(
+        "--serve", dest="serve_plane", action="store_true",
+        help="serving-plane envelope: open-loop SSE req/s + TTFT/p99, "
+        "admission shedding A/B, prefix-cache A/B, drain-under-load proof",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

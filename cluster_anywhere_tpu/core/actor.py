@@ -28,6 +28,9 @@ _VALID_ACTOR_OPTIONS = {
     "placement_group_bundle_index",
     "scheduling_strategy",
     "runtime_env",
+    # False = exempt from automatic drain migration (supervisor-managed
+    # lifecycles, e.g. serve replicas: the controller drains them app-aware)
+    "drain_migration",
 }
 
 

@@ -138,6 +138,11 @@ class ActorRec:
     runtime_env: Optional[dict] = None
     strategy: Optional[dict] = None  # scheduling strategy wire dict
     node_id: Optional[str] = None  # where this incarnation runs
+    # drain hook: False opts this actor out of automatic drain migration —
+    # a supervisor (e.g. the serve controller) owns its lifecycle and drains
+    # it application-aware (replacements first, in-flight streams finish)
+    # instead of the head's restart-FSM migration killing it mid-request
+    drain_migration: bool = True
     # where this incarnation's resources are currently charged:
     # "pg" (bundle.used) | "node" (node.avail) | None (not charged) — guards
     # against double-crediting when a PG is removed before the actor's
@@ -523,6 +528,7 @@ class Head:
                     "pg_id": a.pg_id, "bundle_index": a.bundle_index,
                     "runtime_env": a.runtime_env, "strategy": a.strategy,
                     "node_id": a.node_id, "charged": a.charged,
+                    "drain_migration": a.drain_migration,
                 }
                 for a in self.actors.values()
             ],
@@ -1629,6 +1635,11 @@ class Head:
                 if node.state != "draining":
                     return
                 if a.node_id == node.node_id and a.state == "alive":
+                    if not a.drain_migration:
+                        # supervisor-managed (serve replicas): the owner
+                        # drains it app-aware; the deadline kill still
+                        # applies if the supervisor doesn't finish in time
+                        continue
                     await self._migrate_actor(a, node)
             await self._evacuate_objects(node)
         except asyncio.CancelledError:
@@ -2415,6 +2426,7 @@ class Head:
             bundle_index=msg.get("bundle_index", -1),
             runtime_env=msg.get("runtime_env"),
             strategy=msg.get("strategy"),
+            drain_migration=msg.get("drain_migration", True),
         )
         if a.name:
             if a.name in self.named_actors:

@@ -85,3 +85,20 @@ class ObjectRefGenerator:
     def completed(self) -> bool:
         with self._state.cond:
             return self._state.ended and self._state.next_read >= self._state.produced
+
+    def cancel(self) -> None:
+        """Abandon the stream: interrupt the producer (TaskCancelledError at
+        its next bytecode boundary, the normal ca.cancel path) and end the
+        local stream so blocked __next__ callers wake with the error.  A
+        consumer that stops reading mid-stream MUST call this — otherwise
+        the producer keeps generating until its backpressure window fills
+        (serve SSE client-disconnect path).  Idempotent; safe from any
+        thread."""
+        from ..core.errors import TaskCancelledError
+
+        st = self._state
+        with st.cond:
+            already = st.ended
+        if not already:
+            self._worker.cancel_stream(st)
+            st.on_end(TaskCancelledError("stream abandoned by consumer"))

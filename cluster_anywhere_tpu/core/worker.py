@@ -1038,6 +1038,14 @@ class Worker:
             DRAIN_STATS["leases_recalled_total"] += len(recalled)
             self.return_leases(recalled)
 
+    def draining_node_ids(self) -> set:
+        """Node ids currently inside an announced drain window (fed by the
+        head's `drain` pubs; entries expire at deadline+grace).  The serve
+        controller reads this to stop routing to / start replacing replicas
+        on exiting nodes with ZERO extra head RPCs.  Thread-safe snapshot."""
+        now = time.monotonic()
+        return {n for n, exp in dict(self._draining_nodes).items() if exp > now}
+
     def _retry_exempt(self, node_id: Optional[str]) -> bool:
         """Is a worker death on `node_id` inside a drain window?  Exempt
         retries don't consume max_retries (announced exits are the system's
@@ -2095,6 +2103,31 @@ class Worker:
             self.loop.call_soon_threadsafe(_send)
         except RuntimeError:
             pass
+
+    def cancel_stream(self, st) -> None:
+        """Abandon one in-flight streaming task (ObjectRefGenerator.cancel):
+        deliver a cancel to the executing worker so the producer generator
+        stops, and drop the local stream state so late items are ignored
+        (thread-safe; the _on_peer_push miss path treats unknown task ids as
+        abandoned streams already)."""
+        tid = st.task_id.binary()
+
+        def _do():
+            self._streams.pop(tid, None)
+            self._cancelled_tasks.add(tid)
+            addr = st.addr or self._inflight_tasks.get(tid)
+            if addr is not None:
+                conn = self._conns.get(self._normalize_peer_addr(addr)) or self._conns.get(addr)
+                if conn is not None and not conn.closed:
+                    try:
+                        conn.notify("cancel", task_id=tid, force=False)
+                    except Exception:
+                        pass  # producer already gone: nothing left to stop
+
+        try:
+            self.loop.call_soon_threadsafe(_do)
+        except RuntimeError:
+            pass  # loop shutting down: the producer dies with the process
 
     def submit_streaming_task(self, fn, args, kwargs, opts: Dict[str, Any]):
         """Submit a generator task; returns an ObjectRefGenerator
@@ -4033,6 +4066,7 @@ class Worker:
                 bundle_index=opts.get("placement_group_bundle_index", -1),
                 runtime_env=wire_env,
                 strategy=opts.get("strategy"),
+                drain_migration=bool(opts.get("drain_migration", True)),
                 timeout=None,
             )
             return reply

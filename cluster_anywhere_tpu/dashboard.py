@@ -11,6 +11,7 @@ Endpoints:
   GET /api/objects    object directory sample
   GET /api/tasks      recent task events
   GET /api/pgs        placement groups
+  GET /api/serve      serving plane (replica targets, drain, last autoscale)
   GET /metrics        Prometheus text (user + runtime metrics)
 
 Zero extra process: the head owns every table locally, so requests are
@@ -430,6 +431,19 @@ class Dashboard:
                     for p in h.pgs.values()
                 ]
             )
+        if path == "/api/serve":
+            # serving plane: the controller's ~1s digest (target/actual
+            # replicas, per-replica node/queue/draining, last autoscale
+            # decision) rides the head KV, so this works even while the
+            # controller actor is busy reconciling
+            raw = h.kv.get("", {}).get("serve:plane")
+            plane = {}
+            if raw:
+                try:
+                    plane = json.loads(raw)
+                except Exception:
+                    plane = {}
+            return self._json({"deployments": plane})
         if path == "/api/timeseries":
             # metrics-plane history: the head's retention store (ring
             # buffers, two tiers), counter→rate derivable server-side

@@ -42,6 +42,7 @@ from ..models.generate import (
     _nucleus_mask,
     _rms_norm,
     _sample,
+    decode_one,
     prefill,
 )
 from ..models.transformer import TransformerConfig
@@ -113,6 +114,61 @@ def _install_slot(cache, slot_k, slot_v, slot):
     }
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _suffix_step(params, rows, token, pos, pad, *, cfg):
+    """One teacher-forced token over a SINGLE request's cache rows
+    ([L, 1, t_max, KV, D], donated — updated in place) during chunked admit:
+    feeds a known prompt token at cache slot `pos`, returns the next-token
+    logits [1, V] and the updated rows.  The prefix-cache admit path runs
+    the un-cached tail of the prompt through this instead of prefill, so a
+    warm hit and a cold miss compute the suffix IDENTICALLY (bit-equal
+    outputs is the cache's correctness contract)."""
+    return decode_one(params, rows, token, pos, cfg, pad)
+
+
+class PrefixCache:
+    """Bounded LRU of prefilled prompt-prefix KV rows, keyed by the prefix
+    token content (+ bucket shape).  A hit hands the admit path device-ready
+    rows — the shared system prompt's prefill is skipped entirely and only
+    the request's unique tail is computed."""
+
+    def __init__(self, entries: int):
+        from collections import OrderedDict
+
+        self.entries = entries
+        self._d: "OrderedDict[str, dict]" = OrderedDict()
+        self.evictions = 0
+
+    @staticmethod
+    def key(prefix_ids: np.ndarray, bucket: int) -> str:
+        import hashlib
+
+        h = hashlib.sha1(np.ascontiguousarray(prefix_ids, np.int32).tobytes())
+        return f"{h.hexdigest()}:{len(prefix_ids)}:{bucket}"
+
+    def get(self, key: str):
+        e = self._d.get(key)
+        if e is not None:
+            self._d.move_to_end(key)
+        return e
+
+    def put(self, key: str, rows: dict, pad: int) -> None:
+        self._d[key] = {"rows": rows, "pad": pad}
+        while len(self._d) > self.entries:
+            self._d.popitem(last=False)
+            self.evictions += 1
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def memory_bytes(self) -> int:
+        return sum(
+            int(a.size) * a.dtype.itemsize
+            for e in self._d.values()
+            for a in e["rows"].values()
+        )
+
+
 class ContinuousBatcher:
     """Iteration-level scheduler over a fixed slot pool (see module doc).
 
@@ -129,6 +185,8 @@ class ContinuousBatcher:
         t_max: int = 512,
         prefill_buckets: (tuple) = (64, 128, 256),
         top_k: int = 0,
+        prefix_cache_entries: int = 0,
+        prefix_block: int = 16,
     ):
         self.params = params
         self.cfg = cfg
@@ -136,6 +194,25 @@ class ContinuousBatcher:
         self.t_max = t_max
         self.top_k = top_k
         self.prefill_buckets = tuple(sorted(prefill_buckets))
+        # prefix/KV reuse (0 entries = off, the pre-cache admit path
+        # verbatim).  When on, admit splits the prompt at the largest
+        # prefix_block multiple: the prefix prefills once and its KV rows
+        # are cached; the suffix is teacher-forced through _suffix_step on
+        # BOTH hit and miss so outputs are bit-identical either way.
+        self.prefix_cache = (
+            PrefixCache(prefix_cache_entries) if prefix_cache_entries > 0 else None
+        )
+        self.prefix_block = max(1, int(prefix_block))
+        # split granularity: prefix prefill compiles one XLA program per
+        # DISTINCT split length (the configured buckets rarely leave decode
+        # room for bucket + suffix + max_new, so the exact-split fallback is
+        # the common case).  Quantizing splits to max(block, longest
+        # bucket/8) bounds the program count at ~8 for any prompt length —
+        # a recompile stalls the shared pump thread, so an unbounded shape
+        # family would freeze live streams on long-tail traffic.
+        longest = self.prefill_buckets[-1] if self.prefill_buckets else t_max
+        q = max(self.prefix_block, longest // 8)
+        self._split_quantum = -(-q // self.prefix_block) * self.prefix_block
         self.cache = {
             "k": jnp.zeros(
                 (cfg.n_layers, slots, t_max, cfg.n_kv_heads, cfg.d_head), cfg.dtype
@@ -157,7 +234,10 @@ class ContinuousBatcher:
         self._completed: deque[Request] = deque(maxlen=4096)
         self._ids = itertools.count(1)
         self._rng = jax.random.key(0)
-        self.stats = {"admitted": 0, "finished": 0, "decode_steps": 0}
+        self.stats = {
+            "admitted": 0, "finished": 0, "decode_steps": 0, "cancelled": 0,
+            "prefix_hits": 0, "prefix_misses": 0, "prefix_tokens_reused": 0,
+        }
 
     # ------------------------------------------------------------- interface
     def submit(
@@ -182,6 +262,25 @@ class ContinuousBatcher:
         )
         self.queue.append(req)
         return req
+
+    def cancel(self, request_id: int) -> bool:
+        """Abort one request: drop it from the queue, or free its slot so
+        the next admit reuses it immediately (abandoned-stream path — the
+        consumer is gone, decoding its remaining tokens is pure waste).
+        Returns False when the request already finished (no-op)."""
+        for i, r in enumerate(self.queue):
+            if r.request_id == request_id:
+                del self.queue[i]
+                r.done = True
+                self.stats["cancelled"] += 1
+                return True
+        for s, r in enumerate(self._by_slot):
+            if r is not None and r.request_id == request_id:
+                r.done = True
+                self._by_slot[s] = None  # lane decodes garbage; rows frozen
+                self.stats["cancelled"] += 1
+                return True
+        return False
 
     @property
     def has_work(self) -> bool:
@@ -250,25 +349,93 @@ class ContinuousBatcher:
                 return b
         return n
 
-    def _admit(self, out: Optional[Dict[int, List[int]]] = None) -> None:
-        while self.queue and None in self._by_slot:
-            req = self.queue.popleft()
-            slot = self._by_slot.index(None)
-            prompt = req.prompt_ids
-            bucket = self._bucket(len(prompt), req.max_new_tokens)
+    def _prefix_split(self, prompt: np.ndarray) -> int:
+        """Cacheable prefix length: the largest _split_quantum multiple that
+        still leaves >= 1 suffix token (the last prompt token must be
+        teacher-forced through _suffix_step to produce first-token logits).
+        0 = no usable prefix (prompt too short)."""
+        split = ((len(prompt) - 1) // self._split_quantum) * self._split_quantum
+        return split if split >= self.prefix_block else 0
+
+    def _admit_full_prefill(self, req: Request):
+        """Cold admit: prefill the whole prompt (one bucketed batch-1
+        program).  Returns (first-token logits [1,V], slot rows, pad,
+        next_pos)."""
+        prompt = req.prompt_ids
+        bucket = self._bucket(len(prompt), req.max_new_tokens)
+        padded = np.zeros(bucket, np.int32)
+        pad = bucket - len(prompt)
+        padded[pad:] = prompt  # LEFT pad: generate.py's prefill contract
+        logits, rowcache = prefill(
+            self.params,
+            jnp.asarray(padded[None]),
+            self.cfg,
+            self.t_max,
+            pad=jnp.asarray([pad], np.int32),
+        )
+        rows = {"k": rowcache["k"][:, 0], "v": rowcache["v"][:, 0]}
+        return logits, rows, pad, bucket
+
+    def _admit_prefix_cached(self, req: Request, split: int):
+        """Chunked admit via the prefix cache: the block-aligned prefix
+        comes from the cache (or prefills once, populating it); the suffix
+        teacher-forces through _suffix_step token by token.  Hit and miss
+        run the SAME suffix computation on the same prefix rows, so the
+        produced tokens are bit-identical either way — a hit just skips the
+        prefix prefill (the TTFT win on shared-system-prompt traffic)."""
+        prompt = req.prompt_ids
+        suffix = prompt[split:]
+        # bucket must leave room for the stepped suffix AND decode
+        bucket = self._bucket(split, req.max_new_tokens + len(suffix))
+        key = PrefixCache.key(prompt[:split], bucket)
+        entry = self.prefix_cache.get(key)
+        if entry is None:
             padded = np.zeros(bucket, np.int32)
-            pad = bucket - len(prompt)
-            padded[pad:] = prompt  # LEFT pad: generate.py's prefill contract
-            logits, rowcache = prefill(
+            pad = bucket - split
+            padded[pad:] = prompt[:split]
+            _, rowcache = prefill(
                 self.params,
                 jnp.asarray(padded[None]),
                 self.cfg,
                 self.t_max,
                 pad=jnp.asarray([pad], np.int32),
             )
-            self.cache = _install_slot(
-                self.cache, rowcache["k"][:, 0], rowcache["v"][:, 0], slot
+            rows = {"k": rowcache["k"][:, 0:1], "v": rowcache["v"][:, 0:1]}
+            # store a snapshot BEFORE stepping: _suffix_step donates its rows
+            self.prefix_cache.put(
+                key, {k: jnp.copy(v) for k, v in rows.items()}, pad
             )
+            self.stats["prefix_misses"] += 1
+        else:
+            pad = entry["pad"]
+            rows = {k: jnp.copy(v) for k, v in entry["rows"].items()}
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += split
+        pad_arr = jnp.asarray([pad], np.int32)
+        logits = None
+        for i, tok in enumerate(suffix):
+            logits, rows = _suffix_step(
+                self.params, rows,
+                jnp.asarray([int(tok)], np.int32),
+                jnp.asarray(bucket + i, np.int32),
+                pad_arr, cfg=self.cfg,
+            )
+        return logits, {"k": rows["k"][:, 0], "v": rows["v"][:, 0]}, pad, bucket + len(suffix)
+
+    def _admit(self, out: Optional[Dict[int, List[int]]] = None) -> None:
+        while self.queue and None in self._by_slot:
+            req = self.queue.popleft()
+            slot = self._by_slot.index(None)
+            split = (
+                self._prefix_split(req.prompt_ids)
+                if self.prefix_cache is not None
+                else 0
+            )
+            if split:
+                logits, rows, pad, next_pos = self._admit_prefix_cached(req, split)
+            else:
+                logits, rows, pad, next_pos = self._admit_full_prefill(req)
+            self.cache = _install_slot(self.cache, rows["k"], rows["v"], slot)
             self._rng, k = jax.random.split(self._rng)
             first = int(
                 np.asarray(
@@ -284,7 +451,7 @@ class ContinuousBatcher:
             req.slot = slot
             self._by_slot[slot] = req
             self._tokens[slot] = first
-            self._pos[slot] = bucket  # next write lands after the prompt
+            self._pos[slot] = next_pos  # next write lands after the prompt
             self._pads[slot] = pad
             self._temps[slot] = req.temperature
             self._topks[slot] = req.top_k

@@ -68,6 +68,10 @@ class ProcessorConfig:
     top_p: float = 1.0
     apply_chat_template: bool = False
     system_prompt: str = ""
+    # prefix/KV-cache reuse in ContinuousLLMServer: requests sharing a
+    # system-prompt prefix skip its prefill (0 entries disables)
+    prefix_cache_entries: int = 8
+    prefix_block: int = 16
 
 
 class _InferenceWorker:

@@ -129,7 +129,10 @@ class ContinuousLLMServer:
         self.cb = ContinuousBatcher(
             params, tcfg, slots=slots, t_max=t_max,
             prefill_buckets=(config.max_prompt_len,), top_k=config.top_k,
+            prefix_cache_entries=getattr(config, "prefix_cache_entries", 8),
+            prefix_block=getattr(config, "prefix_block", 16),
         )
+        self._metrics_synced: dict = {}
         self._lock = threading.Lock()  # batcher is single-threaded inside
         self._queues: dict = {}  # request_id -> queue of token ids (+ None EOF)
         self._reqs: dict = {}  # request_id -> Request (done detection)
@@ -159,10 +162,45 @@ class ContinuousLLMServer:
         except Exception:
             pass
 
+    _llm_metrics: dict = {}  # class-level: one registry entry per process
+
+    def _sync_engine_metrics(self):
+        """Ship the batcher's counters (prefix-cache hits/misses/tokens
+        reused, decode steps) as ca_serve_* cluster metrics — the series
+        behind the envelope's "hits skip prefill" claim."""
+        if not self._llm_metrics:
+            from ..util import metrics as m
+
+            for key, name, desc in (
+                ("prefix_hits", "ca_serve_prefix_hits_total",
+                 "LLM admits that reused cached prefix KV rows"),
+                ("prefix_misses", "ca_serve_prefix_misses_total",
+                 "LLM admits that prefilled (and cached) their prefix"),
+                ("prefix_tokens_reused", "ca_serve_prefix_tokens_reused_total",
+                 "prompt tokens whose prefill was skipped via the prefix cache"),
+                ("decode_steps", "ca_serve_decode_steps_total",
+                 "continuous-batcher decode iterations"),
+            ):
+                self._llm_metrics[key] = m.Counter(name, desc)
+        for key, counter in self._llm_metrics.items():
+            cur = self.cb.stats.get(key, 0)
+            delta = cur - self._metrics_synced.get(key, 0)
+            if delta:
+                counter.inc(delta)
+                self._metrics_synced[key] = cur
+
     def _pump_loop(self):
         import time as _time
 
+        last_sync = 0.0
         while not self._stop:
+            now = _time.monotonic()
+            if now - last_sync > 1.0:
+                last_sync = now
+                try:
+                    self._sync_engine_metrics()
+                except Exception:
+                    pass  # metrics must never kill the decode pump
             try:
                 with self._lock:
                     work = self.cb.has_work
@@ -222,6 +260,10 @@ class ContinuousLLMServer:
         with self._lock:
             self._queues.pop(req.request_id, None)
             self._reqs.pop(req.request_id, None)
+            if not req.done:
+                # consumer abandoned mid-decode (SSE client disconnect):
+                # free the slot NOW instead of decoding tokens nobody reads
+                self.cb.cancel(req.request_id)
 
     def __call__(self, request) -> Dict[str, Any]:
         prompt, req, q = self._submit(_parse_body(request))
@@ -264,6 +306,23 @@ class ContinuousLLMServer:
             self._forget(req)
 
 
+class StreamingLLMIngress(ContinuousLLMServer):
+    """ContinuousLLMServer whose __call__ STREAMS when the HTTP client asks
+    for SSE (Accept: text/event-stream) and answers one JSON body otherwise
+    — the proxy's SSE path invokes the ingress's __call__, so token
+    streaming over plain `curl -H 'Accept: text/event-stream'` needs the
+    branch here."""
+
+    def __call__(self, request):
+        from ..serve import Request
+
+        if isinstance(request, Request) and "text/event-stream" in request.headers.get(
+            "accept", ""
+        ):
+            return self.stream(request)  # generator -> one SSE event per token
+        return ContinuousLLMServer.__call__(self, request)
+
+
 def build_continuous_llm_deployment(
     config: Optional[ProcessorConfig] = None,
     *,
@@ -271,17 +330,24 @@ def build_continuous_llm_deployment(
     num_replicas: int = 1,
     num_tpus: float = 0.0,
     name: str = "ContinuousLLMServer",
+    admission=None,
+    autoscaling_config=None,
+    sse_ingress: bool = False,
 ):
     """Continuous-batching twin of build_llm_deployment: up to `slots`
-    requests share every decode iteration on each replica."""
+    requests share every decode iteration on each replica.  `admission`
+    (AdmissionPolicy/dict) arms the proxy's load-shedding gate;
+    `sse_ingress=True` serves token-streaming SSE from __call__."""
     from .. import serve
 
     config = config or ProcessorConfig()
     dep = serve.deployment(
-        ContinuousLLMServer,
+        StreamingLLMIngress if sse_ingress else ContinuousLLMServer,
         name=name,
         num_replicas=num_replicas,
         num_tpus=num_tpus,
         max_ongoing_requests=slots,  # callers block in __call__; pump is a thread
+        admission=admission,
+        autoscaling_config=autoscaling_config,
     )
     return dep.bind(config, slots)

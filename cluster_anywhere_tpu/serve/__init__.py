@@ -21,7 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 from ..core import api as ca
 from ..core.actor import get_actor, kill
 from .batching import batch
-from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .config import AdmissionPolicy, AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .controller import CONTROLLER_NAME, ServeController, get_or_create_controller
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .grpc_proxy import grpc_call, grpc_call_typed, grpc_healthz, grpc_list_applications
@@ -54,6 +54,12 @@ class Deployment:
         import dataclasses
 
         name = kw.pop("name", self.name)
+        # dict spellings accepted everywhere the dataclasses are (config
+        # files route through here)
+        if isinstance(kw.get("autoscaling_config"), dict):
+            kw["autoscaling_config"] = AutoscalingConfig(**kw["autoscaling_config"])
+        if isinstance(kw.get("admission"), dict):
+            kw["admission"] = AdmissionPolicy(**kw["admission"])
         cfg_kw = {}
         for f in dataclasses.fields(DeploymentConfig):
             if f.name in kw:
@@ -75,6 +81,7 @@ def deployment(
     max_ongoing_requests: int = 8,
     user_config: Optional[Dict[str, Any]] = None,
     autoscaling_config: Optional[Union[AutoscalingConfig, Dict[str, Any]]] = None,
+    admission: Optional[Union["AdmissionPolicy", Dict[str, Any]]] = None,
     num_cpus: float = 1.0,
     num_tpus: float = 0.0,
     resources: Optional[Dict[str, float]] = None,
@@ -89,6 +96,7 @@ def deployment(
             asc = AutoscalingConfig(**autoscaling_config)
         else:
             asc = autoscaling_config
+        adm = AdmissionPolicy(**admission) if isinstance(admission, dict) else admission
         n_replicas = num_replicas
         if n_replicas == "auto":
             n_replicas = None
@@ -99,6 +107,7 @@ def deployment(
             max_ongoing_requests=max_ongoing_requests,
             user_config=user_config,
             autoscaling_config=asc,
+            admission=adm,
             num_cpus=num_cpus,
             num_tpus=num_tpus,
             resources=resources or {},
@@ -162,8 +171,13 @@ def start(http_options: Optional[HTTPOptions] = None, grpc_port: Optional[int] =
     try:
         get_actor(PROXY_NAME)
     except Exception:
+        from ..core.scheduling_strategies import NodeAffinitySchedulingStrategy as _NA
+
         Proxy = ca.remote(ProxyActor).options(
-            name=PROXY_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=4
+            name=PROXY_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=4,
+            # the proxy owns live client sockets: pin it to the undrainable
+            # head node so a worker-node drain can't restart it mid-stream
+            scheduling_strategy=_NA("n0", soft=True),
         )
         h = Proxy.remote(opts.host, opts.port)
         ca.get(h.ready.remote(), timeout=30)
@@ -280,6 +294,7 @@ __all__ = [
     "DeploymentResponse",
     "DeploymentConfig",
     "AutoscalingConfig",
+    "AdmissionPolicy",
     "HTTPOptions",
     "Request",
     "batch",

@@ -53,9 +53,26 @@ class _DeploymentState:
         self.generation = 0
         self.retired = False
         self._last_scale_t = 0.0
+        # drain plane: replicas on announced-exiting nodes.  STICKY — once a
+        # replica is draining it only leaves the set by being retired/dying,
+        # never by the drain window expiring (a node past its deadline is
+        # about to be killed, not coming back).  Routers stop picking these;
+        # the reconcile pass starts replacements first and retires each
+        # draining replica once it has zero in-flight requests.
+        self.draining_rids: set = set()
+        self.draining_marked: Dict[str, float] = {}  # rid -> monotonic mark time
+        self.replica_nodes: Dict[str, str] = {}  # replica_id -> node_id
+        self.qlens: Dict[str, int] = {}  # replica_id -> last reported ongoing
+        # autoscale observability: the last actual scale decision and the
+        # last observation that informed one (ca status / /api/serve)
+        self.last_scale: Optional[Dict[str, Any]] = None
+        self.last_autoscale_obs: Optional[Dict[str, Any]] = None
 
     def key(self) -> str:
         return f"{self.app}/{self.name}"
+
+    def active_rids(self) -> List[str]:
+        return [rid for rid in self.replicas if rid not in self.draining_rids]
 
 
 class ServeController:
@@ -65,6 +82,7 @@ class ServeController:
         self.ingress: Dict[str, str] = {}  # app -> ingress deployment name
         self._lock = threading.RLock()
         self._stopped = False
+        self._last_plane_pub = 0.0
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         )
@@ -100,6 +118,10 @@ class ServeController:
                         # same code: keep live replicas, push config deltas
                         st.replicas = old.replicas
                         st.generation = old.generation
+                        st.draining_rids = old.draining_rids
+                        st.draining_marked = old.draining_marked
+                        st.replica_nodes = old.replica_nodes
+                        st.qlens = old.qlens
                         if cfg.user_config is not None and old.cfg.user_config != cfg.user_config:
                             for h in st.replicas.values():
                                 try:
@@ -163,6 +185,10 @@ class ServeController:
             except Exception:
                 pass
         st.replicas.clear()
+        st.draining_rids.clear()
+        st.draining_marked.clear()
+        st.qlens.clear()
+        st.replica_nodes.clear()
 
     # ----------------------------------------------------------- router API
     def get_deployment_info(self, app: str, deployment: str) -> Dict[str, Any]:
@@ -172,7 +198,17 @@ class ServeController:
                 "version": st.version,
                 "max_ongoing_requests": st.cfg.max_ongoing_requests,
                 "replicas": [
-                    {"replica_id": rid, "actor_name": self._replica_actor_name(st, rid)}
+                    {
+                        "replica_id": rid,
+                        "actor_name": self._replica_actor_name(st, rid),
+                        # routers stop picking draining replicas (in-flight
+                        # streams on them run to completion)
+                        "draining": rid in st.draining_rids,
+                        # last controller-observed ongoing count: the shared
+                        # load signal behind power-of-two-choices (each
+                        # router's local view only sees its own traffic)
+                        "queue_len": int(st.qlens.get(rid, 0)),
+                    }
                     for rid in st.replicas
                 ],
             }
@@ -197,26 +233,78 @@ class ServeController:
                 "ingress": self.ingress.get(app, ""),
             }
 
-    def list_routes(self) -> Dict[str, Dict[str, str]]:
+    def list_routes(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
-            return {
-                app: {"route_prefix": self.route_prefixes.get(app, "/"), "ingress": ing}
-                for app, ing in self.ingress.items()
-            }
+            out: Dict[str, Dict[str, Any]] = {}
+            for app, ing in self.ingress.items():
+                info: Dict[str, Any] = {
+                    "route_prefix": self.route_prefixes.get(app, "/"),
+                    "ingress": ing,
+                }
+                st = self.apps.get(app, {}).get(ing)
+                if st is not None:
+                    # the proxy's admission gate rides the route table: the
+                    # policy plus the live capacity its depth cap derives from
+                    info["max_ongoing_requests"] = st.cfg.max_ongoing_requests
+                    info["replicas"] = len(st.active_rids()) or len(st.replicas)
+                    if st.cfg.admission is not None:
+                        info["admission"] = st.cfg.admission.to_wire()
+                out[app] = info
+            return out
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
             out: Dict[str, Any] = {}
             for app_name, app in self.apps.items():
-                out[app_name] = {
-                    name: DeploymentStatus(
+                out[app_name] = {}
+                for name, st in app.items():
+                    n_drain = len(st.draining_rids & set(st.replicas))
+                    states = {"RUNNING": len(st.replicas) - n_drain}
+                    if n_drain:
+                        states["DRAINING"] = n_drain
+                    out[app_name][name] = DeploymentStatus(
                         name=name,
                         status=st.status,
-                        replica_states={"RUNNING": len(st.replicas)},
+                        replica_states=states,
                         message=st.message,
                     ).__dict__
-                    for name, st in app.items()
-                }
+            return out
+
+    def serve_plane_info(self) -> Dict[str, Any]:
+        """Autoscale + drain observability: per-deployment target vs actual
+        replicas, per-replica node/queue/draining state, and the last scale
+        decision — the payload behind `ca status`, /api/serve, and
+        util.state.serve_plane()."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for app_name, app in self.apps.items():
+                out[app_name] = {}
+                for name, st in app.items():
+                    out[app_name][name] = {
+                        "status": st.status,
+                        "version": st.version,
+                        "target_replicas": st.target,
+                        "actual_replicas": len(st.replicas),
+                        "draining_replicas": sorted(
+                            st.draining_rids & set(st.replicas)
+                        ),
+                        "max_ongoing_requests": st.cfg.max_ongoing_requests,
+                        "autoscaling": st.cfg.autoscaling_config is not None,
+                        "admission": (
+                            st.cfg.admission.to_wire()
+                            if st.cfg.admission is not None else None
+                        ),
+                        "replicas": {
+                            rid: {
+                                "node_id": st.replica_nodes.get(rid),
+                                "queue_len": int(st.qlens.get(rid, 0)),
+                                "draining": rid in st.draining_rids,
+                            }
+                            for rid in st.replicas
+                        },
+                        "last_scale": st.last_scale,
+                        "last_autoscale_obs": st.last_autoscale_obs,
+                    }
             return out
 
     def ping(self) -> str:
@@ -234,6 +322,37 @@ class ServeController:
         # with the names the replacement state will use
         return f"SERVE_REPLICA::{st.app}::{st.name}::g{st.generation}::{rid}"
 
+    def _draining_node_ids(self) -> set:
+        """Nodes inside an announced drain window.  The head pushes `drain`
+        pubs to every client — including this controller's host process — so
+        the read is a local dict lookup, zero RPCs."""
+        try:
+            from ..core.worker import global_worker
+
+            return global_worker().draining_node_ids()
+        except Exception:
+            return set()
+
+    def _publish_plane_digest(self):
+        """Ship serve_plane_info to the head KV (~1/s): `ca status`, the
+        dashboard's /api/serve, and util.state.serve_plane() read it without
+        needing an actor round-trip to this controller."""
+        import json as _json
+
+        now = time.monotonic()
+        if now - self._last_plane_pub < 1.0:
+            return
+        self._last_plane_pub = now
+        try:
+            from ..core.worker import global_worker
+
+            global_worker().head_call(
+                "kv_put", key="serve:plane",
+                value=_json.dumps(self.serve_plane_info(), default=str).encode(),
+            )
+        except Exception:
+            pass  # head briefly unreachable: next tick retries
+
     def _reconcile_loop(self):
         while not self._stopped:
             try:
@@ -241,25 +360,64 @@ class ServeController:
                     states = [
                         st for app in self.apps.values() for st in app.values()
                     ]
+                draining_nodes = self._draining_node_ids()
                 for st in states:
+                    self._mark_draining(st, draining_nodes)
                     self._reconcile_deployment(st)
                     self._autoscale(st)
+                self._publish_plane_digest()
             except Exception:
                 traceback.print_exc()
             time.sleep(0.1)
+
+    def _mark_draining(self, st: _DeploymentState, draining_nodes: set):
+        """Flag replicas hosted on announced-exiting nodes (sticky).  The
+        version bump makes every router refresh and stop picking them —
+        step one of the zero-drop drain story."""
+        if not draining_nodes or st.retired:
+            return
+        newly = {
+            rid
+            for rid, nid in st.replica_nodes.items()
+            if nid in draining_nodes and rid in st.replicas
+        } - st.draining_rids
+        if newly:
+            now = time.monotonic()
+            with self._lock:
+                st.draining_rids |= newly
+                for rid in newly:
+                    st.draining_marked[rid] = now
+            self._bump_version(st)
 
     def _bump_version(self, st: _DeploymentState):
         with self._lock:
             st.version += 1
 
+    def _retire_replica(self, st: _DeploymentState, rid: str, h) -> None:
+        try:
+            ca.get(h.prepare_shutdown.remote(), timeout=st.cfg.graceful_shutdown_timeout_s)
+        except Exception:
+            pass
+        try:
+            kill(h)
+        except Exception:
+            pass
+
     def _reconcile_deployment(self, st: _DeploymentState):
         if st.retired:
             return
-        # replace dead replicas
+        # telemetry doubles as the health check: one RPC per replica per
+        # pass yields alive/deadness, the ongoing-request count (router P2C
+        # signal + drain retirement gate + autoscale input), and the hosting
+        # node (drain detection)
         dead = []
         for rid, h in list(st.replicas.items()):
             try:
-                ca.get(h.check_health.remote(), timeout=30)
+                t = ca.get(h.telemetry.remote(), timeout=30)
+                with self._lock:
+                    st.qlens[rid] = int(t.get("queue_len", 0))
+                    if t.get("node_id"):
+                        st.replica_nodes[rid] = t["node_id"]
             except Exception:
                 dead.append(rid)
         for rid in dead:
@@ -269,10 +427,18 @@ class ServeController:
                 pass
             with self._lock:
                 st.replicas.pop(rid, None)
+                st.draining_rids.discard(rid)
+                st.draining_marked.pop(rid, None)
+                st.qlens.pop(rid, None)
+                st.replica_nodes.pop(rid, None)
         if dead:
             self._bump_version(st)
         changed = False
-        while len(st.replicas) < st.target and not self._stopped and not st.retired:
+        # replacements FIRST: spawn until the ACTIVE (non-draining) count
+        # reaches target.  Draining replicas keep serving their in-flight
+        # requests but no longer count toward capacity; new actors place on
+        # survivors automatically (the head excludes draining nodes).
+        while len(st.active_rids()) < st.target and not self._stopped and not st.retired:
             with self._lock:
                 rid = f"r{st.replica_counter}"
                 st.replica_counter += 1
@@ -290,7 +456,7 @@ class ServeController:
                     rid,
                     deployment_name=f"{st.app}:{st.name}",
                 )
-                ca.get(h.check_health.remote(), timeout=60)
+                t = ca.get(h.telemetry.remote(), timeout=60)
             except Exception as e:
                 st.status = "UNHEALTHY"
                 st.message = f"replica start failed: {e!r}"
@@ -304,36 +470,62 @@ class ServeController:
                 return
             with self._lock:
                 st.replicas[rid] = h
+                st.qlens[rid] = 0
+                if t.get("node_id"):
+                    st.replica_nodes[rid] = t["node_id"]
             changed = True
-        while len(st.replicas) > st.target:
+        # normal downscale: retire surplus ACTIVE replicas (draining ones
+        # are on their own retirement track below)
+        while len(st.active_rids()) > st.target:
             with self._lock:
-                rid = next(iter(st.replicas))
+                rid = st.active_rids()[0]
                 h = st.replicas.pop(rid)
-            try:
-                ca.get(h.prepare_shutdown.remote(), timeout=st.cfg.graceful_shutdown_timeout_s)
-            except Exception:
-                pass
-            try:
-                kill(h)
-            except Exception:
-                pass
+                st.qlens.pop(rid, None)
+                st.replica_nodes.pop(rid, None)
+            self._retire_replica(st, rid, h)
             changed = True
+        # drain retirement: once replacements are up, retire each draining
+        # replica when its last in-flight request (including SSE streams)
+        # finishes.  The grace window matters: routers only refresh on-route
+        # (~1s period), so a replica marked draining can still RECEIVE a
+        # request for up to a refresh period — killing it at the first
+        # qlen==0 sample would race that request.  2.5s > 2x refresh closes
+        # the window; after it, every router has seen the draining flag.
+        if st.draining_rids:
+            now = time.monotonic()
+            for rid in sorted(st.draining_rids & set(st.replicas)):
+                if len(st.active_rids()) < st.target:
+                    break  # replacements not ready: keep serving
+                if now - st.draining_marked.get(rid, 0.0) < 2.5:
+                    continue  # routers may still route here: too early
+                if st.qlens.get(rid, 1) != 0:
+                    continue  # in-flight work: let it run out
+                with self._lock:
+                    h = st.replicas.pop(rid)
+                    st.draining_rids.discard(rid)
+                    st.draining_marked.pop(rid, None)
+                    st.qlens.pop(rid, None)
+                    st.replica_nodes.pop(rid, None)
+                self._retire_replica(st, rid, h)
+                changed = True
         if changed:
             self._bump_version(st)
-        st.status = "HEALTHY" if len(st.replicas) == st.target else "UPDATING"
+        st.status = (
+            "HEALTHY" if len(st.active_rids()) == st.target else "UPDATING"
+        )
         if st.status == "HEALTHY":
             st.message = ""
 
     def _autoscale(self, st: _DeploymentState):
         cfg = st.cfg.autoscaling_config
-        if cfg is None or not st.replicas:
+        if cfg is None or not st.replicas or st.retired:
             return
-        lens = []
-        for h in list(st.replicas.values()):
-            try:
-                lens.append(ca.get(h.get_queue_len.remote(), timeout=5))
-            except Exception:
-                pass
+        # draining replicas are excluded: their load is migrating to the
+        # actives, and counting them would double the apparent demand right
+        # when capacity planning matters most
+        lens = [
+            st.qlens[rid] for rid in st.active_rids() if rid in st.qlens
+        ]
         if not lens:
             return
         avg = sum(lens) / len(lens)
@@ -347,22 +539,45 @@ class ServeController:
             ),
         )
         now = time.monotonic()
+        st.last_autoscale_obs = {
+            "ts": time.time(),
+            "avg_ongoing": round(avg, 3),
+            "active_replicas": len(lens),
+            "desired": desired,
+        }
+        decided = None
         if desired > st.target and now - st._last_scale_t > cfg.upscale_delay_s:
+            decided = ("up", st.target, desired)
             st.target = desired
             st._last_scale_t = now
         elif desired < st.target and now - st._last_scale_t > cfg.downscale_delay_s:
+            decided = ("down", st.target, max(desired, cfg.min_replicas))
             st.target = max(desired, cfg.min_replicas)
             st._last_scale_t = now
+        if decided is not None:
+            st.last_scale = {
+                "ts": time.time(),
+                "direction": decided[0],
+                "from": decided[1],
+                "to": decided[2],
+                "avg_ongoing": round(avg, 3),
+            }
 
 
 def get_or_create_controller():
     """Get the cluster's controller actor, creating it if needed."""
+    from ..core.scheduling_strategies import NodeAffinitySchedulingStrategy
+
     try:
         return get_actor(CONTROLLER_NAME)
     except Exception:
         pass
     Controller = ca.remote(ServeController).options(
-        name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=16
+        name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1, max_concurrency=16,
+        # system actors live with the control plane: the head node never
+        # drains, so the controller doesn't restart mid-drain-orchestration
+        # (soft: single-node clusters and full heads still place somewhere)
+        scheduling_strategy=NodeAffinitySchedulingStrategy("n0", soft=True),
     )
     try:
         h = Controller.remote()

@@ -15,6 +15,55 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from ..util.aio import drain, spawn_logged
 
+_proxy_metrics = {}
+
+
+def _shed_metrics():
+    """Admission-control + stream-lifecycle series (lazy like the replica's
+    request metrics): ca_serve_shed_total{deployment,reason} counts requests
+    refused at the gate; ca_serve_stream_abandoned_total{deployment} counts
+    SSE streams whose client vanished mid-stream (their replica-side
+    generators get cancelled, not left decoding)."""
+    if not _proxy_metrics:
+        from ..util import metrics as m
+
+        _proxy_metrics["shed"] = m.Counter(
+            "ca_serve_shed_total", "serve requests shed at the admission gate",
+            tag_keys=("deployment", "reason"),
+        )
+        _proxy_metrics["abandoned"] = m.Counter(
+            "ca_serve_stream_abandoned_total",
+            "serve SSE streams abandoned by their client mid-stream",
+            tag_keys=("deployment",),
+        )
+    return _proxy_metrics
+
+
+class _Shed(Exception):
+    """Admission refusal: HTTP code + reason + the Retry-After hint."""
+
+    def __init__(self, code: int, reason: str, retry_after: float, limit: int):
+        super().__init__(reason)
+        self.code = code
+        self.reason = reason
+        self.retry_after = retry_after
+        self.limit = limit
+
+
+class _AdmissionState:
+    """Per-deployment admission bookkeeping in THIS proxy: in-flight request
+    count and summed token-cost estimate, gated by the deployment's
+    AdmissionPolicy (refreshed with the route table)."""
+
+    __slots__ = ("policy", "replicas", "max_ongoing", "inflight", "tokens")
+
+    def __init__(self):
+        self.policy = None  # dict from AdmissionPolicy.to_wire(), or None
+        self.replicas = 1
+        self.max_ongoing = 8
+        self.inflight = 0
+        self.tokens = 0
+
 
 class Request:
     """What ingress callables receive for HTTP requests (a compact stand-in
@@ -44,6 +93,7 @@ class ProxyActor:
         self.host = host
         self.port = port
         self._routes: Dict[str, Any] = {}  # route_prefix -> DeploymentHandle
+        self._admission: Dict[str, _AdmissionState] = {}  # route_prefix ->
         self._routes_lock = threading.Lock()
         self._miss_lock = threading.Lock()
         self._refresh_gen = 0
@@ -98,18 +148,27 @@ class ProxyActor:
             new = {}
             for app, info in routes.items():
                 if info["ingress"]:
-                    new[info["route_prefix"]] = DeploymentHandle(app, info["ingress"])
+                    new[info["route_prefix"]] = (DeploymentHandle(app, info["ingress"]), info)
             with self._routes_lock:
                 # keep existing handles (their routers have warm caches)
-                for prefix, h in new.items():
+                for prefix, (h, info) in new.items():
                     if prefix not in self._routes or (
                         self._routes[prefix].app != h.app
                         or self._routes[prefix].deployment != h.deployment
                     ):
                         self._routes[prefix] = h
+                    # admission state rides the refresh: the policy is
+                    # deployment config, capacity tracks the autoscaler
+                    adm = self._admission.get(prefix)
+                    if adm is None:
+                        adm = self._admission[prefix] = _AdmissionState()
+                    adm.policy = info.get("admission")
+                    adm.replicas = int(info.get("replicas", 1) or 1)
+                    adm.max_ongoing = int(info.get("max_ongoing_requests", 8))
                 for prefix in list(self._routes):
                     if prefix not in new:
                         del self._routes[prefix]
+                        self._admission.pop(prefix, None)
         except Exception:
             pass
 
@@ -194,7 +253,91 @@ class ProxyActor:
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         return Request(method.upper(), unquote(parsed.path), query, headers, body)
 
+    # ------------------------------------------------------------- admission
+    @staticmethod
+    def _estimate_tokens(policy: Dict[str, Any], req: Request) -> int:
+        """Token-cost estimate for the budget gate: prompt chars/4 +
+        max_new_tokens when the body (or query) carries them, else the
+        policy's default.  Deliberately cheap and rough — the gate bounds
+        aggregate decode work, it doesn't meter exact usage."""
+        body: Dict[str, Any] = {}
+        default = int(policy.get("default_request_tokens") or 64)
+        if len(req._body) > 256 * 1024:
+            # don't json-parse megabyte prompts on the event loop just for
+            # an estimate: for a body this large the prompt dominates —
+            # charge its size directly
+            return max(1, default + len(req._body) // 4)
+        try:
+            if req.method == "POST" and req._body[:1] in (b"{", b"["):
+                parsed = json.loads(req._body)
+                if isinstance(parsed, dict):
+                    body = parsed
+            elif req.query_params:
+                body = dict(req.query_params)
+        except Exception:
+            pass
+        try:
+            new_toks = int(body["max_new_tokens"]) if "max_new_tokens" in body else None
+        except (TypeError, ValueError):
+            new_toks = None
+        prompt = body.get("prompt")
+        prompt_toks = len(str(prompt)) // 4 if isinstance(prompt, (str, bytes)) else 0
+        if new_toks is None and not prompt_toks:
+            return default
+        return max(1, (new_toks if new_toks is not None else default) + prompt_toks)
+
+    def _try_admit(self, prefix: str, req: Request):
+        """Admission gate.  Returns (None, 0) when no policy applies,
+        (adm, tokens) when admitted, or raises _Shed with the refusal.
+        The token estimate (a json.loads of the body) runs OUTSIDE the
+        routes lock — holding it there would serialize every concurrent
+        dispatch/release/refresh behind one request's body parse; the
+        verdict + reservation then re-check under the lock atomically."""
+        with self._routes_lock:
+            adm = self._admission.get(prefix)
+            pol = adm.policy if adm is not None else None
+        if pol is None:
+            return None, 0
+        tokens = (
+            self._estimate_tokens(pol, req)
+            if pol.get("max_tokens_in_flight") is not None
+            else 0
+        )
+        with self._routes_lock:
+            adm = self._admission.get(prefix)
+            if adm is None or adm.policy is None:
+                return None, 0  # route/policy changed mid-check: admit
+            pol = adm.policy
+            depth = pol.get("max_queue_depth")
+            if depth is None:
+                depth = max(
+                    1,
+                    int(
+                        float(pol.get("queue_depth_factor") or 2.0)
+                        * max(1, adm.replicas) * adm.max_ongoing
+                    ),
+                )
+            retry = float(pol.get("retry_after_s") or 1.0)
+            if adm.inflight >= depth:
+                raise _Shed(503, "queue_depth", retry, depth)
+            budget = pol.get("max_tokens_in_flight")
+            if budget is not None:
+                if adm.tokens + tokens > int(budget):
+                    raise _Shed(429, "token_budget", retry, int(budget))
+            else:
+                tokens = 0
+            adm.inflight += 1
+            adm.tokens += tokens
+            return adm, tokens
+
+    def _release(self, adm, tokens: int):
+        if adm is not None:
+            with self._routes_lock:
+                adm.inflight -= 1
+                adm.tokens -= tokens
+
     async def _dispatch(self, req: Request, writer: asyncio.StreamWriter):
+        admitted = None
         try:
             match = self._match(req.path)
             if match is None:
@@ -209,12 +352,26 @@ class ProxyActor:
             if match is None:
                 await self._respond(writer, 404, {"error": f"no route for {req.path}"})
                 return
-            _, handle = match
+            prefix, handle = match
+            dep_tag = {"deployment": f"{handle.app}/{handle.deployment}"}
+            try:
+                admitted = self._try_admit(prefix, req)
+            except _Shed as s:
+                # load-shedding: refuse NOW with Retry-After instead of
+                # queueing unboundedly — past the saturation knee a bounded
+                # queue is the only way p99 stays bounded
+                _shed_metrics()["shed"].inc(1, tags={**dep_tag, "reason": s.reason})
+                await self._respond(
+                    writer, s.code,
+                    {"error": "request shed", "reason": s.reason, "limit": s.limit},
+                    extra_headers={"Retry-After": f"{s.retry_after:g}"},
+                )
+                return
             loop = asyncio.get_running_loop()
             if "text/event-stream" in req.headers.get("accept", ""):
                 # SSE: iterate the deployment's generator, one event per item
                 # (reference proxy StreamingResponse path; LLM token streams)
-                await self._respond_sse(writer, handle, req, loop)
+                await self._respond_sse(writer, handle, req, loop, dep_tag)
                 return
             # handle.remote() blocks briefly (routing) and result() blocks
             # until done — run both off the event loop
@@ -231,8 +388,11 @@ class ProxyActor:
         except Exception as e:
             traceback.print_exc()
             await self._respond(writer, 500, {"error": repr(e)})
+        finally:
+            if admitted is not None:
+                self._release(*admitted)
 
-    async def _respond_sse(self, writer, handle, req: Request, loop):
+    async def _respond_sse(self, writer, handle, req: Request, loop, dep_tag=None):
         import json as _json
         import queue as _queue
 
@@ -243,37 +403,77 @@ class ProxyActor:
         await drain(writer)
         q: _queue.Queue = _queue.Queue(maxsize=64)
         _END = object()
+        abandoned = threading.Event()
+        resp_gen = handle.options(stream=True).remote(req)
+
+        def qput(item) -> bool:
+            # abandonment-aware put: a dead consumer stops reading the
+            # queue, so a plain put() would block this thread forever once
+            # the buffer fills — but a merely SLOW consumer must still get
+            # every item (especially _END: dropping it would hang the
+            # consumer and leak its admission slot), so keep trying until
+            # delivered or abandoned.
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def pump():
             try:
-                for item in handle.options(stream=True).remote(req):
-                    q.put(item)
+                for item in resp_gen:
+                    if not qput(item):
+                        return
             except Exception as e:  # noqa: BLE001 — forwarded as an event
-                q.put({"error": repr(e)})
+                qput({"error": repr(e)})
             finally:
-                q.put(_END)
+                qput(_END)
 
         loop.run_in_executor(None, pump)
-        while True:
-            item = await loop.run_in_executor(None, q.get)
-            if item is _END:
-                break
-            if isinstance(item, bytes):
-                data = item.decode("utf-8", "replace")
-            elif isinstance(item, str):
-                data = item
-            else:
-                data = _json.dumps(item, default=str)
-            writer.write(f"data: {data}\n\n".encode())
-            # bounded: a consumer that stops reading mid-stream must not pin
-            # this coroutine (and the replica's generator) forever
-            await drain(writer)
         try:
-            writer.close()
-        except Exception:
-            pass
+            while True:
+                item = await loop.run_in_executor(None, q.get)
+                if item is _END:
+                    break
+                if isinstance(item, bytes):
+                    data = item.decode("utf-8", "replace")
+                elif isinstance(item, str):
+                    data = item
+                else:
+                    data = _json.dumps(item, default=str)
+                try:
+                    writer.write(f"data: {data}\n\n".encode())
+                    # bounded: a consumer that stops reading mid-stream must
+                    # not pin this coroutine (or the replica's generator)
+                    await drain(writer)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # client went away mid-stream: cancel the replica-side
+                    # generator — the bounded buffer only protected MEMORY;
+                    # without this the replica keeps decoding tokens nobody
+                    # will ever read.  cancel() can block briefly on an
+                    # unresolved routing future, so it runs off-loop.
+                    abandoned.set()
+                    loop.run_in_executor(None, resp_gen.cancel)
+                    _shed_metrics()["abandoned"].inc(
+                        1, tags=dep_tag or {"deployment": f"{handle.app}/{handle.deployment}"}
+                    )
+                    return
+        except asyncio.CancelledError:
+            # proxy shutdown: stop the upstream too, then stay cancelled
+            abandoned.set()
+            loop.run_in_executor(None, resp_gen.cancel)
+            raise
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
-    async def _respond(self, writer, code: int, payload: Any):
+    async def _respond(self, writer, code: int, payload: Any, extra_headers=None):
         try:
             if isinstance(payload, bytes):
                 body, ctype = payload, "application/octet-stream"
@@ -281,13 +481,21 @@ class ProxyActor:
                 body, ctype = payload.encode(), "text/plain; charset=utf-8"
             else:
                 body, ctype = json.dumps(_json_default(payload)).encode(), "application/json"
-            status = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}.get(
-                code, "OK"
+            status = {
+                200: "OK",
+                404: "Not Found",
+                429: "Too Many Requests",
+                500: "Internal Server Error",
+                503: "Service Unavailable",
+            }.get(code, "OK")
+            extras = "".join(
+                f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
             )
             writer.write(
                 f"HTTP/1.1 {code} {status}\r\n"
                 f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n"
+                f"{extras}"
                 f"Connection: close\r\n\r\n".encode() + body
             )
             await drain(writer)

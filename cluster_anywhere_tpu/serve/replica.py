@@ -116,6 +116,26 @@ class Replica:
     def get_queue_len(self) -> int:
         return self.num_ongoing
 
+    def telemetry(self) -> Dict[str, Any]:
+        """One RPC for the controller's reconcile pass: liveness (raises if
+        the user's check_health hook does), ongoing-request count (router
+        P2C signal, drain retirement gate, autoscale input), and the hosting
+        node (drain detection)."""
+        fn = getattr(self.instance, "check_health", None)
+        if fn is not None:
+            fn()
+        try:
+            from ..core.worker import global_worker
+
+            node_id = global_worker().node_id
+        except Exception:
+            node_id = None
+        return {
+            "queue_len": self.num_ongoing,
+            "node_id": node_id,
+            "total": self.total_requests,
+        }
+
     def stats(self) -> Dict[str, Any]:
         return {
             "replica_id": self.replica_id,
@@ -198,11 +218,16 @@ class Replica:
                 yield out  # non-generator result: one-item stream
                 return
             yield from out
-        except Exception:
+        except Exception as e:
             # Exception only: client cancellation (CancelledError /
             # GeneratorExit are BaseException) is not a deployment error and
-            # must not feed the errors series alerts watch
-            mets["errors"].inc(1, tags=self._metric_tags)
+            # must not feed the errors series alerts watch.  TaskCancelledError
+            # is the consumer abandoning the stream (proxy SSE disconnect) —
+            # same story, different spelling.
+            from ..core.errors import TaskCancelledError
+
+            if not isinstance(e, TaskCancelledError):
+                mets["errors"].inc(1, tags=self._metric_tags)
             raise
         finally:
             # latency covers the full stream (first byte to exhaustion)

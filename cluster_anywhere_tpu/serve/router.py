@@ -59,11 +59,62 @@ class DeploymentResponseGenerator:
 
     def __init__(self, gen_future):
         self._gen_future = gen_future
+        self._gen = None  # resolved ObjectRefGenerator (cancel target)
+
+    def _resolve(self):
+        if self._gen is None:
+            self._gen = self._gen_future.result(30)  # ObjectRefGenerator
+        return self._gen
 
     def __iter__(self):
-        gen = self._gen_future.result(30)  # ObjectRefGenerator
-        for ref in gen:
-            yield ca.get(ref, timeout=60)
+        gen = self._resolve()
+        try:
+            for ref in gen:
+                yield ca.get(ref, timeout=60)
+        except GeneratorExit:
+            # consumer close()d us mid-stream: stop the replica-side
+            # generator too, or it decodes to completion for nobody
+            self.cancel()
+            raise
+
+    def cancel(self):
+        """Abandon the stream: interrupt the replica-side generator (it gets
+        TaskCancelledError at its next yield) and release this consumer.
+        Call when the downstream client is gone (proxy SSE disconnect).
+        Runs off-loop (callers use an executor): still-queued routing is
+        cancelled outright; in-flight routing gets a grace LONGER than
+        _acquire_replica's 30 s backpressure deadline — under saturation
+        (exactly when clients give up) the submit resolves late, and a
+        shorter wait would swallow the cancel and let the replica decode
+        the whole abandoned stream for nobody."""
+        try:
+            if self._gen is None and not self._gen_future.done():
+                if self._gen_future.cancel():
+                    return  # routing never started: nothing replica-side
+            self._gen = self._gen_future.result(35)
+            self._gen.cancel()
+        except Exception:
+            pass  # routing itself failed / replica dead: nothing to stop
+
+
+_backpressure_hist = None
+
+
+def _backpressure_metric():
+    """ca_serve_backpressure_seconds: time route() spent waiting because
+    every pickable replica was saturated — the visible form of what used to
+    be an invisible CPU-burning spin-wait."""
+    global _backpressure_hist
+    if _backpressure_hist is None:
+        from ..util import metrics as m
+
+        _backpressure_hist = m.Histogram(
+            "ca_serve_backpressure_seconds",
+            "serve router wait for replica capacity",
+            boundaries=[0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 5.0, 30.0],
+            tag_keys=("deployment",),
+        )
+    return _backpressure_hist
 
 
 class Router:
@@ -78,7 +129,7 @@ class Router:
             max_workers=1, thread_name_prefix="serve-router"
         )
         self._lock = threading.Lock()
-        self._replicas: List[Dict[str, str]] = []
+        self._replicas: List[Dict[str, Any]] = []
         self._handles: Dict[str, Any] = {}  # replica_id -> actor handle
         self._inflight: Dict[str, int] = {}
         self._version = -1
@@ -86,7 +137,11 @@ class Router:
         self._last_refresh = 0.0
         self._watched: List = []  # [(replica_id, ref)]
         self._watch_cv = threading.Condition(self._lock)
+        # saturation backpressure: route() waits HERE (bounded, no spin)
+        # until the watch loop's completion decrements free capacity
+        self._capacity_cv = threading.Condition(self._lock)
         self._watcher: Optional[threading.Thread] = None
+        self._metric_tags = {"deployment": f"{app}/{deployment}"}
 
     def _controller(self):
         return get_actor(CONTROLLER_NAME)
@@ -101,16 +156,21 @@ class Router:
             self._controller().get_deployment_info.remote(self.app, self.deployment)
         )
         with self._lock:
-            if info["version"] == self._version and self._replicas:
-                return
+            stale = info["version"] == self._version and self._replicas
             self._version = info["version"]
             self._max_ongoing = info.get("max_ongoing_requests", 8)
             self._replicas = info["replicas"]
+            if stale:
+                # same membership, but the controller-reported queue_lens
+                # (merged in _pick) are fresh — keep them
+                self._capacity_cv.notify_all()
+                return
             live = {r["replica_id"] for r in self._replicas}
             self._handles = {k: v for k, v in self._handles.items() if k in live}
             self._inflight = {
                 k: self._inflight.get(k, 0) for k in live
             }
+            self._capacity_cv.notify_all()
 
     def _handle_for(self, rid: str, actor_name: str):
         h = self._handles.get(rid)
@@ -119,37 +179,69 @@ class Router:
             self._handles[rid] = h
         return h
 
-    def _pick(self) -> Optional[Dict[str, str]]:
-        with self._lock:
+    def _load(self, rep: Dict[str, Any]) -> int:
+        """Replica load estimate for power-of-two-choices: the max of this
+        router's own in-flight count and the controller-reported ongoing
+        count (which sees EVERY router's traffic plus the replica's own
+        concurrency, ~1s stale).  max() rather than sum: the reported number
+        already includes whatever of our in-flight work reached the replica."""
+        return max(
+            self._inflight.get(rep["replica_id"], 0),
+            int(rep.get("queue_len", 0)),
+        )
+
+    def _pick_locked(self) -> Optional[Dict[str, Any]]:
+        reps = [r for r in self._replicas if not r.get("draining")]
+        if not reps:
+            # every replica draining (replacements still starting): keep
+            # serving on the draining ones — they're alive until the drain
+            # deadline, and refusing would drop requests a drain promised
+            # to preserve
             reps = list(self._replicas)
-            if not reps:
-                return None
-            if len(reps) == 1:
-                return reps[0]
-            a, b = random.sample(reps, 2)
-            ia = self._inflight.get(a["replica_id"], 0)
-            ib = self._inflight.get(b["replica_id"], 0)
-            return a if ia <= ib else b
+        if not reps:
+            return None
+        if len(reps) == 1:
+            return reps[0]
+        a, b = random.sample(reps, 2)
+        return a if self._load(a) <= self._load(b) else b
+
+    def _acquire_replica(self, meta: Dict[str, Any]) -> Dict[str, Any]:
+        """Pick a replica with free capacity, waiting on the capacity
+        condition when saturated (bounded waits, visible in the
+        ca_serve_backpressure_seconds histogram) instead of spinning."""
+        deadline = time.monotonic() + 30.0
+        t_wait0 = None
+        while True:
+            self._refresh()
+            with self._capacity_cv:
+                pick = self._pick_locked()
+                if (
+                    pick is not None
+                    and self._inflight.get(pick["replica_id"], 0) < self._max_ongoing
+                ):
+                    if t_wait0 is not None:
+                        _backpressure_metric().observe(
+                            time.monotonic() - t_wait0, tags=self._metric_tags
+                        )
+                    return pick
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"no available replica for {self.app}/{self.deployment}"
+                    )
+                if t_wait0 is None:
+                    t_wait0 = time.monotonic()
+                # bounded: completions notify; the cap also forces a
+                # periodic membership refresh while saturated/empty
+                self._capacity_cv.wait(timeout=min(0.25, remaining))
+            if pick is None:
+                self._refresh(force=True)
 
     def route(self, meta: Dict[str, Any], args, kwargs):
         """Blocking routing + submission; runs on the dispatch thread only.
         Returns the ObjectRef of the replica call."""
-        deadline = time.monotonic() + 30.0
-        while True:
-            self._refresh()
-            pick = self._pick()
-            if pick is not None:
-                rid = pick["replica_id"]
-                # backpressure: spin briefly if every replica is saturated in
-                # our local view (reference: replica queue-len gating)
-                if self._inflight.get(rid, 0) < self._max_ongoing:
-                    break
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no available replica for {self.app}/{self.deployment}"
-                )
-            time.sleep(0.01 if pick is None else 0.001)
-            self._refresh(force=pick is None)
+        pick = self._acquire_replica(meta)
+        rid = pick["replica_id"]
         h = self._handle_for(rid, pick["actor_name"])
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
@@ -158,6 +250,7 @@ class Router:
         except Exception:
             with self._lock:
                 self._inflight[rid] -= 1
+                self._capacity_cv.notify_all()
             raise
         self._watch_completion(rid, ref)
         return ref
@@ -166,22 +259,11 @@ class Router:
         """Like route(), but invokes the replica's streaming twin and returns
         an ObjectRefGenerator.  Inflight is released at submit: stream
         lifetimes are unbounded (token generation), so queue-gating on them
-        would starve the replica for regular traffic."""
-        deadline = time.monotonic() + 30.0
-        while True:
-            self._refresh()
-            pick = self._pick()
-            if pick is not None:
-                rid = pick["replica_id"]
-                if self._inflight.get(rid, 0) < self._max_ongoing:
-                    break
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no available replica for {self.app}/{self.deployment}"
-                )
-            time.sleep(0.01 if pick is None else 0.001)
-            self._refresh(force=pick is None)
-        h = self._handle_for(rid, pick["actor_name"])
+        would starve the replica for regular traffic.  (The controller-side
+        queue_len still counts streams — the replica's num_ongoing covers
+        the stream's whole life — so P2C and drain retirement see them.)"""
+        pick = self._acquire_replica(meta)
+        h = self._handle_for(pick["replica_id"], pick["actor_name"])
         return h.handle_request_streaming.options(num_returns="streaming").remote(
             meta, *args, **kwargs
         )
@@ -218,6 +300,8 @@ class Router:
                     else:
                         still.append((rid, ref))
                 self._watched = still
+                # capacity freed: wake saturated route() waiters
+                self._capacity_cv.notify_all()
 
 
 _router_cache: Dict[tuple, Router] = {}

@@ -547,6 +547,43 @@ def get_metrics_snapshot() -> Dict[str, dict]:
     return global_worker().head_call("metrics_snapshot")["metrics"]
 
 
+def merged_histogram(rec: Optional[dict]) -> Tuple[List[float], List[int], int]:
+    """Merge a snapshot histogram's tagged cells into one
+    (bounds, cumulative-ready buckets, count) triple — the shape
+    histogram_quantile() consumes.  Shared by bench.py's BENCH-json blocks
+    and util.state's plane summaries (one definition, not N copies)."""
+    bounds: List[float] = []
+    buckets: List[int] = []
+    count = 0
+    for cell in (rec or {}).get("data", {}).values():
+        b = cell.get("bounds", [])
+        if len(b) > len(bounds):
+            bounds = b
+            buckets = buckets + [0] * (len(b) + 1 - len(buckets))
+        for i, c in enumerate(cell.get("buckets", [])):
+            if i < len(buckets):
+                buckets[i] += c
+        count += cell.get("count", 0)
+    return bounds, buckets, count
+
+
+def histogram_quantile(
+    bounds: List[float], buckets: List[int], count: int, q: float
+) -> float:
+    """Quantile upper bound from a bucketed histogram (the Prometheus
+    histogram_quantile estimate, conservative: returns the bucket's upper
+    boundary; the overflow bucket reports 2x the top boundary)."""
+    if not count:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            return bounds[i] if i < len(bounds) else (bounds[-1] * 2 if bounds else 0.0)
+    return bounds[-1] * 2 if bounds else 0.0
+
+
 def prometheus_text() -> str:
     """Prometheus exposition format of the cluster metrics snapshot."""
     return render_prometheus(get_metrics_snapshot())

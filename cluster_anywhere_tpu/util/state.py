@@ -213,6 +213,61 @@ def transfer_plane() -> Dict[str, Any]:
     }
 
 
+def serve_plane() -> Dict[str, Any]:
+    """Serving-plane summary: per-deployment target vs actual replicas,
+    per-replica node/queue/draining state and the last autoscale decision
+    (live from the controller, falling back to its ~1s head-KV digest when
+    the controller is busy/unreachable), plus the cluster-aggregated
+    ca_serve_* counters and request/backpressure latency quantiles — the
+    one-call view of admission, routing, prefix reuse, and drain health."""
+    from .metrics import get_metrics_snapshot, histogram_quantile, merged_histogram
+
+    deployments: Dict[str, Any] = {}
+    source = "none"
+    try:
+        from ..core import api as ca
+        from ..core.actor import get_actor
+        from ..serve.controller import CONTROLLER_NAME
+
+        ctrl = get_actor(CONTROLLER_NAME)
+        deployments = ca.get(ctrl.serve_plane_info.remote(), timeout=5)
+        source = "controller"
+    except Exception:
+        try:
+            raw = _head("kv_get", key="serve:plane").get("value")
+            if raw:
+                deployments = json.loads(raw)
+                source = "kv_digest"
+        except Exception:
+            pass
+    counters: Dict[str, int] = {}
+    quantiles: Dict[str, float] = {}
+    try:
+        snap = get_metrics_snapshot()
+        for name, rec in snap.items():
+            if name.startswith("ca_serve_") and rec.get("type") == "counter":
+                counters[name[len("ca_serve_"):]] = int(
+                    sum(rec.get("data", {}).values())
+                )
+        for name, label in (
+            ("ca_serve_request_latency_seconds", "request_latency"),
+            ("ca_serve_backpressure_seconds", "backpressure"),
+        ):
+            b, bk, n = merged_histogram(snap.get(name))
+            if n:
+                quantiles[f"{label}_p50_s"] = histogram_quantile(b, bk, n, 0.50)
+                quantiles[f"{label}_p99_s"] = histogram_quantile(b, bk, n, 0.99)
+                quantiles[f"{label}_count"] = n
+    except Exception:
+        pass
+    return {
+        "deployments": deployments,
+        "source": source,
+        "counters": counters,
+        "quantiles": quantiles,
+    }
+
+
 def timeseries(
     names: Optional[List[str]] = None,
     *,

@@ -129,6 +129,46 @@ def attn_micro():
         )
 
 
+def serve_smoke():
+    """Serving-plane smoke under the probe's watchdog: start a cluster,
+    deploy a tiny ContinuousLLMServer, stream one SSE request through the
+    HTTP proxy, tear down.  A wedged accelerator runtime (or a serve
+    regression) can't hang the harness — the watchdog killpg's us."""
+    import socket
+
+    import cluster_anywhere_tpu as ca
+    from cluster_anywhere_tpu import serve
+    from cluster_anywhere_tpu.llm.processor import ProcessorConfig
+    from cluster_anywhere_tpu.llm.serve_llm import build_continuous_llm_deployment
+    from cluster_anywhere_tpu.microbenchmark import _sse_request
+
+    ca.init(num_cpus=4)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    serve.start(host="127.0.0.1", port=port)
+    app = build_continuous_llm_deployment(
+        ProcessorConfig(max_prompt_len=64, max_new_tokens=8),
+        slots=2, num_replicas=1, sse_ingress=True,
+    )
+    serve.run(app, name="probesmoke", route_prefix="/probesmoke")
+    status, ttft, total, n_events = _sse_request(
+        "127.0.0.1", port, "/probesmoke",
+        {"prompt": "probe smoke", "max_new_tokens": 8}, timeout=120,
+    )
+    assert status == 200, f"serve smoke: HTTP {status}"
+    assert n_events >= 8, f"serve smoke: {n_events} SSE events (wanted >= 8)"
+    print(
+        f"serve smoke : {n_events} tokens streamed, TTFT {ttft*1e3:7.1f} ms "
+        f"(cold: includes jit compile), total {total*1e3:7.1f} ms",
+        flush=True,
+    )
+    serve.delete("probesmoke")
+    serve.shutdown()
+    ca.shutdown()
+
+
 VARIANTS = {
     "jnp8": lambda: run_step("jnp b8", base_cfg(attn_impl="jnp"), 8, 1024),
     "flash8": lambda: run_step("flash b8", base_cfg(attn_impl="flash"), 8, 1024),
@@ -137,6 +177,7 @@ VARIANTS = {
     "jnp16r": lambda: run_step("jnp b16 rm", base_cfg(attn_impl="jnp", remat=True), 16, 1024),
     "jnp32r": lambda: run_step("jnp b32 rm", base_cfg(attn_impl="jnp", remat=True), 32, 1024),
     "attnmicro": attn_micro,
+    "serve": serve_smoke,
 }
 
 

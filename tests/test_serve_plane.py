@@ -1,0 +1,427 @@
+"""Serving-plane tests (PR 12): proxy admission control + load shedding,
+router saturation backpressure, SSE client-disconnect cancellation,
+prefix/KV-cache bit-identical reuse, autoscale observability, and the
+drain-under-load zero-drop chaos test."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu import serve
+
+HOST = "127.0.0.1"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind((HOST, 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+PORT = _free_port()
+
+
+@pytest.fixture(scope="module")
+def serve_cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    ca.init(num_cpus=6)
+    serve.start(host=HOST, port=PORT)
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    if ca.is_initialized():
+        ca.shutdown()
+
+
+def _get(path, timeout=30):
+    return urllib.request.urlopen(f"http://{HOST}:{PORT}{path}", timeout=timeout)
+
+
+def test_admission_sheds_queue_depth_with_retry_after(serve_cluster):
+    """Past the depth cap the proxy sheds 503 + Retry-After instead of
+    queueing unboundedly; below it nothing sheds; ca_serve_shed_total counts."""
+    import asyncio
+
+    @serve.deployment(
+        max_ongoing_requests=2,
+        admission=serve.AdmissionPolicy(max_queue_depth=3, retry_after_s=2.0),
+    )
+    class Slow:
+        async def __call__(self, request):
+            await asyncio.sleep(0.8)
+            return {"ok": True}
+
+    serve.run(Slow.bind(), name="shed", route_prefix="/shed")
+    time.sleep(1.0)  # proxy route+policy refresh
+
+    # sequential traffic stays under the cap: nothing sheds
+    for _ in range(2):
+        assert json.loads(_get("/shed").read())["ok"] is True
+
+    codes = []
+    retry_after = []
+    lock = threading.Lock()
+
+    def one():
+        try:
+            with _get("/shed") as r:
+                with lock:
+                    codes.append(r.status)
+        except urllib.error.HTTPError as e:
+            with lock:
+                codes.append(e.code)
+                if e.code == 503:
+                    retry_after.append(e.headers.get("Retry-After"))
+
+    threads = [threading.Thread(target=one) for _ in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert codes.count(200) >= 2, codes  # under-cap requests still served
+    assert codes.count(503) >= 4, codes  # the overflow was shed, not queued
+    assert retry_after and retry_after[0] == "2", retry_after
+
+    # the shed counter flows through the cluster metrics pipeline
+    from cluster_anywhere_tpu.util.metrics import get_metrics_snapshot
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        rec = get_metrics_snapshot().get("ca_serve_shed_total", {})
+        if any(
+            "shed/Slow" in k and "queue_depth" in k and v >= 4
+            for k, v in rec.get("data", {}).items()
+        ):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"shed counter never landed: {rec}")
+    serve.delete("shed")
+
+
+def test_admission_token_budget_429(serve_cluster):
+    """The token-budget gate sheds 429 when the estimated in-flight decode
+    work would exceed the budget."""
+
+    @serve.deployment(
+        admission=serve.AdmissionPolicy(max_tokens_in_flight=50, retry_after_s=1.0),
+    )
+    class Llmish:
+        def __call__(self, request):
+            return {"ok": True}
+
+    serve.run(Llmish.bind(), name="tokbudget", route_prefix="/tokbudget")
+    time.sleep(1.0)
+
+    # small request fits the budget
+    req = urllib.request.Request(
+        f"http://{HOST}:{PORT}/tokbudget",
+        data=json.dumps({"prompt": "hi", "max_new_tokens": 8}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    assert json.loads(urllib.request.urlopen(req, timeout=30).read())["ok"]
+
+    # one oversized request exceeds it outright -> 429
+    big = urllib.request.Request(
+        f"http://{HOST}:{PORT}/tokbudget",
+        data=json.dumps({"prompt": "x" * 400, "max_new_tokens": 400}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(big, timeout=30)
+    assert ei.value.code == 429
+    assert ei.value.headers.get("Retry-After") == "1"
+    assert json.loads(ei.value.read())["reason"] == "token_budget"
+    serve.delete("tokbudget")
+
+
+def test_router_backpressure_condition_not_spin(serve_cluster):
+    """Saturating every replica makes route() wait on the capacity condition
+    (bounded, completion-notified) and the wait lands in the
+    ca_serve_backpressure_seconds histogram."""
+    import asyncio
+
+    @serve.deployment(max_ongoing_requests=2)
+    class Busy:
+        async def __call__(self, x):
+            await asyncio.sleep(0.4)
+            return x
+
+    h = serve.run(Busy.bind(), name="bp", route_prefix="/bp")
+    t0 = time.monotonic()
+    rs = [h.remote(i) for i in range(8)]  # 4 waves of 2
+    assert sorted(r.result(timeout_s=60) for r in rs) == list(range(8))
+    wall = time.monotonic() - t0
+    assert wall > 1.0, "8 requests at concurrency 2 can't finish instantly"
+
+    from cluster_anywhere_tpu.util.metrics import get_metrics_snapshot
+
+    deadline = time.monotonic() + 15
+    count = 0
+    while time.monotonic() < deadline:
+        rec = get_metrics_snapshot().get("ca_serve_backpressure_seconds", {})
+        count = sum(
+            cell.get("count", 0)
+            for k, cell in rec.get("data", {}).items()
+            if "bp/Busy" in k
+        )
+        if count >= 1:
+            break
+        time.sleep(0.5)
+    assert count >= 1, "saturation wait never observed in the histogram"
+    serve.delete("bp")
+
+
+def test_sse_client_disconnect_cancels_replica_generator(serve_cluster):
+    """A consumer that stops reading mid-stream must cancel the replica-side
+    generator (the regression: the bounded buffer protected memory but the
+    generator kept producing).  Progress is tracked in a side actor; the
+    abandoned counter must tick."""
+
+    @ca.remote
+    class Progress:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+
+        def get(self):
+            return self.n
+
+    tracker = Progress.remote()
+
+    @serve.deployment
+    class Ticker:
+        def __init__(self, tracker):
+            self.tracker = tracker
+
+        def __call__(self, request):
+            for i in range(200):
+                self.tracker.bump.remote()
+                time.sleep(0.05)
+                yield {"i": i}
+
+    serve.run(Ticker.bind(tracker), name="abandon", route_prefix="/abandon")
+    time.sleep(1.0)
+
+    s = socket.create_connection((HOST, PORT), timeout=30)
+    s.sendall(
+        b"GET /abandon HTTP/1.1\r\nHost: x\r\nAccept: text/event-stream\r\n\r\n"
+    )
+    buf = b""
+    s.settimeout(30)
+    while buf.count(b"data:") < 3:
+        chunk = s.recv(4096)
+        assert chunk, f"stream ended early: {buf!r}"
+        buf += chunk
+    s.close()  # abandon mid-stream
+
+    # the generator must STOP: progress freezes well short of 200
+    time.sleep(2.0)
+    n1 = ca.get(tracker.get.remote(), timeout=10)
+    time.sleep(2.0)
+    n2 = ca.get(tracker.get.remote(), timeout=10)
+    assert n2 < 200, f"generator ran to completion ({n2})"
+    assert n2 - n1 <= 2, f"generator still producing after disconnect ({n1}->{n2})"
+
+    from cluster_anywhere_tpu.util.metrics import get_metrics_snapshot
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        rec = get_metrics_snapshot().get("ca_serve_stream_abandoned_total", {})
+        if sum(rec.get("data", {}).values()) >= 1:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("ca_serve_stream_abandoned_total never ticked")
+    serve.delete("abandon")
+
+
+def test_serve_plane_observability(serve_cluster):
+    """util.state.serve_plane() exposes target/actual replicas and the
+    controller's KV digest backs /api/serve + ca status."""
+
+    @serve.deployment(num_replicas=2)
+    class Obs:
+        def __call__(self, x):
+            return x
+
+    serve.run(Obs.bind(), name="obs", route_prefix="/obs")
+    from cluster_anywhere_tpu.util.state import serve_plane
+
+    sp = serve_plane()
+    d = sp["deployments"]["obs"]["Obs"]
+    assert d["target_replicas"] == 2
+    assert d["actual_replicas"] == 2
+    assert len(d["replicas"]) == 2
+    for rep in d["replicas"].values():
+        assert rep["node_id"]  # controller learned each replica's node
+        assert rep["draining"] is False
+    assert sp["source"] in ("controller", "kv_digest")
+
+    # the ~1s KV digest lands on the head (the dashboard's /api/serve source)
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    deadline = time.monotonic() + 10
+    raw = None
+    while time.monotonic() < deadline and not raw:
+        raw = global_worker().head_call("kv_get", key="serve:plane").get("value")
+        time.sleep(0.3)
+    assert raw, "controller never published the serve:plane KV digest"
+    assert "obs" in json.loads(raw)
+    serve.delete("obs")
+
+
+def test_prefix_cache_bit_identical_and_cancel():
+    """Cold-miss vs warm-hit admits produce BIT-IDENTICAL outputs under
+    JAX_PLATFORMS=cpu (the cache's correctness contract), hit/miss counters
+    tick, the LRU bounds entries, and cancel() frees the slot."""
+    import jax
+
+    from cluster_anywhere_tpu.llm.continuous import ContinuousBatcher
+    from cluster_anywhere_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_head=16, d_ff=64,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    cb = ContinuousBatcher(
+        params, cfg, slots=2, t_max=128, prefill_buckets=(32, 64),
+        prefix_cache_entries=2, prefix_block=16,
+    )
+    sys_prefix = list(range(1, 33))  # 32 tokens, block-aligned
+
+    r1 = cb.submit(sys_prefix + [40, 41, 42], max_new_tokens=8, temperature=0.0)
+    cb.pump()
+    assert cb.stats["prefix_misses"] == 1 and cb.stats["prefix_hits"] == 0
+    r2 = cb.submit(sys_prefix + [40, 41, 42], max_new_tokens=8, temperature=0.0)
+    cb.pump()
+    assert cb.stats["prefix_hits"] == 1
+    assert cb.stats["prefix_tokens_reused"] == 32
+    assert r2.out_tokens == r1.out_tokens, "warm hit diverged from cold miss"
+
+    # different suffix, same prefix: still a hit, different continuation ok
+    r3 = cb.submit(sys_prefix + [50, 51], max_new_tokens=8, temperature=0.0)
+    cb.pump()
+    assert cb.stats["prefix_hits"] == 2
+
+    # LRU bound: two more distinct prefixes evict the oldest
+    for base in (100, 200):
+        cb.submit(
+            [base % 64 + i % 8 for i in range(32)] + [1, 2],
+            max_new_tokens=2, temperature=0.0,
+        )
+    cb.pump()
+    assert len(cb.prefix_cache) <= 2
+    assert cb.prefix_cache.evictions >= 1
+
+    # cancel(): queued and slotted requests both free immediately
+    ra = cb.submit(sys_prefix + [9, 9, 9], max_new_tokens=64, temperature=0.0)
+    cb.step()  # admits ra into a slot
+    assert not ra.done
+    assert cb.cancel(ra.request_id) is True
+    assert ra.done and cb.stats["cancelled"] == 1
+    assert cb.cancel(ra.request_id) is False  # idempotent no-op
+    cb.pump()  # nothing left: the slot was freed
+
+
+def test_drain_under_load_zero_dropped_requests():
+    """The acceptance chaos test: open-loop SSE load over a 2-replica
+    streaming deployment across 2 worker nodes; drain the node hosting a
+    replica mid-traffic.  Zero requests drop or error, replacement replicas
+    spawn on the survivor, and TTFT p99 during the drain stays within 2x of
+    steady state."""
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+    from cluster_anywhere_tpu.core.actor import get_actor
+    from cluster_anywhere_tpu.microbenchmark import _open_loop, _pct, _sse_request
+    from cluster_anywhere_tpu.serve.controller import CONTROLLER_NAME
+
+    if ca.is_initialized():
+        ca.shutdown()
+    c = Cluster(head_resources={"CPU": 1})
+    c.add_node(num_cpus=3)
+    c.add_node(num_cpus=3)
+    c.connect()
+    c.wait_for_nodes(3)
+    port = _free_port()
+    try:
+        serve.start(host=HOST, port=port)
+
+        @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+        class TokenStream:
+            def __call__(self, request):
+                for i in range(20):
+                    time.sleep(0.05)
+                    yield {"token": i}
+
+        serve.run(TokenStream.bind(), name="drainapp", route_prefix="/drainapp")
+        time.sleep(1.0)
+        st, _, _, ne = _sse_request(HOST, port, "/drainapp", {})
+        assert st == 200 and ne >= 20, f"warmup stream failed: {st}/{ne}"
+
+        ctrl = get_actor(CONTROLLER_NAME)
+        info = ca.get(ctrl.serve_plane_info.remote(), timeout=10)
+        reps = info["drainapp"]["TokenStream"]["replicas"]
+        nodes = [r["node_id"] for r in reps.values()]
+        victim = next(n for n in nodes if n and n != "n0")
+
+        drained = {}
+
+        def drainer():
+            time.sleep(2.5)
+            drained["t"] = time.perf_counter()
+            ca.drain_node(victim, reason="preemption", deadline_s=25.0)
+
+        th = threading.Thread(target=drainer, daemon=True)
+        t_start = time.perf_counter()
+        th.start()
+        rs, _ = _open_loop(HOST, port, "/drainapp", lambda i: {}, 4.0, 9.0)
+        th.join()
+        assert "t" in drained
+        ok = [r for r in rs if r[1] == 200 and r[4] >= 20]
+        bad = [r for r in rs if r not in ok]
+        assert not bad, f"dropped/errored under drain: {bad}"
+        cut = drained["t"] - t_start
+        steady = [r[2] for r in ok if r[2] is not None and r[0] < cut]
+        during = [r[2] for r in ok if r[2] is not None and r[0] >= cut]
+        assert steady and during
+        p99_steady = max(_pct(steady, 0.99), 0.02)
+        p99_during = _pct(during, 0.99)
+        assert p99_during <= 2.0 * p99_steady + 0.25, (
+            f"TTFT p99 blew past 2x during drain: "
+            f"{p99_steady*1e3:.1f}ms -> {p99_during*1e3:.1f}ms"
+        )
+
+        # replacements spawned on survivors; the draining replica retires
+        deadline = time.monotonic() + 30
+        final = None
+        while time.monotonic() < deadline:
+            final = ca.get(ctrl.serve_plane_info.remote(), timeout=10)[
+                "drainapp"]["TokenStream"]
+            active = final["actual_replicas"] - len(final["draining_replicas"])
+            if active == 2 and all(
+                r["node_id"] != victim for r in final["replicas"].values()
+            ):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"replacements never settled: {final}")
+        serve.delete("drainapp")
+        serve.shutdown()
+    finally:
+        c.shutdown()
