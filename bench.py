@@ -490,6 +490,22 @@ def bench_serve_plane():
     return out
 
 
+def bench_train_plane():
+    """Preemption-elastic train rows (drain-aware proactive restart vs
+    reactive poll-failure restart: warning->resumed latency + steps lost)
+    as a BENCH-json block — the structural claim is proactive losing
+    strictly fewer steps, not absolute latency on this noisy host."""
+    from cluster_anywhere_tpu.microbenchmark import run_train_elastic
+
+    rows = run_train_elastic(quick=True)
+    out = {}
+    for name, value, _unit in rows:
+        key = name.replace("train-elastic ", "").replace(" ", "_").replace("-", "_")
+        out[key] = round(value, 3)
+    log(f"trainplane: {out}")
+    return out
+
+
 def main():
     _, best_actor, _, logplane, drainplane, ownerplane, metricsplane = bench_core()
     transferplane = {}
@@ -502,6 +518,11 @@ def main():
         serveplane = bench_serve_plane()
     except Exception as e:
         log(f"serve plane bench failed: {e!r}")
+    trainplane = {}
+    try:
+        trainplane = bench_train_plane()
+    except Exception as e:
+        log(f"train plane bench failed: {e!r}")
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -525,6 +546,8 @@ def main():
         out["transferplane"] = transferplane
     if serveplane:
         out["serveplane"] = serveplane
+    if trainplane:
+        out["trainplane"] = trainplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

@@ -331,6 +331,29 @@ def cmd_status(args):
                 print(f"  {k}: {v:.4g}" if isinstance(v, float) else f"  {k}: {v}")
     except Exception:
         pass
+    # train plane: active/recent runs (attempt, world size, last checkpoint)
+    # and the elastic counters — a preemption mid-run should read as a
+    # PREEMPTING->RUNNING transition with a fresh checkpoint, not a mystery
+    try:
+        from .util.state import train_plane
+
+        tp = train_plane()
+        if tp["runs"] or tp["counters"]:
+            print("== train plane ==")
+            for name, r in sorted(tp["runs"].items()):
+                ck = r.get("last_checkpoint")
+                ck_note = f" last_ckpt={os.path.basename(ck)}" if ck else ""
+                pre = r.get("preempt_restarts") or 0
+                pre_note = f" preempt_restarts={pre}" if pre else ""
+                print(
+                    f"  {name}: {r.get('status')} attempt={r.get('attempt')} "
+                    f"world={r.get('world_size')}"
+                    f"{pre_note}{ck_note}"
+                )
+            for k, v in sorted(tp["counters"].items()):
+                print(f"  ca_train_{k}: {v}")
+    except Exception:
+        pass
     ca.shutdown()
 
 
@@ -772,6 +795,13 @@ def cmd_microbenchmark(args):
 
         run_serve_plane(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "train_elastic", False):
+        # owns its own clusters (drain-aware proactive restart vs reactive
+        # poll-failure restart: warning->resumed latency + steps lost)
+        from .microbenchmark import run_train_elastic
+
+        run_train_elastic(quick=getattr(args, "quick", False))
+        return
 
     import cluster_anywhere_tpu as ca
 
@@ -1029,6 +1059,12 @@ def main(argv=None):
         "--serve", dest="serve_plane", action="store_true",
         help="serving-plane envelope: open-loop SSE req/s + TTFT/p99, "
         "admission shedding A/B, prefix-cache A/B, drain-under-load proof",
+    )
+    sp.add_argument(
+        "--train-elastic", dest="train_elastic", action="store_true",
+        help="preemption-elastic train A/B: drain-aware proactive restart "
+        "vs reactive poll-failure restart (warning->resumed latency, "
+        "steps lost, max_failures consumed)",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

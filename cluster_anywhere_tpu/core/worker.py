@@ -92,6 +92,26 @@ def drain_stats() -> Dict[str, int]:
     return dict(DRAIN_STATS)
 
 
+# Train-plane counters (shipped as ca_train_* by util/metrics).  The elastic
+# training story in numbers: proactive preemption restarts (the controller
+# reacted to a drain warning BEFORE the kill), checkpoint-barrier outcomes
+# inside the warning window, and attempts that were budget-exempt because
+# the death was an announced exit rather than an application failure.
+TRAIN_STATS: Dict[str, int] = {
+    "preempt_restarts_total": 0,   # drain-triggered proactive group rebuilds
+    "preempt_barrier_acked_total": 0,    # barriers where every rank checkpointed
+    "preempt_barrier_timeout_total": 0,  # barriers torn down without full acks
+    "budget_exempt_attempts_total": 0,   # restarts that did not consume max_failures
+    "callback_errors_total": 0,    # run_config callback hooks that raised
+    "shutdown_errors_total": 0,    # worker-group teardown errors (kill / PG removal)
+}
+
+
+def train_stats() -> Dict[str, int]:
+    """Snapshot of this process's train-plane counters."""
+    return dict(TRAIN_STATS)
+
+
 # Transfer-plane counters (shipped as ca_transfer_* by util/metrics).  The
 # bulk-byte data plane: windowed node-to-node object pulls, multi-source
 # range splitting, client-mode uploads, and the quantized collective ring's

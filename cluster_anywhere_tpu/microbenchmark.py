@@ -1343,6 +1343,160 @@ def head_saturation(quick: bool = False) -> List[Tuple[str, float, str]]:
     return results
 
 
+def run_train_elastic(quick: bool = False) -> List[Tuple[str, float, str]]:
+    """`ca microbenchmark --train-elastic`: A/B the preemption-elastic
+    train plane.
+
+    Both arms run the SAME training loop (periodic checkpoint every
+    `ckpt_every` steps, cooperative `train.should_checkpoint()` saves) as a
+    2-worker gang across two 1-CPU nodes, and preempt one worker node
+    mid-run (`ca.drain_node(reason="preemption")`):
+
+    - proactive (drain_aware=True, max_failures=0): the controller sees the
+      warning, barriers a checkpoint at the next step boundary, and rebuilds
+      on the survivor — budget-exempt, so max_failures=0 still succeeds.
+    - reactive (drain_aware=False, max_failures=1): the controller only
+      learns at the drain-deadline kill (poll failure) and resumes from the
+      last PERIODIC checkpoint, re-running every step since it.
+
+    Rows: preempt-warning -> training-resumed latency and steps lost
+    (re-executed) per arm.  Steps lost counts from delivered reports, so it
+    is a floor for the reactive arm (reports between the last poll and the
+    kill die with the worker)."""
+    import tempfile
+    import threading
+
+    from .cluster_utils import Cluster
+    from .core import api as ca
+
+    results: List[Tuple[str, float, str]] = []
+
+    def record(name: str, value: float, unit: str):
+        results.append((name, value, unit))
+        print(f"{name}: {value:,.2f} {unit}")
+
+    total = 40 if quick else 70
+    step_s = 0.15
+    ckpt_every = 10
+    preempt_at = 8
+    reactive_deadline_s = 3.0
+
+    def loop(config):
+        import time as _time
+
+        import numpy as _np
+
+        from cluster_anywhere_tpu import train
+        from cluster_anywhere_tpu.train import Checkpoint
+
+        ctx = train.get_context()
+        ck = train.get_checkpoint()
+        start = 0
+        if ck is not None:
+            start = int(ck.load_pytree_sharded()["step"]) + 1
+        resumed = start > 0
+        for step in range(start, config["total"]):
+            _time.sleep(config["step_s"])  # the "compute"
+            if (
+                step == config["preempt_at"]
+                and ctx.get_world_rank() == 0
+                and not resumed
+            ):
+                open(config["go"], "w").close()  # arm the preempter
+            save = (
+                train.should_checkpoint()
+                or step % config["ckpt_every"] == config["ckpt_every"] - 1
+                or step == config["total"] - 1
+            )
+            metrics = {"step": step, "t": _time.time(), "resumed": resumed}
+            if save:
+                c = Checkpoint(train.shared_checkpoint_dir(step))
+                c.save_pytree_sharded(
+                    {"step": _np.int64(step)},
+                    process_index=ctx.get_world_rank(),
+                    num_processes=ctx.get_world_size(),
+                )
+                train.report(metrics, checkpoint=c)
+            else:
+                train.report(metrics)
+
+    def arm(drain_aware: bool) -> Tuple[float, float]:
+        from .train import (
+            DataParallelTrainer,
+            FailureConfig,
+            RunConfig,
+            ScalingConfig,
+        )
+
+        cluster = Cluster(head_resources={"CPU": 0})
+        n1 = cluster.add_node(num_cpus=1)
+        cluster.add_node(num_cpus=1)
+        cluster.connect()
+        try:
+            cluster.wait_for_nodes(3)
+            tmp = tempfile.mkdtemp(prefix="ca_train_elastic_")
+            go = os.path.join(tmp, "go")
+            warn_t: Dict[str, float] = {}
+
+            def preempter():
+                while not os.path.exists(go):
+                    time.sleep(0.02)
+                warn_t["t"] = time.time()
+                ca.drain_node(
+                    n1,
+                    reason="preemption",
+                    deadline_s=30.0 if drain_aware else reactive_deadline_s,
+                )
+
+            th = threading.Thread(target=preempter, daemon=True)
+            th.start()
+            res = DataParallelTrainer(
+                loop,
+                train_loop_config={
+                    "total": total,
+                    "step_s": step_s,
+                    "ckpt_every": ckpt_every,
+                    "preempt_at": preempt_at,
+                    "go": go,
+                },
+                scaling_config=ScalingConfig(
+                    num_workers=2, min_workers=1, max_workers=2
+                ),
+                run_config=RunConfig(
+                    name="proactive" if drain_aware else "reactive",
+                    storage_path=tmp,
+                    failure_config=FailureConfig(
+                        max_failures=0 if drain_aware else 1,
+                        drain_aware=drain_aware,
+                    ),
+                ),
+            ).fit()
+            th.join(timeout=10)
+            hist = res.metrics_history
+            pre = [m for m in hist if not m["resumed"]]
+            post = [m for m in hist if m["resumed"]]
+            if not pre or not post:
+                raise RuntimeError(
+                    f"arm drain_aware={drain_aware}: no restart observed "
+                    f"(pre={len(pre)}, post={len(post)})"
+                )
+            latency = min(m["t"] for m in post) - warn_t["t"]
+            steps_lost = max(m["step"] for m in pre) - (
+                min(m["step"] for m in post) - 1
+            )
+            return latency, float(max(0, steps_lost))
+        finally:
+            cluster.shutdown()
+
+    lat_a, lost_a = arm(drain_aware=True)
+    record("train-elastic proactive restart latency", lat_a, "s")
+    record("train-elastic proactive steps lost", lost_a, "steps")
+    lat_b, lost_b = arm(drain_aware=False)
+    record("train-elastic reactive restart latency", lat_b, "s")
+    record("train-elastic reactive steps lost", lost_b, "steps")
+    return results
+
+
 def main(
     quick: bool = False,
     saturation: bool = False,
@@ -1353,6 +1507,7 @@ def main(
     owner_plane: bool = False,
     transfer: bool = False,
     serve_plane: bool = False,
+    train_elastic: bool = False,
 ):
     if saturation:
         head_saturation(quick=quick)
@@ -1370,6 +1525,8 @@ def main(
         run_transfer_plane(quick=quick)
     elif serve_plane:
         run_serve_plane(quick=quick)
+    elif train_elastic:
+        run_train_elastic(quick=quick)
     else:
         run_microbenchmarks(quick=quick)
 
@@ -1387,4 +1544,5 @@ if __name__ == "__main__":
         owner_plane="--owner-plane" in sys.argv,
         transfer="--transfer" in sys.argv,
         serve_plane="--serve" in sys.argv,
+        train_elastic="--train-elastic" in sys.argv,
     )

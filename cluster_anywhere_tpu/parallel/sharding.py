@@ -68,3 +68,105 @@ def constraint(x, mesh, *logical, rules=None):
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, logical_to_spec(logical, rules))
     )
+
+
+# --- shard-chunk geometry (the resharding read path of sharded checkpoints) ---
+#
+# A "box" is a device shard's global index as plain data: [[start, stop], ...]
+# one pair per dimension (a scalar's box is []).  Boxes serialize to JSON, so
+# per-rank shard manifests can describe where each saved chunk lives in the
+# global array without pickling slice objects; extract_region stitches any
+# requested box back together from whatever chunking the SAVING mesh used —
+# which is what lets an 8-way checkpoint restore onto a 6-way mesh.
+
+
+def index_box(index, shape) -> list:
+    """Normalize a shard index (tuple of slices, as jax reports it) into a
+    box against the global `shape`."""
+    box = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        box.append([start, stop])
+    return box
+
+
+def box_shape(box) -> tuple:
+    return tuple(b[1] - b[0] for b in box)
+
+
+def box_volume(box) -> int:
+    n = 1
+    for b in box:
+        n *= b[1] - b[0]
+    return n
+
+
+def boxes_cover(boxes, shape) -> bool:
+    """Do `boxes` exactly tile an array of `shape`?  In-bounds + pairwise
+    disjoint + volumes summing to the array's volume is equivalent to an
+    exact tiling on an integer grid (the union's volume equals the space's
+    and the union is contained in it).  The disjointness check matters:
+    volume alone would accept overlapping-but-gapped layouts — e.g. stale
+    and fresh manifests with different chunkings in one dir — and a restore
+    would then return uninitialized memory for the gap instead of raising."""
+    total = 1
+    for dim in shape:
+        total *= int(dim)
+    seen = [
+        list(map(list, b))
+        for b in {tuple(map(tuple, b)) for b in boxes}
+    ]
+    for b in seen:
+        if any(lo < 0 or hi > int(dim) for (lo, hi), dim in zip(b, shape)):
+            return False
+    for i, a in enumerate(seen):
+        for b in seen[i + 1:]:
+            if all(
+                max(x[0], y[0]) < min(x[1], y[1]) for x, y in zip(a, b)
+            ):
+                return False  # a (non-empty) overlap
+    return sum(box_volume(b) for b in seen) == total
+
+
+def extract_region(box, chunks):
+    """Assemble the global region `box` from (chunk_box, ndarray) pairs.
+
+    Chunks may be laid out by ANY partitioning of the global array; every
+    element of the requested region must be covered (boxes_cover guards
+    this at manifest-load time).  This is the topology-portable restore
+    primitive: the target mesh asks for its shard's box, and the answer is
+    stitched from whichever saved chunks overlap it."""
+    import numpy as np
+
+    if not box:  # scalar
+        for cbox, arr in chunks:
+            return np.asarray(arr).copy()
+        raise ValueError("no chunk covers the requested scalar")
+    if box_volume(box) == 0:
+        # a zero-sized region has no elements to stitch, but still needs
+        # the right shape and dtype — the overlap loop below would find no
+        # intersecting chunk (every interval is empty) and misread an
+        # empty leaf as missing coverage
+        for cbox, arr in chunks:
+            return np.empty(box_shape(box), dtype=np.asarray(arr).dtype)
+        raise ValueError(f"no chunk describes empty region {box}")
+    out = None
+    for cbox, arr in chunks:
+        inter = [
+            (max(b[0], c[0]), min(b[1], c[1])) for b, c in zip(box, cbox)
+        ]
+        if any(lo >= hi for lo, hi in inter):
+            continue
+        if out is None:
+            out = np.empty(box_shape(box), dtype=np.asarray(arr).dtype)
+        dst = tuple(
+            slice(lo - b[0], hi - b[0]) for (lo, hi), b in zip(inter, box)
+        )
+        src = tuple(
+            slice(lo - c[0], hi - c[0]) for (lo, hi), c in zip(inter, cbox)
+        )
+        out[dst] = np.asarray(arr)[src]
+    if out is None:
+        raise ValueError(f"no chunk overlaps requested region {box}")
+    return out

@@ -26,6 +26,7 @@ from .config import (
 from .controller import (
     ElasticScalingPolicy,
     FailureDecision,
+    FailureKind,
     FailurePolicy,
     FixedScalingPolicy,
     Result,
@@ -39,6 +40,8 @@ from .session import (
     get_dataset_shard,
     make_temp_checkpoint_dir,
     report,
+    shared_checkpoint_dir,
+    should_checkpoint,
 )
 from .trainer import DataParallelTrainer, JaxTrainer
 from .worker_group import TrainWorker, WorkerGroup
@@ -61,12 +64,15 @@ __all__ = [
     "ElasticScalingPolicy",
     "FailurePolicy",
     "FailureDecision",
+    "FailureKind",
     "TrainContext",
     "report",
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
     "make_temp_checkpoint_dir",
+    "shared_checkpoint_dir",
+    "should_checkpoint",
     "DataParallelTrainer",
     "JaxTrainer",
     "TrainWorker",

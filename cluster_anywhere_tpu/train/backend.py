@@ -33,9 +33,25 @@ class Backend:
         pass
 
 
+def ensure_cpu_collectives():
+    """Select Gloo for CPU cross-process collectives.  Must run BEFORE the
+    runtime initializes (newer jaxlibs default to "none" and every
+    multi-process computation raises).  The knob only affects the CPU
+    backend, so it is set unconditionally — probing the platform here would
+    initialize backends ahead of distributed.initialize and pin the mesh
+    local; TPU/GPU runtimes keep their native ICI/DCN paths regardless."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jax: gloo is the baked-in default
+
+
 def _init_jax_distributed(coordinator: str, num_processes: int, process_id: int):
     import jax
 
+    ensure_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

@@ -60,6 +60,7 @@ class BackendExecutor:
         train_fn_config: Optional[Dict[str, Any]],
         datasets: Optional[Dict[str, Any]],
         resume_checkpoint: Optional[Checkpoint],
+        attempt: int = 0,
     ):
         wg = self.worker_group
         assert wg is not None, "call start() first"
@@ -85,6 +86,7 @@ class BackendExecutor:
                 experiment_name=self.experiment_name,
                 storage_path=storage,
                 trial_dir=trial_dir,
+                attempt=attempt,
             )
             refs.append(
                 w.start_training.remote(
@@ -98,10 +100,59 @@ class BackendExecutor:
         ca.get(refs)
 
     def poll(self) -> List[Dict[str, Any]]:
+        """Per-rank poll results; a dead rank yields a synthetic error
+        entry instead of raising.  Resolving the batch with one ca.get
+        would discard every SURVIVING rank's already-drained reports when
+        any single ref raises — their poll() executed remotely (emptying
+        the session deque) before the batch get failed, losing e.g. the
+        barrier checkpoint report the preempt ack protocol just delivered."""
         import cluster_anywhere_tpu as ca
 
         assert self.worker_group is not None
-        return ca.get([w.poll.remote() for w in self.worker_group.workers])
+        refs = [w.poll.remote() for w in self.worker_group.workers]
+        out = []
+        for ref in refs:
+            try:
+                out.append(ca.get(ref))
+            except Exception as e:
+                out.append(
+                    {
+                        "reports": [],
+                        "done": False,
+                        "error": f"worker actor lost: {e!r}",
+                        "ckpt_acked": False,
+                    }
+                )
+        return out
+
+    def worker_node_ids(self) -> List[str]:
+        """Per-rank node ids of the running group ([] before start())."""
+        if self.worker_group is None:
+            return []
+        return self.worker_group.node_ids()
+
+    def request_checkpoint(self) -> List[bool]:
+        """Fan the checkpoint-on-preempt request out to every rank's
+        session.  All requests launch up front and are gathered under ONE
+        shared 2s window (ca.wait), not a per-rank timeout: N unreachable
+        ranks on the dying node must cost 2s total, not 2s each — every
+        second spent here comes out of the barrier window."""
+        import cluster_anywhere_tpu as ca
+
+        assert self.worker_group is not None
+        refs = [w.request_checkpoint.remote() for w in self.worker_group.workers]
+        ready, _ = ca.wait(refs, num_returns=len(refs), timeout=2.0)
+        ready_set = set(ready)
+        out = []
+        for ref in refs:
+            if ref not in ready_set:
+                out.append(False)  # rank unreachable inside the window
+                continue
+            try:
+                out.append(bool(ca.get(ref)))
+            except Exception:
+                out.append(False)
+        return out
 
     def shutdown(self):
         if self.worker_group is not None:

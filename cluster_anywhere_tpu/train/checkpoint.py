@@ -18,6 +18,7 @@ import pickle
 import shutil
 import tempfile
 import threading
+import time
 import uuid
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -26,6 +27,32 @@ from typing import Any, Dict, List, Optional, Tuple
 from .config import CheckpointConfig
 
 _METADATA_FILE = ".ca_checkpoint_metadata.json"
+
+
+def _atomic_write(path: str, write_fn, mode: str = "wb") -> None:
+    """Write via unique tmp + rename, unlinking the tmp on failure: a
+    preemption kill mid-write must never leave a truncated shard (or tmp
+    litter) where a restore expects a file."""
+    tmp = os.path.join(
+        os.path.dirname(path), f".{os.path.basename(path)}.{uuid.uuid4().hex[:6]}.tmp"
+    )
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _atomic_savez(path: str, arrays: Dict[str, Any]) -> None:
+    import numpy as np
+
+    _atomic_write(path, lambda f: np.savez(f, **arrays))
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    _atomic_write(path, lambda f: json.dump(obj, f), mode="w")
 
 
 class Checkpoint:
@@ -95,6 +122,358 @@ class Checkpoint:
 
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
+    # -- sharded pytree helpers (multi-process / topology-portable) -------
+    #
+    # `save_pytree` gathers the WHOLE state onto every host (jax.device_get),
+    # which cannot run under a multi-process mesh where each process
+    # addresses only its own devices' shards — and it forces the restoring
+    # mesh to fit the full state per host.  The sharded variant writes, per
+    # process, only the shards that process can address:
+    #
+    #   {name}.shard<p>.npz   chunk arrays (this process's replica-0 shards)
+    #   {name}.shard<p>.json  chunk -> (leaf index, global index box)
+    #   {name}.index.json     world size + per-leaf global shape/dtype (rank 0)
+    #   {name}.treedef.pkl    pytree structure (rank 0)
+    #
+    # Restore stitches any target sharding from whatever chunking the SAVING
+    # mesh used (parallel/sharding.py extract_region), so a checkpoint
+    # written by an 8-process world reshards onto the 6-process mesh the
+    # surviving nodes form — optimizer state re-laid-out included (cf.
+    # automatic cross-replica sharding, arxiv 2004.13336).
+
+    def is_sharded(self, name: Optional[str] = None) -> bool:
+        """Does this checkpoint hold a rank-cooperative sharded pytree?
+        With name=None (the session's register-in-place check) ANY sharded
+        save counts, whatever it was named; any rank's shard manifest
+        suffices — rank 0's index may not have landed yet while the barrier
+        is still draining."""
+        try:
+            files = os.listdir(self.path)
+        except OSError:
+            return False
+        if name is None:
+            return any(
+                f.endswith(".index.json")
+                or (".shard" in f and f.endswith(".json"))
+                for f in files
+            )
+        return any(
+            f == f"{name}.index.json"
+            or (f.startswith(f"{name}.shard") and f.endswith(".json"))
+            for f in files
+        )
+
+    def save_pytree_sharded(
+        self,
+        tree: Any,
+        name: str = "state",
+        process_index: Optional[int] = None,
+        num_processes: Optional[int] = None,
+    ) -> None:
+        """Store this process's addressable shards of a (possibly only
+        partially addressable) global pytree.  Every rank of a gang calls
+        this against the SAME directory; each jax.Array leaf contributes its
+        replica-0 device shards with their global index boxes, non-array
+        leaves are written whole by process 0.  Writes are atomic
+        (tmp + rename) so a preemption kill mid-save never leaves a
+        half-written shard for the restore to trip on."""
+        import numpy as np
+
+        from ..parallel.sharding import index_box
+
+        try:
+            import jax
+
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            if process_index is None:
+                process_index = jax.process_index()
+            if num_processes is None:
+                num_processes = jax.process_count()
+        except ImportError:  # numpy-only environments
+            arr = np.asarray(tree)
+            if arr.dtype == object:
+                # np.asarray on a dict/mixed tree yields an object array:
+                # savez would happily pickle it, but np.load(allow_pickle=
+                # False) on restore cannot read it back — the data would be
+                # unrecoverable.  Fail at save time, not resume time.
+                raise TypeError(
+                    "save_pytree_sharded without jax supports only a "
+                    "single array-like tree; got a structure that "
+                    "numpy can only represent as an object array"
+                )
+            leaves, treedef = [arr], None
+            process_index = process_index or 0
+            num_processes = num_processes or 1
+        chunks: Dict[str, Any] = {}
+        meta: List[Dict[str, Any]] = []
+        leaf_specs: List[Dict[str, Any]] = []
+
+        def _add(leaf_i: int, box: list, data) -> None:
+            key = f"l{leaf_i}c{len(meta)}"
+            chunks[key] = data
+            meta.append({"leaf": leaf_i, "key": key, "box": box})
+
+        for i, leaf in enumerate(leaves):
+            shards = getattr(leaf, "addressable_shards", None)
+            # attribute reads only: np.asarray on a partially-addressable
+            # global array would try to fetch remote shards and raise
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                shape = tuple(leaf.shape)
+                dtype = str(leaf.dtype)
+            else:
+                arr = np.asarray(leaf)
+                shape, dtype = arr.shape, str(arr.dtype)
+            leaf_specs.append({"shape": list(shape), "dtype": dtype})
+            if shards is not None:
+                for sh in shards:
+                    if sh.replica_id != 0:
+                        continue  # one writer per distinct global shard
+                    _add(i, index_box(sh.index, shape), np.asarray(sh.data))
+            elif process_index == 0:
+                arr = np.asarray(leaf)
+                if arr.dtype == object:
+                    # savez would pickle an object array silently, but
+                    # np.load(allow_pickle=False) on restore can never
+                    # read it back — and sharded_complete (manifest-only)
+                    # would keep steering resume into the poisoned dir.
+                    # Fail at save time, not resume time.
+                    raise TypeError(
+                        f"save_pytree_sharded: leaf {i} is not array-like "
+                        "(numpy can only represent it as an object array, "
+                        "which a pickle-free restore cannot read)"
+                    )
+                _add(i, [[0, d] for d in arr.shape], arr)
+        _atomic_savez(
+            os.path.join(self.path, f"{name}.shard{process_index}.npz"), chunks
+        )
+        _atomic_write_json(
+            os.path.join(self.path, f"{name}.shard{process_index}.json"),
+            {"process_index": process_index, "chunks": meta},
+        )
+        if process_index == 0:
+            _atomic_write_json(
+                os.path.join(self.path, f"{name}.index.json"),
+                {
+                    "version": 1,
+                    "world_size": num_processes,
+                    "num_leaves": len(leaves),
+                    "leaves": leaf_specs,
+                },
+            )
+            _atomic_write(
+                os.path.join(self.path, f"{name}.treedef.pkl"),
+                lambda f: pickle.dump(treedef, f),
+            )
+            # overwriting a dir saved by a LARGER world leaves stale
+            # high-rank shards behind whose boxes would double-cover the
+            # leaves and fail the restore's coverage check — sweep them
+            for fn in os.listdir(self.path):
+                pref = f"{name}.shard"
+                if not fn.startswith(pref):
+                    continue
+                rank_str = fn[len(pref):].split(".", 1)[0]
+                if rank_str.isdigit() and int(rank_str) >= num_processes:
+                    try:
+                        os.unlink(os.path.join(self.path, fn))
+                    except OSError:
+                        pass
+
+    def _read_shard_directory(self, name: str = "state"):
+        """Read a sharded checkpoint's manifests (no array loads): returns
+        (index, per-leaf chunk directory) and raises ValueError when the
+        chunk boxes do not fully cover every leaf — the coverage check that
+        keeps a missing rank's shard from silently zero-filling a restore."""
+        import glob as _glob
+
+        from ..parallel.sharding import boxes_cover
+
+        with open(os.path.join(self.path, f"{name}.index.json")) as f:
+            index = json.load(f)
+        if not os.path.exists(os.path.join(self.path, f"{name}.treedef.pkl")):
+            # rank 0 writes the treedef LAST: an index without it means the
+            # save was killed between the two writes — restorable data but
+            # no structure to unflatten into, so the dir is incomplete
+            raise ValueError(
+                f"incomplete sharded checkpoint {self.path!r}: "
+                f"{name}.treedef.pkl never landed"
+            )
+        # chunk directory: leaf -> [(box, shard_npz_path, key)]
+        per_leaf: List[List[Tuple[list, str, str]]] = [
+            [] for _ in range(index["num_leaves"])
+        ]
+        for mpath in sorted(
+            _glob.glob(os.path.join(self.path, f"{name}.shard*.json"))
+        ):
+            with open(mpath) as f:
+                m = json.load(f)
+            if int(m.get("process_index", 0)) >= index["world_size"]:
+                # stale shard from an earlier larger-world save into this
+                # dir (save-side sweep may not have run against it)
+                continue
+            npz = mpath[: -len(".json")] + ".npz"
+            for c in m["chunks"]:
+                if not 0 <= c["leaf"] < index["num_leaves"]:
+                    # a manifest left over from a save with a DIFFERENT
+                    # tree structure: corrupt, not merely incomplete
+                    raise ValueError(
+                        f"sharded checkpoint {self.path!r}: manifest "
+                        f"{os.path.basename(mpath)} references leaf "
+                        f"{c['leaf']} but the index has "
+                        f"{index['num_leaves']} leaves"
+                    )
+                per_leaf[c["leaf"]].append((c["box"], npz, c["key"]))
+        for i, spec in enumerate(index["leaves"]):
+            if not boxes_cover([b for b, _, _ in per_leaf[i]], spec["shape"]):
+                raise ValueError(
+                    f"incomplete sharded checkpoint {self.path!r}: leaf {i} "
+                    f"(shape {spec['shape']}) is not fully covered by the "
+                    f"saved shards — a rank's shard file is missing"
+                )
+        return index, per_leaf
+
+    def sharded_complete(self, name: Optional[str] = None) -> bool:
+        """Cheap (manifest-only) validity probe: does every leaf have full
+        shard coverage?  False for a dir where a rank's save never landed
+        (killed mid-write) — the controller skips such checkpoints when
+        picking a resume point instead of retrying into the same error.
+        name=None validates every sharded save in the dir (whatever names
+        the loop used); a dir with shard files but no index (rank 0 never
+        finished) is incomplete by definition."""
+        try:
+            if name is None:
+                names = [
+                    f[: -len(".index.json")]
+                    for f in os.listdir(self.path)
+                    if f.endswith(".index.json")
+                ]
+                if not names:
+                    return False  # no index landed: not restorable at all
+            else:
+                names = [name]
+            for nm in names:
+                self._read_shard_directory(nm)
+            return True
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return False
+
+    def load_pytree_sharded(
+        self,
+        name: str = "state",
+        mesh: Any = None,
+        specs: Any = None,
+    ) -> Any:
+        """Restore a sharded pytree, resharding onto `mesh`.
+
+        mesh=None: assemble full host (numpy) arrays — the single-process /
+        inspection path.  With a mesh: `specs` gives the target layout (a
+        matching pytree of PartitionSpec, one spec for every leaf, or None =
+        fully replicated) and each leaf materializes via
+        jax.make_array_from_callback, so every process reads only the saved
+        chunks overlapping ITS addressable shards.  The saving and restoring
+        world sizes are independent: coverage is validated from the chunk
+        boxes, and a missing rank's shard raises instead of zero-filling."""
+        import numpy as np
+
+        from ..parallel.sharding import extract_region
+
+        index, per_leaf = self._read_shard_directory(name)
+        with open(os.path.join(self.path, f"{name}.treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        files: Dict[str, Any] = {}
+
+        def _load(npz: str, key: str):
+            if npz not in files:
+                files[npz] = np.load(npz)
+            return files[npz][key]
+
+        def _chunks(leaf_i: int, want: Optional[list] = None) -> List[Tuple[list, Any]]:
+            """Materialize leaf chunks — only the ones intersecting `want`
+            when given.  npz members decompress per key, so a process
+            restoring onto a mesh reads ONLY the saved bytes overlapping
+            its own shards, not the whole global array."""
+            out = []
+            for box, npz, key in per_leaf[leaf_i]:
+                if want is not None and any(
+                    max(b[0], w[0]) >= min(b[1], w[1])
+                    for b, w in zip(box, want)
+                ):
+                    continue  # no (non-empty) intersection with the request
+                out.append((box, _load(npz, key)))
+            return out
+
+        try:
+            if mesh is None:
+                from ..parallel.sharding import box_volume
+
+                # zero-sized leaves rebuild from the index's recorded
+                # shape/dtype alone — they may have no chunk at all (a
+                # zero-volume leaf passes coverage vacuously), and there
+                # are no elements to read anyway
+                leaves = [
+                    np.empty(tuple(spec["shape"]), dtype=spec["dtype"])
+                    if spec["shape"]
+                    and box_volume([[0, d] for d in spec["shape"]]) == 0
+                    else extract_region(
+                        [[0, d] for d in spec["shape"]], _chunks(i)
+                    )
+                    for i, spec in enumerate(index["leaves"])
+                ]
+            else:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from ..parallel.sharding import index_box
+
+                n = index["num_leaves"]
+                if specs is None:
+                    spec_list = [PartitionSpec()] * n
+                elif isinstance(specs, PartitionSpec):
+                    spec_list = [specs] * n
+                else:
+                    spec_list, _ = jax.tree_util.tree_flatten(
+                        specs,
+                        is_leaf=lambda x: x is None
+                        or isinstance(x, PartitionSpec),
+                    )
+                    if len(spec_list) != n:
+                        raise ValueError(
+                            f"specs pytree has {len(spec_list)} leaves, "
+                            f"checkpoint has {n}"
+                        )
+                from ..parallel.sharding import box_shape, box_volume
+
+                def _region(idx, leaf_i, shape, dtype):
+                    box = index_box(idx, shape)
+                    if box_volume(box) == 0:
+                        # an empty target shard has nothing to read — the
+                        # index records shape/dtype, no chunk IO needed
+                        return np.empty(box_shape(box), dtype=dtype)
+                    return extract_region(box, _chunks(leaf_i, want=box))
+
+                leaves = []
+                for i, spec in enumerate(index["leaves"]):
+                    shape, dtype = tuple(spec["shape"]), spec["dtype"]
+                    sharding = NamedSharding(
+                        mesh, spec_list[i] or PartitionSpec()
+                    )
+                    leaves.append(
+                        jax.make_array_from_callback(
+                            shape,
+                            sharding,
+                            lambda idx, _i=i, _s=shape, _d=dtype: _region(
+                                idx, _i, _s, _d
+                            ),
+                        )
+                    )
+        finally:
+            for z in files.values():
+                z.close()
+        if treedef is None:
+            return leaves[0]
+        import jax
+
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     def __repr__(self):
         return f"Checkpoint(path={self.path!r})"
 
@@ -117,6 +496,7 @@ class CheckpointManager:
         self.config = config or CheckpointConfig()
         self._lock = threading.Lock()
         self._checkpoints: List[_TrackedCheckpoint] = []
+        self._pending_delete: List[Checkpoint] = []
         self._next_index = 0
 
     def register(
@@ -125,6 +505,17 @@ class CheckpointManager:
         with self._lock:
             tracked = _TrackedCheckpoint(checkpoint, self._next_index, metrics or {})
             self._next_index += 1
+            # a re-registered path supersedes its older entry (dropped
+            # WITHOUT deleting: they share the directory).  Rank-shared
+            # sharded dirs are keyed by step, so a retry attempt that
+            # re-runs a step re-saves into — and re-registers — the same
+            # dir; two tracked entries aliasing one path would let keep-K
+            # eviction of the stale entry rmtree the live checkpoint
+            self._checkpoints = [
+                t
+                for t in self._checkpoints
+                if t.checkpoint.path != checkpoint.path
+            ]
             self._checkpoints.append(tracked)
             self._evict_locked()
             return tracked
@@ -137,7 +528,19 @@ class CheckpointManager:
             return (float("-inf"), t.index)
         return (sign * float(t.metrics[attr]), t.index)
 
+    # sharded dirs written to this recently may have a lagging rank still
+    # mid-save (register-in-place: every rank writes the SAME dir, and the
+    # driver registers on rank 0's report, not on all ranks finishing) —
+    # deleting under the writer would error that rank and charge the
+    # attempt to max_failures for an eviction race
+    _SHARDED_EVICT_GRACE_S = 60.0
+
     def _evict_locked(self):
+        # retry deferred deletions FIRST, even when nothing new gets
+        # evicted this pass — the early return below must not strand them
+        pending, self._pending_delete = self._pending_delete, []
+        for ck in pending:
+            self._delete_or_defer(ck)
         k = self.config.num_to_keep
         if k is None or len(self._checkpoints) <= k:
             return
@@ -146,10 +549,38 @@ class CheckpointManager:
         keep = ranked[:k]
         if latest not in keep:  # the latest is always kept for resume
             keep = keep[: k - 1] + [latest]
+        keep_paths = {t.checkpoint.path for t in keep}
         for t in self._checkpoints:
-            if t not in keep:
-                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+            if t not in keep and t.checkpoint.path not in keep_paths:
+                self._delete_or_defer(t.checkpoint)
         self._checkpoints = [t for t in self._checkpoints if t in keep]
+
+    def finalize(self):
+        """Run teardown: force-delete evictions still deferred by the
+        write-grace window.  The grace protects lagging ranks mid-save;
+        once the worker group is down there are no writers left, and
+        leaving the dirs would quietly turn keep-K into keep-K-plus-tail
+        (multi-GB state per leaked dir)."""
+        with self._lock:
+            pending, self._pending_delete = self._pending_delete, []
+            for ck in pending:
+                shutil.rmtree(ck.path, ignore_errors=True)
+
+    def _delete_or_defer(self, ck: Checkpoint) -> None:
+        """rmtree an evicted checkpoint dir, unless it is a sharded dir
+        whose files changed within the grace window (a lagging rank may
+        still be writing) — those go to the pending list and are retried
+        on the next eviction."""
+        try:
+            if ck.is_sharded() and (
+                time.time() - os.path.getmtime(ck.path)
+                < self._SHARDED_EVICT_GRACE_S
+            ):
+                self._pending_delete.append(ck)
+                return
+        except OSError:
+            pass  # already gone / unreadable: fall through to rmtree
+        shutil.rmtree(ck.path, ignore_errors=True)
 
     @property
     def best_checkpoint(self) -> Optional[Checkpoint]:
@@ -164,6 +595,13 @@ class CheckpointManager:
             if not self._checkpoints:
                 return None
             return self._checkpoints[-1].checkpoint
+
+    def checkpoints_newest_first(self) -> List[Checkpoint]:
+        """Registration order, newest first — the controller walks this to
+        find the newest RESUMABLE checkpoint (skipping sharded dirs whose
+        ranks were killed mid-save)."""
+        with self._lock:
+            return [t.checkpoint for t in reversed(self._checkpoints)]
 
     def best_checkpoints(self) -> List[Tuple[Checkpoint, Dict[str, Any]]]:
         with self._lock:

@@ -68,9 +68,21 @@ class CheckpointConfig:
 @dataclass
 class FailureConfig:
     """max_failures: worker-group restarts allowed before the run fails.
-    -1 = unlimited (reference air/config.py FailureConfig)."""
+    -1 = unlimited (reference air/config.py FailureConfig).
+
+    Preemption elasticity: with drain_aware on (default), the controller
+    watches the drain plane's preemption warnings (`worker.draining_node_ids`)
+    and, when a node hosting a gang member enters its drain window, asks
+    every rank to checkpoint at the next step boundary
+    (`train.should_checkpoint()`), waits up to preempt_barrier_timeout_s for
+    the barrier, and rebuilds the group on survivors BEFORE the kill lands.
+    Preemption-caused attempts never consume max_failures — an announced
+    exit is the system's fault, not the application's (mirrors the drain
+    plane's budget-exempt task retry)."""
 
     max_failures: int = 0
+    drain_aware: bool = True
+    preempt_barrier_timeout_s: float = 15.0
 
 
 @dataclass

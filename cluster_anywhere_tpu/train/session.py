@@ -31,6 +31,10 @@ class TrainContext:
     experiment_name: str
     storage_path: str
     trial_dir: str
+    # controller-assigned attempt number, identical on every rank of the
+    # gang — what keys rank-shared sharded checkpoint dirs so a retry that
+    # re-runs a step never re-saves into a previous attempt's directory
+    attempt: int = 0
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -62,6 +66,15 @@ class _Session:
         self.lock = threading.Lock()
         self.report_seq = 0
         self.finished = threading.Event()
+        # checkpoint-on-preempt barrier (controller -> session control
+        # channel): the controller sets ckpt_request on every rank when a
+        # gang node enters a drain window; the training loop observes it via
+        # train.should_checkpoint() and answers by reporting a checkpoint at
+        # its next step boundary, which flips ckpt_acked for the driver's
+        # barrier poll.  Resume then loses at most ONE step, not one
+        # checkpoint interval.
+        self.ckpt_request = threading.Event()
+        self.ckpt_acked = False
         # distinguishes checkpoint dirs across retry attempts: report_seq
         # restarts at 0 in a new session, and a colliding path would let the
         # driver's keep-K eviction of the old attempt's entry delete the new
@@ -73,21 +86,38 @@ class _Session:
     ) -> None:
         entry: Dict[str, Any] = {"metrics": dict(metrics), "seq": self.report_seq}
         if checkpoint is not None:
-            # Persist into the trial dir so it survives the worker process.
-            # Only rank 0's copy is registered by the driver, but every rank
-            # may pass a checkpoint (they are rank-tagged to avoid collision).
-            dest = os.path.join(
-                self.context.trial_dir,
-                f"checkpoint_{self.attempt_token}_{self.report_seq:06d}"
-                f"_rank{self.context.world_rank}",
-            )
-            if os.path.abspath(checkpoint.path) != dest:
-                os.makedirs(os.path.dirname(dest), exist_ok=True)
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
-            entry["checkpoint_path"] = dest
+            if checkpoint.is_sharded():
+                # rank-cooperative sharded checkpoint: every rank wrote its
+                # own shards into ONE shared dir (shared_checkpoint_dir) —
+                # register it in place; a per-rank copy would capture only
+                # the shards that happened to have landed at copy time
+                entry["checkpoint_path"] = checkpoint.path
+            else:
+                # Persist into the trial dir so it survives the worker
+                # process.  Only rank 0's copy is registered by the driver,
+                # but every rank may pass a checkpoint (they are rank-tagged
+                # to avoid collision).
+                dest = os.path.join(
+                    self.context.trial_dir,
+                    f"checkpoint_{self.attempt_token}_{self.report_seq:06d}"
+                    f"_rank{self.context.world_rank}",
+                )
+                if os.path.abspath(checkpoint.path) != dest:
+                    os.makedirs(os.path.dirname(dest), exist_ok=True)
+                    shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                entry["checkpoint_path"] = dest
         with self.lock:
             self.reports.append(entry)
             self.report_seq += 1
+            if checkpoint is not None and self.ckpt_request.is_set():
+                # the barrier is answered by the FIRST checkpoint-carrying
+                # report after the request, whatever triggered the save.
+                # Acked strictly AFTER the entry is queued (and inside the
+                # lock): the controller's poll must never observe the ack
+                # without also draining the checkpoint report it acks —
+                # it tears the group down on the strength of that ack
+                self.ckpt_request.clear()
+                self.ckpt_acked = True
 
     def drain_reports(self) -> List[Dict[str, Any]]:
         with self.lock:
@@ -144,5 +174,32 @@ def make_temp_checkpoint_dir() -> str:
     d = os.path.join(
         _get_session().context.trial_dir, f"_tmp_ckpt_{uuid.uuid4().hex[:8]}"
     )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def should_checkpoint() -> bool:
+    """Has the controller asked this rank to checkpoint at the next step
+    boundary?  Set when a node hosting a gang member enters a preemption
+    drain window; answer by reporting a checkpoint (the report clears the
+    flag and acks the barrier).  Ranks of a multi-process mesh should agree
+    on the boundary by reducing the flag across the mesh (max) before
+    branching — the request lands on every rank, but not atomically between
+    steps (see ARCHITECTURE.md "Elastic train plane")."""
+    return _get_session().ckpt_request.is_set()
+
+
+def shared_checkpoint_dir(tag: Any) -> str:
+    """The rank-SHARED directory for a cooperative sharded checkpoint:
+    every rank calling with the same `tag` (use the step number) resolves
+    the same trial-dir path, writes its own shards there
+    (Checkpoint.save_pytree_sharded), and reports it; the session registers
+    sharded checkpoints in place instead of making per-rank copies.  The
+    path is keyed by the controller-assigned attempt too: a retry that
+    re-runs a step must save into a FRESH dir — a kill mid-re-save into the
+    previous attempt's dir would leave a mix of old and new shards that
+    passes the coverage check and restores inconsistent state."""
+    ctx = _get_session().context
+    d = os.path.join(ctx.trial_dir, f"shard_ckpt_a{ctx.attempt}_{tag}")
     os.makedirs(d, exist_ok=True)
     return d

@@ -82,11 +82,29 @@ class TrainWorker:
 
     def poll(self) -> Dict[str, Any]:
         s = self._session
+        # checkpoint-on-preempt barrier: True once this rank reported a
+        # checkpoint after the controller's request_checkpoint.  Read BEFORE
+        # draining: the ack is set after its report is queued, so an ack
+        # observed here guarantees the checkpoint entry rides this (or an
+        # earlier) drain — the controller tears the group down on it
+        acked = bool(s.ckpt_acked) if s else False
         return {
             "reports": s.drain_reports() if s else [],
             "done": self._done,
             "error": self._error,
+            "ckpt_acked": acked,
         }
+
+    def request_checkpoint(self) -> bool:
+        """Controller->session control channel: ask the training loop to
+        checkpoint at its next step boundary (train.should_checkpoint()).
+        Returns False when no session is running (nothing to barrier on)."""
+        s = self._session
+        if s is None or self._done:
+            return False
+        s.ckpt_acked = False
+        s.ckpt_request.set()
+        return True
 
     def join(self, timeout: Optional[float] = None) -> bool:
         if self._thread is None:
@@ -97,6 +115,21 @@ class TrainWorker:
     def execute(self, fn: Callable, *args, **kwargs):
         """Run an arbitrary function in the worker process (backend setup)."""
         return fn(*args, **kwargs)
+
+
+def _node_sorted_permutation(node_infos: List[Dict[str, Any]]) -> List[int]:
+    """Stable permutation grouping workers by first-seen node: ranks on the
+    same node become contiguous (and keep their relative order), which is
+    what local_ranks()/node_ranks() assume.  Raw placement order can
+    interleave nodes (e.g. SPREAD, or PACK across partially-full nodes),
+    which would hand two workers of one node non-consecutive local ranks."""
+    order: Dict[str, int] = {}
+    for info in node_infos:
+        order.setdefault(info["node_id"], len(order))
+    return sorted(
+        range(len(node_infos)),
+        key=lambda i: (order[node_infos[i]["node_id"]], i),
+    )
 
 
 class WorkerGroup:
@@ -133,11 +166,21 @@ class WorkerGroup:
                 num_cpus=bundle.get("CPU", 0),
                 num_tpus=bundle.get("TPU", 0),
                 resources=custom,
+                # the TrainController handles node drains app-aware
+                # (checkpoint barrier + group rebuild on survivors); the
+                # head's generic drain evacuation restarting a TrainWorker
+                # elsewhere would race the barrier and lose the training
+                # thread's state anyway
+                drain_migration=False,
             ).remote()
             for i in range(num_workers)
         ]
-        # sorted by node for stable local_rank assignment
-        self.node_infos = ca.get([w.node_info.remote() for w in self.workers])
+        # sorted by node for stable local_rank assignment: workers and their
+        # infos are reordered TOGETHER so rank i always maps to workers[i]
+        infos = ca.get([w.node_info.remote() for w in self.workers])
+        perm = _node_sorted_permutation(infos)
+        self.workers = [self.workers[i] for i in perm]
+        self.node_infos = [infos[i] for i in perm]
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
         return ca.get(self.execute_async(fn, *args, **kwargs))
@@ -167,14 +210,33 @@ class WorkerGroup:
             ranks.append(order[nid])
         return ranks
 
+    def node_ids(self) -> List[str]:
+        """Per-rank node ids — what the controller intersects with the
+        drain plane's draining_node_ids() to spot a preemption warning."""
+        return [info["node_id"] for info in self.node_infos]
+
     def shutdown(self):
-        for w in self.workers:
+        from ..core.ownership import warn_ratelimited
+        from ..core.worker import TRAIN_STATS
+
+        for rank, w in enumerate(self.workers):
             try:
                 ca.kill(w)
-            except Exception:
-                pass
+            except Exception as e:
+                # a worker that is already gone (preempted node) is normal
+                # here, but it must stay visible: a kill that fails for any
+                # OTHER reason leaks an actor slot for the group's lifetime
+                TRAIN_STATS["shutdown_errors_total"] += 1
+                warn_ratelimited(
+                    "train_wg_kill",
+                    f"train worker group: killing rank {rank} failed: {e!r}",
+                )
         self.workers = []
         try:
             ca.remove_placement_group(self._pg)
-        except Exception:
-            pass
+        except Exception as e:
+            TRAIN_STATS["shutdown_errors_total"] += 1
+            warn_ratelimited(
+                "train_wg_pg",
+                f"train worker group: removing placement group failed: {e!r}",
+            )

@@ -68,6 +68,28 @@ _LOGPLANE_DESCS = {
 }
 
 
+_train_shipped: Dict[str, int] = {}
+_TRAIN_DESCS = {
+    "preempt_restarts_total": (
+        "worker-group rebuilds triggered proactively by a drain warning "
+        "(before the preemption kill, not after a poll failure)"
+    ),
+    "preempt_barrier_acked_total": (
+        "checkpoint-on-preempt barriers where every rank checkpointed "
+        "inside the warning window"
+    ),
+    "preempt_barrier_timeout_total": (
+        "checkpoint-on-preempt barriers torn down without full acks"
+    ),
+    "budget_exempt_attempts_total": (
+        "train attempts restarted without consuming failure_config."
+        "max_failures (preemption-caused deaths are the system's fault)"
+    ),
+    "callback_errors_total": "run_config callback hooks that raised",
+    "shutdown_errors_total": "train worker-group teardown errors",
+}
+
+
 _drain_shipped: Dict[str, int] = {}
 _DRAIN_DESCS = {
     "tasks_evacuated_total": (
@@ -193,6 +215,16 @@ def _drain_records() -> List[dict]:
     return _counter_deltas("ca_drain_", DRAIN_STATS, _drain_shipped, _DRAIN_DESCS)
 
 
+def _train_records() -> List[dict]:
+    """Train-plane counters (core/worker.py TRAIN_STATS) as ca_train_*
+    records: proactive preemption restarts, checkpoint-barrier outcomes,
+    and budget-exempt attempts — the series behind `ca microbenchmark
+    --train-elastic`'s proactive-vs-reactive claim."""
+    from ..core.worker import TRAIN_STATS
+
+    return _counter_deltas("ca_train_", TRAIN_STATS, _train_shipped, _TRAIN_DESCS)
+
+
 def _logplane_records() -> List[dict]:
     """Log-plane counters (util/logplane.py LOG_STATS) as ca_log_lines_total
     / ca_log_bytes_total / ca_log_dropped_total — capture volume and drop
@@ -302,6 +334,7 @@ def flush_once():
     batch.extend(_owner_records())
     batch.extend(_transfer_records())
     batch.extend(_drain_records())
+    batch.extend(_train_records())
     batch.extend(_logplane_records())
     batch.extend(_metrics_records())
     if not batch:

@@ -268,6 +268,36 @@ def serve_plane() -> Dict[str, Any]:
     }
 
 
+def train_plane() -> Dict[str, Any]:
+    """Train-plane summary: every run's controller digest from the head KV
+    (status / attempt / world size / failure count / preemption restarts /
+    last registered checkpoint — controllers publish `train:run:<name>` at
+    ~1s while polling and on every attempt transition), plus the
+    cluster-aggregated ca_train_* counters behind the elastic story
+    (proactive preempt restarts, barrier acks, budget-exempt attempts)."""
+    from .metrics import get_metrics_snapshot
+
+    runs: Dict[str, Any] = {}
+    try:
+        for key in _head("kv_keys", prefix="train:run:")["keys"]:
+            raw = _head("kv_get", key=key).get("value")
+            if raw:
+                runs[key[len("train:run:"):]] = json.loads(raw)
+    except Exception:
+        pass
+    counters: Dict[str, int] = {}
+    try:
+        snap = get_metrics_snapshot()
+        for name, rec in snap.items():
+            if name.startswith("ca_train_") and rec.get("type") == "counter":
+                counters[name[len("ca_train_"):]] = int(
+                    sum(rec.get("data", {}).values())
+                )
+    except Exception:
+        pass
+    return {"runs": runs, "counters": counters}
+
+
 def timeseries(
     names: Optional[List[str]] = None,
     *,
