@@ -506,6 +506,23 @@ def bench_train_plane():
     return out
 
 
+def bench_chaos_plane():
+    """Partition-tolerance rows (head<->node blackhole mid-workload:
+    detect->fence->heal timeline, at-most-once commit proof, zombie-grant
+    audit, fresh-incarnation rejoin) as a BENCH-json block.  The structural
+    claims are zero duplicate/missing commits and zero zombie grants; the
+    detect/heal latencies are host-noisy context."""
+    from cluster_anywhere_tpu.microbenchmark import run_partition_chaos
+
+    rows = run_partition_chaos(quick=True)
+    out = {}
+    for name, value, _unit in rows:
+        key = name.replace("partition ", "").replace("->", "_to_").replace(" ", "_")
+        out[key] = round(value, 3)
+    log(f"chaosplane: {out}")
+    return out
+
+
 def main():
     _, best_actor, _, logplane, drainplane, ownerplane, metricsplane = bench_core()
     transferplane = {}
@@ -523,6 +540,11 @@ def main():
         trainplane = bench_train_plane()
     except Exception as e:
         log(f"train plane bench failed: {e!r}")
+    chaosplane = {}
+    try:
+        chaosplane = bench_chaos_plane()
+    except Exception as e:
+        log(f"chaos plane bench failed: {e!r}")
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -548,6 +570,8 @@ def main():
         out["serveplane"] = serveplane
     if trainplane:
         out["trainplane"] = trainplane
+    if chaosplane:
+        out["chaosplane"] = chaosplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

@@ -224,6 +224,50 @@ def cmd_drain(args):
     ca.shutdown()
 
 
+def cmd_chaos(args):
+    """Network-chaos plane control: install/clear/inspect a cluster-wide
+    per-link fault schedule (blackhole/delay/flap, seeded+deterministic).
+    The head installs the spec locally and broadcasts it to every connected
+    process, so both ends of each named link inject symmetrically."""
+    from cluster_anywhere_tpu.core.worker import global_worker
+
+    ca = _connect(args)
+    try:
+        w = global_worker()
+        if args.action == "set":
+            if not args.spec:
+                print("usage: ca chaos set '<spec>'  (e.g. "
+                      "'seed=7;n0<>node1:blackhole@0+8')")
+                sys.exit(2)
+            r = w.head_call(
+                "net_chaos", spec=args.spec, epoch=args.epoch or time.time()
+            )
+            print(f"installed: {r.get('spec')}")
+        elif args.action == "clear":
+            w.head_call("net_chaos", spec="")
+            print("cleared (reachable processes only — scheduled windows "
+                  "heal partitioned ones)")
+        else:  # status
+            r = w.head_call("net_chaos")
+            st = r.get("status") or {}
+            if not st.get("active"):
+                print("net chaos: inactive")
+            else:
+                print(f"net chaos: {st.get('spec')}")
+                print(f"  seed={st.get('seed')} epoch={st.get('epoch'):.3f} "
+                      f"local={st.get('local')}")
+                print(f"  links: {', '.join(st.get('links') or [])}")
+                for k, v in (st.get("stats") or {}).items():
+                    print(f"  {k}: {v}")
+                for ev in st.get("events") or []:
+                    print(f"  event: {ev}")
+    except Exception as e:
+        print(f"chaos command failed: {e}")
+        ca.shutdown()
+        sys.exit(1)
+    ca.shutdown()
+
+
 def cmd_status(args):
     ca = _connect(args)
     total = ca.cluster_resources()
@@ -802,6 +846,13 @@ def cmd_microbenchmark(args):
 
         run_train_elastic(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "partition", False):
+        # owns its own clusters (head<->node blackhole mid-workload:
+        # detect->fence->heal timeline + at-most-once commit proof)
+        from .microbenchmark import run_partition_chaos
+
+        run_partition_chaos(quick=getattr(args, "quick", False))
+        return
 
     import cluster_anywhere_tpu as ca
 
@@ -896,6 +947,23 @@ def main(argv=None):
     )
     addr(sp)
     sp.set_defaults(fn=cmd_drain)
+
+    sp = sub.add_parser(
+        "chaos",
+        help="network-chaos plane: install/clear/inspect a per-link "
+        "blackhole/delay/flap schedule cluster-wide",
+    )
+    addr(sp)
+    sp.add_argument("action", choices=["set", "clear", "status"])
+    sp.add_argument(
+        "spec", nargs="?", default=None,
+        help="chaos spec for `set`, e.g. 'seed=7;n0<>node1:blackhole@0+8'",
+    )
+    sp.add_argument(
+        "--epoch", type=float, default=None,
+        help="wall-clock anchor for window offsets (default: now)",
+    )
+    sp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("submit", help="submit a job: ca submit -- python x.py")
     addr(sp)
@@ -1065,6 +1133,12 @@ def main(argv=None):
         help="preemption-elastic train A/B: drain-aware proactive restart "
         "vs reactive poll-failure restart (warning->resumed latency, "
         "steps lost, max_failures consumed)",
+    )
+    sp.add_argument(
+        "--partition", action="store_true",
+        help="partition-tolerance chaos: head<->node blackhole mid-workload "
+        "(detect->fence->heal timeline, at-most-once side effects, "
+        "zombie-free rejoin at a fresh incarnation)",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

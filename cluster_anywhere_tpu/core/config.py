@@ -153,6 +153,14 @@ class CAConfig:
     # RAY_testing_rpc_failure (src/ray/rpc/rpc_chaos.h): "method=N" pairs,
     # failing the first N matching RPCs.
     testing_rpc_failure: str = ""
+    # deterministic per-method RPC latency injection: "method=MS" pairs add
+    # MS milliseconds before each matching send (straggler RPCs; names
+    # validated against the protocol contract exactly like the failure knob)
+    testing_rpc_delay: str = ""
+    # network-chaos plane (core/netchaos.py): per-link blackhole / delay /
+    # flap schedules, e.g. "seed=7;n0<>node1:blackhole@1+8".  Empty = every
+    # injection hook disabled (no per-frame overhead).
+    testing_net_chaos: str = ""
 
     def __post_init__(self):
         for f in fields(self):

@@ -70,3 +70,12 @@ class StaleObjectError(CAError):
 
 class PlacementGroupError(CAError):
     """Placement group could not be created or was removed."""
+
+
+class FencedError(CAError):
+    """An RPC carried a stale node incarnation: the head declared that node
+    dead (partition, crash) and adopted its state, so nothing minted under
+    the old incarnation may act anymore.  A fenced agent/worker must cancel
+    its outstanding leases and zombie tasks, tear down, and rejoin as a
+    fresh incarnation — completing in-flight side effects would duplicate
+    work the head already resubmitted elsewhere."""

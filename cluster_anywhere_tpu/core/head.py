@@ -33,10 +33,22 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import scheduling
+from . import netchaos, scheduling
 from .config import CAConfig
-from .errors import ActorDiedError, ObjectStoreFullError, PlacementGroupError
-from .protocol import Connection, Server, spawn_bg, write_frame
+from .errors import (
+    ActorDiedError,
+    FencedError,
+    ObjectStoreFullError,
+    PlacementGroupError,
+)
+from .protocol import (
+    Connection,
+    Server,
+    fence_close,
+    fence_close_conn,
+    spawn_bg,
+    write_frame,
+)
 
 LOCAL_NODE = "n0"
 
@@ -65,6 +77,11 @@ class NodeRec:
     state: str = "alive"  # alive | draining | drained | dead
     drain_reason: str = ""  # preemption | idle | manual (while draining/drained)
     drain_deadline: float = 0.0  # monotonic deadline for the evacuation window
+    # fencing token, minted at register and bumped on every rejoin after a
+    # death verdict: authority-bearing RPCs stamped with an older value are
+    # refused with FencedError (partition tolerance — a node the head
+    # declared dead must not keep acting out of its pre-verdict state)
+    incarnation: int = 1
     pid: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     idle: Dict[str, deque] = field(default_factory=lambda: {"cpu": deque(), "tpu": deque()})
@@ -247,6 +264,18 @@ class Head:
             from .accelerators import ChipAllocator
 
             self._chip_alloc = ChipAllocator(n_chips)
+        # highest incarnation ever minted per node id (snapshot-persisted):
+        # a rejoining node always gets a strictly larger token than any
+        # verdict it may have zombied through
+        self._node_incarnations: Dict[str, int] = {LOCAL_NODE: 1}
+        # network-chaos plane: the spec last broadcast via `net_chaos` (new
+        # registrants receive it in their register reply).  The epoch
+        # travels WITH it everywhere: a spec re-anchored at each receiver's
+        # install time would re-open already-healed windows (observed: a
+        # healed agent re-partitioning itself out of its register reply).
+        self._net_chaos_spec = ""
+        self._net_chaos_epoch: Optional[float] = None
+        netchaos.maybe_install_from_config(config, LOCAL_NODE)
         # -- tables --
         self.workers: Dict[str, WorkerRec] = {}
         self.actors: Dict[str, ActorRec] = {}
@@ -427,12 +456,22 @@ class Head:
         self._ckpt_path = os.path.join(session_dir, "head.ckpt")
         self._dirty = False
         self._restored = False
-        if os.path.exists(self._ckpt_path):
+        # torn-snapshot tolerance: head.ckpt is written via tmp+rename and
+        # rotated to .bak first, so a corrupt/missing primary (kill -9 inside
+        # _save_snapshot, disk fault) falls back to the previous good one
+        for path in (self._ckpt_path, self._ckpt_path + ".bak"):
+            if not os.path.exists(path):
+                continue
             try:
-                self._load_snapshot()
+                self._load_snapshot(path)
                 self._restored = True
+                if path != self._ckpt_path:
+                    self._log_event("snapshot_fallback_bak", path=path)
+                break
             except Exception as e:
-                self._log_event("snapshot_load_failed", error=repr(e))
+                self._log_event(
+                    "snapshot_load_failed", path=path, error=repr(e)
+                )
         # pull-side file maps for serving n0's object chunks
         self._pull_maps: Dict[str, Any] = {}
 
@@ -487,6 +526,7 @@ class Head:
                     "node_id": n.node_id, "addr": n.addr, "total": n.total,
                     "avail": n.avail, "index": n.index, "state": n.state,
                     "pid": n.pid, "labels": n.labels,
+                    "incarnation": n.incarnation,
                     "drain_reason": n.drain_reason,
                     # monotonic deadlines don't survive a restart: persist
                     # the remaining window and re-anchor it at load
@@ -549,6 +589,7 @@ class Head:
                 for p in self.pgs.values()
             ],
             "pending_pgs": list(self.pending_pgs),
+            "node_incarnations": self._node_incarnations,
             "objects": [
                 {
                     "oid": r.oid, "shm_name": r.shm_name, "size": r.size,
@@ -576,12 +617,20 @@ class Head:
         tmp = self._ckpt_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
+        # keep the previous snapshot as .bak before the atomic swap: a head
+        # killed mid-save leaves at worst a torn .tmp (ignored) — and even a
+        # torn/corrupted head.ckpt (operator error, disk fault) still
+        # restarts from the last good state instead of empty tables
+        try:
+            os.replace(self._ckpt_path, self._ckpt_path + ".bak")
+        except FileNotFoundError:
+            pass
         os.replace(tmp, self._ckpt_path)
 
-    def _load_snapshot(self):
+    def _load_snapshot(self, path: Optional[str] = None):
         import msgpack
 
-        with open(self._ckpt_path, "rb") as f:
+        with open(path or self._ckpt_path, "rb") as f:
             state = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
         now = time.monotonic()
         for cid in state.get("departed_clients") or []:
@@ -592,6 +641,7 @@ class Head:
                 n["node_id"], n["addr"], n["total"], n["avail"],
                 index=n["index"], state=n["state"], pid=n["pid"],
                 labels=n.get("labels") or {},
+                incarnation=int(n.get("incarnation") or 1),
             )
             rec.drain_reason = n.get("drain_reason") or ""
             if rec.state == "draining":
@@ -629,6 +679,10 @@ class Head:
                 bundles=[BundleRec(**b) for b in p["bundles"]],
             )
         self.pending_pgs = deque(state["pending_pgs"])
+        for nid, inc in (state.get("node_incarnations") or {}).items():
+            self._node_incarnations[nid] = max(
+                int(inc), self._node_incarnations.get(nid, 0)
+            )
         for r in state["objects"]:
             rec = ObjectRec(
                 oid=r["oid"], shm_name=r["shm_name"], size=r["size"],
@@ -785,7 +839,10 @@ class Head:
         if conn is None or conn.closed:
             from ..util.aio import dial  # lazy: util/__init__ reaches into core
 
-            conn = await dial(rec.addr, purpose=f"worker {rec.worker_id}")
+            conn = await dial(
+                rec.addr, purpose=f"worker {rec.worker_id}",
+                peer_node=rec.node_id,
+            )
             self._worker_conns[rec.worker_id] = conn
         return conn
 
@@ -1072,7 +1129,13 @@ class Head:
                     grant.append({"wid": wid, "addr": rec.addr})
                 if grant:
                     try:
-                        node.conn.notify("lease_block", pool=pool, workers=grant)
+                        # the block carries the node's incarnation: an agent
+                        # whose token disagrees discards the delegation (it
+                        # is mid-fence and must not grant from stale blocks)
+                        node.conn.notify(
+                            "lease_block", pool=pool, workers=grant,
+                            ninc=node.incarnation,
+                        )
                         self.stats["lease_blocks_delegated"] += len(grant)
                         self._dirty = True
                     except Exception:
@@ -1181,6 +1244,25 @@ class Head:
             is not None
         )
 
+    def _fits_eventually(self, a: ActorRec) -> bool:
+        """Could the actor place once currently-leased capacity returns?
+        True when its shape fits some schedulable node's TOTAL resources —
+        gates the bounded reclaim-and-wait above for busy-but-placeable
+        actors; infeasible shapes keep their immediate failure."""
+        views = [
+            scheduling.NodeView(
+                n.node_id, n.total, dict(n.total), n.index, labels=n.labels
+            )
+            for n in self._alive_nodes()
+        ]
+        return (
+            scheduling.pick_node(
+                views, a.resources, a.strategy,
+                self.config.scheduler_spread_threshold,
+            )
+            is not None
+        )
+
     def _reconcile_lease_blocks(self, node: NodeRec, blocks: Dict[str, dict]):
         """Adopt the agent's authoritative view of its delegated blocks (sent
         with every agent (re)registration).  After a head kill -9 + restart
@@ -1257,10 +1339,17 @@ class Head:
                 self._node_views(), a.resources, a.strategy,
                 self.config.scheduler_spread_threshold,
             )
-            if view is None and self._placeable_with_delegated(a):
-                # the capacity exists but is parked in agents' lease blocks:
-                # reclaim (the head is the arbiter) and wait for the slots
-                # to come back instead of failing a valid actor
+            if view is None and (
+                self._placeable_with_delegated(a) or self._fits_eventually(a)
+            ):
+                # the capacity exists but is parked in agents' lease blocks
+                # or held by running task leases: reclaim (the head is the
+                # arbiter) / wait for leases to idle-return instead of
+                # failing a valid actor.  Restart/migration placements hit
+                # this constantly — a drain evacuating an actor onto a
+                # survivor whose CPUs are briefly all leased must wait out
+                # the tasks, not die "resources unavailable".  Genuinely
+                # infeasible shapes (fit no node's TOTAL) still fail fast.
                 deadline = time.monotonic() + 10.0
                 while view is None and time.monotonic() < deadline:
                     # re-stamped EVERY round: a lease_block_return landing
@@ -1285,13 +1374,19 @@ class Head:
             self._pub("actors", self._actor_info(a))
             return
         a.node_id = node.node_id
+        # incarnation guard: if this placement's worker dies mid-start (node
+        # death, partition verdict), _on_worker_death fires a NEW restart at
+        # a bumped incarnation — this superseded coroutine must then return
+        # silently instead of stomping the actor dead over the fresh attempt
+        placing_inc = a.incarnation
         rec = self._spawn_worker_on(node, purpose="actor", pool=self._pool_key(a.resources))
         rec.actor_id = a.actor_id
         a.worker_id = rec.worker_id
         if not await self._wait_registered(rec):
-            a.state = "dead"
-            a.death_cause = "actor worker failed to start"
-            self._pub("actors", self._actor_info(a))
+            if a.incarnation == placing_inc:
+                a.state = "dead"
+                a.death_cause = "actor worker failed to start"
+                self._pub("actors", self._actor_info(a))
             return
         a.addr = rec.addr
         try:
@@ -1306,6 +1401,10 @@ class Head:
                 incarnation=a.incarnation,
                 runtime_env=a.runtime_env,
             )
+            if a.incarnation != placing_inc:
+                # superseded while spawning: the newer incarnation owns the
+                # record now; this worker will be reaped as an orphan
+                return
             a.state = "alive"
             self.stats["actors_created"] += 1
             self._log_event(
@@ -1314,6 +1413,8 @@ class Head:
         except asyncio.CancelledError:
             raise  # head shutdown mid-create: not an actor death
         except Exception as e:
+            if a.incarnation != placing_inc:
+                return
             a.state = "dead"
             a.death_cause = f"actor __init__ failed: {e!r}"
         self._pub("actors", self._actor_info(a))
@@ -1346,15 +1447,15 @@ class Head:
             fut.set_result(False)
         conn = self._worker_conns.pop(rec.worker_id, None)
         if conn is not None:
-            await conn.close()
+            fence_close_conn(conn)
         # fence the worker: close its registration connection so a live-but-
-        # declared-dead process exits instead of acting on stale leases
+        # declared-dead process exits instead of acting on stale leases.
+        # Under an active blackhole both closes defer until the link heals —
+        # a partition delivers no FIN; the zombie instead learns its verdict
+        # at heal (refused re-register / FencedError on its stamped RPCs).
         client_state = self._clients.get(rec.worker_id)
         if client_state is not None:
-            try:
-                client_state["writer"].close()
-            except Exception:
-                pass
+            fence_close(client_state["writer"])
         node = self.nodes.get(rec.node_id)
         if node is not None:
             try:
@@ -1417,8 +1518,19 @@ class Head:
                     self.stats["actor_restarts"] += 1
                     self._log_event("actor_restarting", actor_id=a.actor_id, attempt=a.restarts_used)
                     self._pub("actors", self._actor_info(a))
-                    await asyncio.sleep(self.config.actor_restart_backoff_s)
-                    await self._place_actor(a)
+
+                    async def _restart(a=a):
+                        await asyncio.sleep(self.config.actor_restart_backoff_s)
+                        await self._place_actor(a)
+
+                    # BACKGROUND, never awaited here: _on_worker_death runs
+                    # on the monitor loop, and a restart placement can block
+                    # up to worker_register_timeout_s against a node that is
+                    # silently partitioned — wedging the very failure
+                    # detector that would declare that node dead.  (Observed:
+                    # an actor restart aimed at a blackholed node froze node
+                    # death detection for 30s.)
+                    spawn_bg(_restart())
                 else:
                     a.state = "dead"
                     a.death_cause = a.death_cause or "actor worker died"
@@ -1436,7 +1548,10 @@ class Head:
         from ..util.aio import dial  # lazy: util/__init__ reaches into core
 
         try:
-            node.conn = await dial(node.addr, purpose=f"agent {node.node_id}")
+            node.conn = await dial(
+                node.addr, purpose=f"agent {node.node_id}",
+                peer_node=node.node_id,
+            )
         except asyncio.CancelledError:
             raise  # head shutdown: must not declare the node dead
         except Exception as e:
@@ -1455,20 +1570,21 @@ class Head:
         self.stats["nodes_died"] += 1
         self._log_event("node_died", node_id=node.node_id)
         if node.conn is not None:
-            await node.conn.close()
+            fence_close_conn(node.conn)
             node.conn = None
         node.lease_used = {}  # stale agent-reported occupancy
         for key in [k for k in self._pending_block_adopt if k[0] == node.node_id]:
             del self._pending_block_adopt[key]
         # fence the agent: close its registration connection so an agent
         # declared dead by heartbeat timeout tears itself down (kills its
-        # workers, sweeps its shm namespace) instead of zombieing on
+        # workers, sweeps its shm namespace) instead of zombieing on.
+        # Deferred while a blackhole covers the link (no FIN through a
+        # partition): the healed agent discovers the verdict via FencedError
+        # on its next stamped RPC or refused re-register, then purges and
+        # rejoins at a fresh incarnation.
         agent_state = self._clients.get(node.node_id)
         if agent_state is not None:
-            try:
-                agent_state["writer"].close()
-            except Exception:
-                pass
+            fence_close(agent_state["writer"])
         # workers on the node are dead (their lease/actor cleanup runs through
         # the normal worker-death path; node.avail credits are skipped because
         # the node is already marked dead)
@@ -1515,6 +1631,40 @@ class Head:
     # before the kill — whose retries clients exempt from max_retries.
 
     DRAIN_REASONS = ("preemption", "idle", "manual")
+
+    # ------------------------------------------------------ net-chaos plane
+    async def _h_net_chaos(self, state, msg, reply, reply_err):
+        """Install (or clear, spec="") a network-chaos schedule cluster-wide:
+        the head applies it locally and broadcasts it to every connected
+        client (workers, drivers, agents — agents' registration conns are
+        clients too), so all processes drop/delay the same links from the
+        same seeded schedule.  Scheduled windows (blackhole@S+D, flap) are
+        the way to inject a PARTITION: the heal must come from the schedule,
+        because a `clear` broadcast cannot reach a process it partitioned.
+        Status-only callers omit `spec`."""
+        if "spec" in msg:
+            spec = msg.get("spec") or ""
+            # one shared anchor for every process's window offsets: default
+            # it HERE so late joiners and rebroadcasts agree with the
+            # original installation instead of re-opening healed windows
+            epoch = msg.get("epoch")
+            if epoch is None:
+                epoch = time.time()
+            try:
+                netchaos.install(spec, LOCAL_NODE, epoch=epoch)
+            except (ValueError, TypeError) as e:
+                reply_err(e)
+                return
+            self._net_chaos_spec = spec
+            self._net_chaos_epoch = epoch if spec else None
+            self._log_event("net_chaos", spec=spec)
+            frame = {"m": "net_chaos", "spec": spec, "epoch": epoch}
+            for st in list(self._clients.values()):
+                try:
+                    write_frame(st["writer"], frame)
+                except Exception:
+                    pass
+        reply(spec=self._net_chaos_spec, status=netchaos.status())
 
     async def _h_drain_node(self, state, msg, reply, reply_err):
         nid = msg.get("node_id")
@@ -1627,10 +1777,15 @@ class Head:
                 pass
 
     async def _drain_evacuate(self, node: NodeRec):
-        """Background evacuation pass: migrate live actors off the node
-        through the restart FSM, then re-home sole-copy primary objects.
+        """Background evacuation pass: re-home sole-copy primary objects
+        FIRST, then migrate live actors off the node through the restart
+        FSM.  Objects go first because they are bounded data moves, while
+        an actor migration may legitimately WAIT for capacity (survivors'
+        CPUs briefly all leased to evacuating tasks) — object safety must
+        not sit behind that wait and lose the race with the deadline.
         Finishing arms the quiesce check in the monitor loop."""
         try:
+            await self._evacuate_objects(node)
             for a in list(self.actors.values()):
                 if node.state != "draining":
                     return
@@ -1641,7 +1796,6 @@ class Head:
                         # applies if the supervisor doesn't finish in time
                         continue
                     await self._migrate_actor(a, node)
-            await self._evacuate_objects(node)
         except asyncio.CancelledError:
             raise  # the finally still arms/skips the quiesce check
         except Exception as e:
@@ -2016,12 +2170,52 @@ class Head:
             tk = self._self_tags_keys[m] = json.dumps([["method", m]])
         return tk
 
+    def _fence_refuse(self, state, msg, reply_err, nid, inc) -> None:
+        """Refuse an RPC minted under a dead/superseded node incarnation.
+
+        Requests get a FencedError reply; notifies (no "i") are dropped.
+        Either way the sender is told via a `fenced` push frame, so a zombie
+        that only ever notifies (heartbeats, ledger syncs) still learns its
+        death verdict at heal time and can cancel its leases/tasks instead
+        of completing duplicate side effects."""
+        self.stats["fenced_rpcs"] = self.stats.get("fenced_rpcs", 0) + 1
+        self._log_event(
+            "rpc_fenced", method=msg.get("m"), node_id=nid, inc=inc,
+            client_id=state.get("client_id"),
+        )
+        try:
+            write_frame(state["writer"], {"m": "fenced", "node_id": nid, "ninc": inc})
+        except Exception:
+            pass
+        if msg.get("i") is not None:
+            node = self.nodes.get(nid)
+            reply_err(FencedError(
+                f"node {nid!r} incarnation {inc} was declared dead and its "
+                f"state adopted (current: "
+                f"{node.incarnation if node else 'unregistered'}); cancel "
+                f"outstanding leases/tasks, tear down, and rejoin fresh"
+            ))
+
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
         h = getattr(self, "_h_" + m, None)
         if h is None:
             reply_err(ValueError(f"unknown head method {m}"))
             return
+        # incarnation fence: authority-bearing RPCs from workers/agents are
+        # stamped with their node's incarnation (Connection.stamp / agent
+        # fields); a stamp that no longer matches the node table means the
+        # head declared that node dead and adopted its state — refuse before
+        # dispatch so no stale-authority side effect (grant use, ledger
+        # write, object/task report, KV commit) can land.  register is
+        # exempt: its own dead-worker/stale-agent logic issues the verdict.
+        inc = msg.get("ninc")
+        if inc is not None and m != "register":
+            nid = msg.get("node_id") or state.get("node_id")
+            node = self.nodes.get(nid) if nid else None
+            if node is None or node.state == "dead" or node.incarnation != inc:
+                self._fence_refuse(state, msg, reply_err, nid, inc)
+                return
         self.rpc_counts[m] += 1
         if m not in self._READONLY_METHODS:
             self._dirty = True  # persisted by the debounced snapshot loop
@@ -2054,6 +2248,9 @@ class Head:
             await self._register_agent(state, msg, reply, reply_err)
             return
         state["node_id"] = msg.get("node_id", LOCAL_NODE)
+        # network-chaos labeling: this registration socket's peer lives on
+        # that node — replies/pushes toward a partitioned node must drop
+        netchaos.label_writer(state["writer"], state["node_id"])
         # remote (Ray-Client-analogue) drivers: they reach workers over TCP
         # only, and their node is a client-private namespace no one schedules
         # onto — worker/actor addresses handed to them must be the TCP duals
@@ -2069,6 +2266,13 @@ class Head:
             # `subscribe` RPC had no caller, so these pubs fanned out to
             # nobody and every driver paid a get_actor refresh per restart
             self.subscribers.setdefault("actors", []).append(state["writer"])
+        if role in ("driver", "worker"):
+            # node-death pubs: a PARTITIONED node's sockets never close by
+            # themselves (frames just vanish), so every SUBMITTER — drivers
+            # AND worker processes running nested tasks — needs the death
+            # verdict pushed to fail its in-flight pushes over to survivors
+            # (worker._on_node_dead_pub)
+            self.subscribers.setdefault("nodes", []).append(state["writer"])
         self._departed_clients.pop(client_id, None)  # it's back: not dead
         if msg.get("addr") or msg.get("addr_tcp"):
             self.client_addrs[client_id] = {
@@ -2123,12 +2327,27 @@ class Head:
             fut = self._register_waiters.pop(client_id, None)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+            netchaos.register_addr(msg.get("addr"), rec.node_id)
+            netchaos.register_addr(msg.get("addr_tcp"), rec.node_id)
             self._service_queue()
+        extra = {}
+        reg_node = self.nodes.get(state["node_id"])
+        if reg_node is not None:
+            # the client's node incarnation: workers stamp it onto every
+            # authority-bearing RPC (Connection.stamp) so stale-incarnation
+            # survivors of a partition are fenced, not believed
+            extra["node_inc"] = reg_node.incarnation
+        if self._net_chaos_spec:
+            # a runtime-installed chaos schedule covers late joiners too —
+            # with its ORIGINAL epoch, or healed windows would re-open
+            extra["net_chaos"] = self._net_chaos_spec
+            extra["net_chaos_epoch"] = self._net_chaos_epoch
         reply(
             node_id=state["node_id"],
             session=self.session_name,
             resources=self._agg_total(),
             head_tcp=self.tcp_addr,
+            **extra,
         )
         # late joiners learn about in-progress drains (their retries on those
         # nodes must be budget-exempt too)
@@ -2141,7 +2360,30 @@ class Head:
 
     async def _register_agent(self, state, msg, reply, reply_err):
         node_id = msg["client_id"]
+        netchaos.label_writer(state["writer"], node_id)
         existing = self.nodes.get(node_id)
+        reported_inc = msg.get("ninc")
+        if (
+            existing is not None
+            and existing.state == "dead"
+            and reported_inc is not None
+        ):
+            # a partitioned-then-healed agent re-registering with the token
+            # of an incarnation this head already declared dead: deliver the
+            # verdict.  The agent reacts by killing its (zombie) workers,
+            # dropping every delegated block and local grant, sweeping its
+            # shm namespace, and re-registering WITHOUT a token — which the
+            # fresh-join path below accepts at a bumped incarnation.
+            self.stats["fenced_rpcs"] = self.stats.get("fenced_rpcs", 0) + 1
+            self._log_event(
+                "agent_register_fenced", node_id=node_id, inc=reported_inc
+            )
+            reply_err(FencedError(
+                f"node {node_id!r} incarnation {reported_inc} was declared "
+                f"dead; purge local state (workers, lease blocks, shm) and "
+                f"rejoin fresh"
+            ))
+            return
         if existing is not None and existing.up:
             if existing.conn is None or existing.conn.closed:
                 # agent reconnecting to a restarted head: re-adopt in place
@@ -2159,11 +2401,22 @@ class Head:
                 # local grants kept flowing while the head was down; adopt
                 # the agent's authoritative block state before scheduling
                 self._reconcile_lease_blocks(existing, msg.get("lease_blocks") or {})
-                reply(node_id=node_id, session=self.session_name, head_tcp=self.tcp_addr)
+                reply(
+                    node_id=node_id, session=self.session_name,
+                    head_tcp=self.tcp_addr, incarnation=existing.incarnation,
+                )
                 self._service_queue()
                 return
             reply_err(ValueError(f"node id {node_id!r} already registered"))
             return
+        # fresh join (first registration, or a purged rejoin over a dead
+        # record): mint a strictly increasing incarnation — larger than any
+        # token this node id ever held, even across snapshotless restarts
+        # (the agent reports its last token for exactly that reason)
+        inc = max(
+            self._node_incarnations.get(node_id, 0), int(reported_inc or 0)
+        ) + 1
+        self._node_incarnations[node_id] = inc
         node = self._add_node(
             NodeRec(
                 node_id,
@@ -2171,6 +2424,7 @@ class Head:
                 dict(msg.get("resources") or {}),
                 dict(msg.get("resources") or {}),
                 pid=msg.get("pid", 0),
+                incarnation=inc,
                 # the agent detects its own labels (its env, not the head's)
                 labels={
                     **{str(k): str(v) for k, v in (msg.get("labels") or {}).items()},
@@ -2180,8 +2434,12 @@ class Head:
         )
         state["node_id"] = node_id
         node.metrics_addr = msg.get("metrics_addr") or None
+        netchaos.register_addr(msg["addr"], node_id)
         self.stats["nodes_joined"] += 1
-        self._log_event("node_joined", node_id=node_id, resources=node.total)
+        self._log_event(
+            "node_joined", node_id=node_id, resources=node.total,
+            incarnation=inc,
+        )
         await self._connect_agent(node)
         if node.state != "alive":
             # dial-back failed (unreachable advertised address): the join is
@@ -2193,7 +2451,14 @@ class Head:
             # only record of the delegation
             self._reconcile_lease_blocks(node, msg["lease_blocks"])
         self._pub("nodes", {"node_id": node_id, "alive": True, "resources": node.total})
-        reply(node_id=node_id, session=self.session_name, head_tcp=self.tcp_addr)
+        extra = {}
+        if self._net_chaos_spec:
+            extra["net_chaos"] = self._net_chaos_spec
+            extra["net_chaos_epoch"] = self._net_chaos_epoch
+        reply(
+            node_id=node_id, session=self.session_name,
+            head_tcp=self.tcp_addr, incarnation=inc, **extra,
+        )
         self._service_queue()
 
     async def _h_node_heartbeat(self, state, msg, reply, reply_err):
@@ -3461,6 +3726,9 @@ class Head:
                     "node_id": n.node_id,
                     "alive": n.up,  # draining nodes are up (but unschedulable)
                     "state": n.state,
+                    # fencing token: bumps every time this node id rejoins
+                    # after a death verdict (partition heals prove freshness)
+                    "incarnation": n.incarnation,
                     "drain": (
                         {
                             "reason": n.drain_reason,
@@ -3797,6 +4065,14 @@ class Head:
     async def _on_disconnect(self, state):
         cid = state.get("client_id")
         if cid is None:
+            return
+        cur = self._clients.get(cid)
+        if cur is not None and cur is not state:
+            # a NEWER registration under the same id superseded this
+            # connection (e.g. a fenced agent's deferred transport close
+            # firing after its fresh-incarnation rejoin): tearing down the
+            # live registrant over a stale socket would re-kill the node
+            # that just healed
             return
         self._clients.pop(cid, None)
         self.client_addrs.pop(cid, None)  # p2p dials now fall back to head

@@ -28,13 +28,16 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
 import signal
 import subprocess
 import sys
 import time
 from typing import Any, Dict, Optional
 
+from . import netchaos
 from .config import CAConfig, set_config
+from .errors import FencedError
 from .head import read_shm_chunk
 from .ownership import DeltaReporter, quantize_load
 from .protocol import Server, spawn_bg
@@ -232,6 +235,16 @@ class NodeAgent:
         self._pull_maps: Dict[str, Any] = {}
         self._shutdown = asyncio.Event()
         self._draining = False  # SIGTERM self-drain already requested
+        # fencing token minted by the head at registration; stamped onto
+        # every authority-bearing notify (node_sync, worker_exit, block
+        # returns) so a partitioned-then-healed agent is refused instead of
+        # believed.  None = not yet registered / purged for a fresh rejoin.
+        self.incarnation: Optional[int] = None
+        self._fencing = False  # single-flight guard for _fence_reset
+        # network-chaos plane: partition/straggler injection from the spec
+        # this process was started with (runtime `ca chaos set` broadcasts
+        # arrive as net_chaos pushes)
+        netchaos.maybe_install_from_config(self.config, self.node_id)
         # delta-synced node state (ray_syncer role, head-ward): components
         # re-send only when their payload changes; an idle node's tick
         # degenerates to a bare node_sync keepalive.  reset() on every
@@ -307,15 +320,22 @@ class NodeAgent:
             if g is None:
                 reply(granted=False)
             else:
-                reply(granted=True, **g)
+                # grants carry the node incarnation: a post-heal audit can
+                # prove no outstanding grant was minted pre-verdict
+                reply(granted=True, ninc=self.incarnation, **g)
         elif m == "lease_release":
             for lid in msg.get("lease_ids") or ():
                 self.granter.release(lid)
             reply()
         elif m == "lease_block":
-            # head delegation push: absorb the block's workers
-            self.granter.add_workers(msg.get("pool", "cpu"), msg.get("workers"))
-            reply()
+            # head delegation push: absorb the block's workers — unless the
+            # delegation names a different incarnation (this agent is
+            # mid-fence: granting from a stale block would mint zombies)
+            if msg.get("ninc") is not None and msg["ninc"] != self.incarnation:
+                reply(rejected=True)
+            else:
+                self.granter.add_workers(msg.get("pool", "cpu"), msg.get("workers"))
+                reply()
         elif m == "lease_block_revoke":
             # head wants capacity back (pending central work / fairness):
             # return unleased workers; outstanding grants keep theirs
@@ -325,7 +345,9 @@ class NodeAgent:
                 try:
                     self.head.notify(
                         "lease_block_return",
-                        node_id=self.node_id, pool=pool, wids=wids,
+                        **self._auth(
+                            {"node_id": self.node_id, "pool": pool, "wids": wids}
+                        ),
                     )
                 except Exception:
                     pass  # head gone: re-register reconciles the block
@@ -440,6 +462,23 @@ class NodeAgent:
             )
         elif m == "node_shutdown":
             self._shutdown.set()
+        elif m == "net_chaos":
+            # runtime chaos broadcast from the head (`ca chaos set`)
+            try:
+                netchaos.install(
+                    msg.get("spec") or "", self.node_id,
+                    epoch=msg.get("epoch"),
+                )
+            except (ValueError, TypeError):
+                pass  # malformed spec was already rejected head-side
+            reply()
+        elif m == "fenced":
+            # the head refused one of our stamped RPCs: this incarnation
+            # (echoed in the push) was declared dead — purge and rejoin
+            # fresh (zombie-free heal)
+            if msg.get("ninc") is None or msg.get("ninc") == self.incarnation:
+                spawn_bg(self._fence_reset())
+            reply()
         # operator liveness probe: ca-lint: ignore[rpc-dead-handler]
         elif m == "ping":
             reply(node_id=self.node_id, n_workers=len(self.procs))
@@ -567,6 +606,14 @@ class NodeAgent:
         os.replace(path + ".tmp", path)
 
     # ------------------------------------------------------------ lifecycle
+    def _auth(self, fields: Dict[str, Any]) -> Dict[str, Any]:
+        """Stamp an authority-bearing head notify with this node's
+        incarnation (fencing: a stale stamp is refused, and the refusal is
+        how a healed zombie learns its death verdict)."""
+        if self.incarnation is not None:
+            fields["ninc"] = self.incarnation
+        return fields
+
     async def _heartbeat_loop(self):
         period = self.config.health_check_period_s / 2
         while not self._shutdown.is_set():
@@ -589,7 +636,7 @@ class NodeAgent:
                     if pending:
                         hb["metrics"] = pending
                     try:
-                        self.head.notify("node_heartbeat", **hb)
+                        self.head.notify("node_heartbeat", **self._auth(hb))
                     except Exception:
                         if pending:
                             self._restage_pending_metrics(pending)
@@ -607,7 +654,9 @@ class NodeAgent:
                     if self.chip_alloc is not None:
                         self.chip_alloc.release(self._worker_chips.pop(wid, None))
                     try:
-                        self.head.notify("worker_exit", wid=wid)
+                        self.head.notify(
+                            "worker_exit", **self._auth({"wid": wid})
+                        )
                     except Exception:
                         pass
 
@@ -658,7 +707,7 @@ class NodeAgent:
             else:
                 comps["mem_pressured"] = False
         d = self.reporter.delta(comps)
-        extra: Dict[str, Any] = {}
+        extra: Dict[str, Any] = self._auth({})
         pending = self._take_pending_metrics() if self._metrics_pending else []
         if pending:
             extra["metrics"] = pending
@@ -713,9 +762,10 @@ class NodeAgent:
             await self._start_metrics_http()
         from ..util.aio import dial  # lazy: util/__init__ reaches into core
 
-        self.head = await dial(self.head_addr, purpose="head")
+        netchaos.register_addr(self.head_addr, "n0")
+        self.head = await dial(self.head_addr, purpose="head", peer_node="n0")
         self.head.set_push_handler(self._on_head_push)
-        await self.head.call(
+        reply = await self.head.call(
             "register",
             role="agent",
             client_id=self.node_id,
@@ -726,6 +776,7 @@ class NodeAgent:
             lease_blocks=self.granter.block_snapshot(),
             metrics_addr=self.metrics_addr,
         )
+        self._adopt_register_reply(reply)
         # readiness marker for the cluster fixture
         ready = os.path.join(self.node_dir, "agent.ready")
         with open(ready + ".tmp", "w") as f:
@@ -749,6 +800,77 @@ class NodeAgent:
         head_watch.cancel()
         log_ship.cancel()
         self._teardown()
+
+    def _adopt_register_reply(self, reply: dict) -> None:
+        """Take the head-minted incarnation (the authority token every
+        stamped RPC carries) and any active runtime chaos schedule."""
+        if reply.get("incarnation") is not None:
+            self.incarnation = reply["incarnation"]
+        if reply.get("net_chaos"):
+            try:
+                netchaos.install(
+                    reply["net_chaos"], self.node_id,
+                    epoch=reply.get("net_chaos_epoch"),
+                )
+            except (ValueError, TypeError):
+                pass
+
+    async def _fence_reset(self):
+        """Zombie-free heal: this incarnation was declared dead while we
+        were partitioned.  Everything minted under it must die BEFORE the
+        node rejoins — workers (their tasks would complete duplicate side
+        effects), delegated lease blocks and local grants (granting from
+        them mints more zombies), the shm namespace (the head already
+        declared those object copies lost), and the delta-sync state.  Then
+        drop the incarnation token and force a re-register, which the head
+        accepts as a FRESH node at a bumped incarnation."""
+        if self._fencing:
+            return
+        self._fencing = True
+        try:
+            from .ownership import warn_ratelimited
+
+            warn_ratelimited(
+                "agent-fenced",
+                f"node {self.node_id} incarnation {self.incarnation} was "
+                f"declared dead (partition?): purging workers/leases/shm "
+                f"and rejoining fresh",
+            )
+            for wid in list(self.procs):
+                self._kill_worker(wid)
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while self.procs and asyncio.get_running_loop().time() < deadline:
+                for wid, proc in list(self.procs.items()):
+                    if proc.poll() is not None:
+                        del self.procs[wid]
+                        if self.chip_alloc is not None:
+                            self.chip_alloc.release(
+                                self._worker_chips.pop(wid, None)
+                            )
+                if self.procs:
+                    await asyncio.sleep(0.05)
+            # every local grant and delegated block dies with the verdict
+            self.granter = LeaseGranter(self.node_id)
+            self._worker_chips.clear()
+            # the node's object copies were declared lost: sweep the
+            # namespace so nothing serves stale reads out of it
+            import shutil
+
+            for name, mm in list(self._pull_maps.items()):
+                try:
+                    mm.close()
+                except (BufferError, ValueError, OSError):
+                    pass
+                self._pull_maps.pop(name, None)
+            shutil.rmtree(self.shm_ns_dir, ignore_errors=True)
+            os.makedirs(self.shm_ns_dir, exist_ok=True)
+            self.reporter.reset()
+            self.incarnation = None  # rejoin as a fresh incarnation
+            if self.head is not None and not self.head.closed:
+                # drop the stale-stamped connection; _watch_head re-registers
+                await self.head.close()
+        finally:
+            self._fencing = False
 
     async def _self_drain(self):
         """SIGTERM landed (preemption warning / graceful stop request): ask
@@ -797,9 +919,23 @@ class NodeAgent:
             try:
                 from ..util.aio import dial  # lazy: util/__init__ → core
 
-                conn = await dial(self.head_addr, purpose="head", timeout=5)
+                conn = await dial(
+                    self.head_addr, purpose="head", timeout=5, peer_node="n0"
+                )
                 conn.set_push_handler(self._on_head_push)
-                await conn.call(
+                fields = {
+                    # local grants kept flowing while the head was down; the
+                    # block snapshot lets the restarted head re-adopt the
+                    # delegation (and reconcile grants made in the outage)
+                    "lease_blocks": self.granter.block_snapshot(),
+                    "metrics_addr": self.metrics_addr,
+                }
+                if self.incarnation is not None:
+                    # our token travels with the re-register: a head that
+                    # declared this incarnation dead refuses with
+                    # FencedError instead of silently re-adopting a zombie
+                    fields["ninc"] = self.incarnation
+                reg_reply = await conn.call(
                     "register",
                     role="agent",
                     client_id=self.node_id,
@@ -807,30 +943,37 @@ class NodeAgent:
                     resources=self.resources,
                     labels=self.labels,
                     pid=os.getpid(),
-                    # local grants kept flowing while the head was down; the
-                    # block snapshot lets the restarted head re-adopt the
-                    # delegation (and reconcile grants made in the outage)
-                    lease_blocks=self.granter.block_snapshot(),
-                    metrics_addr=self.metrics_addr,
                     timeout=5,
+                    **fields,
                 )
                 # the restarted head has no delta state for this node: the
                 # next node_sync must be a full resync.  Reset BEFORE
                 # adopting the connection so a failure here still closes
                 # `conn` below instead of stranding a half-registered head.
                 self.reporter.reset()
+                self._adopt_register_reply(reg_reply)
                 self.head = conn
                 down_since = None
             except asyncio.CancelledError:
                 if conn is not None:
                     await conn.close()
                 raise  # agent shutdown beats head-watching
+            except FencedError:
+                # death verdict discovered at re-register (partition healed):
+                # purge everything minted under the dead incarnation, then
+                # let the next loop iteration rejoin fresh
+                if conn is not None:
+                    await conn.close()
+                await self._fence_reset()
+                down_since = asyncio.get_running_loop().time()  # fresh grace
             except Exception:
                 if conn is not None:
                     # registering failed: a leaked half-open socket per retry
                     # tick adds up fast while the head flaps
                     await conn.close()
-                await asyncio.sleep(0.5)
+                # jittered: N agents redialing a restarted head must not
+                # arrive as one synchronized thundering herd
+                await asyncio.sleep(0.3 + random.random() * 0.4)
 
     def _teardown(self):
         import shutil

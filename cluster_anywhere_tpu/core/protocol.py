@@ -73,6 +73,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
+from . import netchaos
 from .config import get_config
 
 _LEN = struct.Struct(">I")
@@ -114,20 +115,27 @@ def spawn_bg(coro) -> asyncio.Task:
 
 
 class RpcChaos:
-    """Counts down per-method failure budgets from config.testing_rpc_failure.
+    """Counts down per-method failure budgets from config.testing_rpc_failure
+    and holds per-method latency injections from config.testing_rpc_delay
+    ("method=MS" pairs: every matching send waits MS milliseconds first —
+    the straggler-RPC knob, where the failure knob models clean errors).
 
-    Method names are validated against the generated RPC contract
-    (docs/PROTOCOL_CONTRACT.json, `ca lint --contract`) at parse time: a
-    typo'd method in a chaos spec used to simply never fire — the test
-    "passed" while injecting nothing.  Unknown names now raise immediately.
+    Method names in BOTH specs are validated against the generated RPC
+    contract (docs/PROTOCOL_CONTRACT.json, `ca lint --contract`) at parse
+    time: a typo'd method in a chaos spec used to simply never fire — the
+    test "passed" while injecting nothing.  Unknown names raise immediately.
     """
 
-    def __init__(self, spec: str):
+    def __init__(self, spec: str, delay_spec: str = ""):
         self._budget: Dict[str, int] = {}
         for part in filter(None, (spec or "").split(",")):
             method, _, n = part.partition("=")
             self._budget[method.strip()] = int(n or 1)
-        if self._budget:
+        self._delay: Dict[str, float] = {}
+        for part in filter(None, (delay_spec or "").split(",")):
+            method, _, ms = part.partition("=")
+            self._delay[method.strip()] = float(ms or 0.0) / 1000.0
+        if self._budget or self._delay:
             self._validate_methods()
 
     def _validate_methods(self) -> None:
@@ -139,12 +147,12 @@ class RpcChaos:
         known = set(doc.get("methods") or ())
         if not known:
             return
-        unknown = sorted(set(self._budget) - known)
+        unknown = sorted((set(self._budget) | set(self._delay)) - known)
         if unknown:
             raise ValueError(
-                f"CA_TESTING_RPC_FAILURE names unknown RPC method(s) "
-                f"{unknown}: not in the extracted protocol contract "
-                f"({len(known)} methods; regenerate with `ca lint "
+                f"CA_TESTING_RPC_FAILURE/CA_TESTING_RPC_DELAY name unknown "
+                f"RPC method(s) {unknown}: not in the extracted protocol "
+                f"contract ({len(known)} methods; regenerate with `ca lint "
                 f"--contract` if the protocol changed)"
             )
 
@@ -154,6 +162,10 @@ class RpcChaos:
             self._budget[method] = left - 1
             raise ConnectionError(f"[chaos] injected RPC failure for {method}")
 
+    def delay_s(self, method: str) -> float:
+        """Injected pre-send latency for `method` (0.0 = none)."""
+        return self._delay.get(method, 0.0) if self._delay else 0.0
+
 
 _chaos: Optional[RpcChaos] = None
 
@@ -161,13 +173,16 @@ _chaos: Optional[RpcChaos] = None
 def rpc_chaos() -> RpcChaos:
     global _chaos
     if _chaos is None:
-        _chaos = RpcChaos(get_config().testing_rpc_failure)
+        cfg = get_config()
+        _chaos = RpcChaos(
+            cfg.testing_rpc_failure, getattr(cfg, "testing_rpc_delay", "")
+        )
     return _chaos
 
 
-def reset_rpc_chaos(spec: str = ""):
+def reset_rpc_chaos(spec: str = "", delay_spec: str = ""):
     global _chaos
-    _chaos = RpcChaos(spec)
+    _chaos = RpcChaos(spec, delay_spec)
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
@@ -239,12 +254,13 @@ class _Cork:
     task/actor fan-out on few cores).  A lone message goes out as a plain
     frame.  Latency cost is at most one loop callback."""
 
-    __slots__ = ("writer", "bodies", "scheduled")
+    __slots__ = ("writer", "bodies", "scheduled", "_next_due")
 
     def __init__(self, writer: asyncio.StreamWriter):
         self.writer = writer
         self.bodies: list = []  # encoded msgpack map bodies (no length prefix)
         self.scheduled = False
+        self._next_due = 0.0  # delayed-emission FIFO watermark (net chaos)
 
     def write_body(self, body: bytes):
         self.bodies.append(body)
@@ -258,6 +274,19 @@ class _Cork:
             return
         bodies = self.bodies
         self.bodies = []
+        # network-chaos send filter (one module-global check when disabled):
+        # frames to a blackholed/flap-down peer vanish silently — the
+        # connection stays open and callers HANG, which is what a real
+        # partition does; a delayed link defers the transport write instead
+        chaos_delay = 0.0
+        ch = netchaos.NET_CHAOS
+        if ch is not None:
+            dst = netchaos.link_of(self.writer)
+            if dst is not None:
+                if ch.link_down(ch.local, dst):
+                    ch.count("frames_dropped", len(bodies))
+                    return
+                chaos_delay = ch.frame_delay(ch.local, dst)
         out = []
         i = 0
         n = len(bodies)
@@ -282,8 +311,21 @@ class _Cork:
             WIRE_STATS["frames_sent"] += 1
             i = j
         WIRE_STATS["messages_sent"] += n
+        data = b"".join(out)
+        if chaos_delay > 0.0:
+            # straggler link: emit later, FIFO per connection (a jittered
+            # shorter delay never reorders past an earlier longer one)
+            ch.count("frames_delayed")
+            loop = asyncio.get_running_loop()
+            due = max(loop.time() + chaos_delay, self._next_due)
+            self._next_due = due
+            loop.call_at(due, self._emit, data)
+            return
+        self._emit(data)
+
+    def _emit(self, data: bytes):
         try:
-            self.writer.write(b"".join(out))
+            self.writer.write(data)
         except Exception:
             pass  # peer gone; readers/futures surface the error
 
@@ -359,6 +401,50 @@ def flush_writer(writer: asyncio.StreamWriter) -> None:
         cork.flush()
 
 
+def fence_close(writer: asyncio.StreamWriter) -> None:
+    """Close a peer transport as part of a death-fencing decision.
+
+    With no active network chaos this is flush+close.  While a blackhole
+    covers the link the close is DEFERRED until the link heals: a real
+    partition delivers no FIN, so the fenced peer must discover its death
+    verdict at heal time (refused re-register / FencedError on its next
+    authority RPC) instead of being tipped off mid-partition by an EOF that
+    could never have reached it."""
+    ch = netchaos.NET_CHAOS
+    if ch is not None:
+        dst = netchaos.link_of(writer)
+        if dst is not None and ch.link_down(ch.local, dst):
+            ch.count("closes_deferred")
+
+            async def _close_when_healed():
+                deadline = asyncio.get_running_loop().time() + 300.0
+                while asyncio.get_running_loop().time() < deadline:
+                    await asyncio.sleep(0.05)
+                    c = netchaos.NET_CHAOS
+                    if c is None or not c.link_down(c.local, dst):
+                        break
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+            spawn_bg(_close_when_healed())
+            return
+    try:
+        flush_writer(writer)
+        writer.close()
+    except Exception:
+        pass
+
+
+def fence_close_conn(conn: "Connection") -> None:
+    """Connection.close with fence_close transport semantics (no await:
+    fencing paths must not block on a partitioned peer's FIN)."""
+    conn._closed = True
+    conn._reader_task.cancel()
+    fence_close(conn.writer)
+
+
 class Connection:
     """A client connection with request/response correlation.
 
@@ -374,6 +460,11 @@ class Connection:
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
         self._on_push: Optional[Callable[[dict], Awaitable[None]]] = None
+        # authority stamp: fields merged into every outgoing request/notify
+        # (worker processes set {"inc": <node incarnation>} after register,
+        # so the head can fence RPCs minted under a dead incarnation).
+        # Drivers never stamp; the template fast path is driver-only.
+        self.stamp: Optional[dict] = None
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     def set_push_handler(self, fn: Callable[[dict], Awaitable[None]]):
@@ -386,6 +477,15 @@ class Connection:
                 frame = await read_frame(self.reader)
                 if frame is None:
                     break
+                # network-chaos receive filter: frames FROM a partitioned
+                # peer are dropped too, so a chaos-enabled process gets a
+                # symmetric partition even against peers without a spec
+                ch = netchaos.NET_CHAOS
+                if ch is not None:
+                    peer = netchaos.link_of(self.writer)
+                    if peer is not None and ch.link_down(peer, ch.local):
+                        ch.count("recv_dropped")
+                        continue
                 # batch envelopes carry many logical replies/pushes in one
                 # physical frame; expand and dispatch each in arrival order
                 for msg in iter_messages(frame):
@@ -424,11 +524,17 @@ class Connection:
             self._pending.clear()
 
     async def call(self, _method: str, timeout: Optional[float] = None, **fields) -> dict:
-        rpc_chaos().maybe_fail(_method)
+        chaos = rpc_chaos()
+        chaos.maybe_fail(_method)
         if self._closed:
             raise ConnectionError("connection closed")
+        d = chaos.delay_s(_method)
+        if d:
+            await asyncio.sleep(d)  # injected straggler-RPC latency
         rid = next(self._req_ids)
         msg = {"m": _method, "i": rid, **fields}
+        if self.stamp:
+            msg.update(self.stamp)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         write_frame(self.writer, msg)
@@ -448,12 +554,22 @@ class Connection:
         The allocation-lean RPC path: no Future, no awaiting coroutine, no
         Task — used by the driver's hot task/actor submission loop where a
         per-call Task measurably caps throughput."""
-        rpc_chaos().maybe_fail(_method)
+        chaos = rpc_chaos()
+        chaos.maybe_fail(_method)
         if self._closed:
             raise ConnectionError("connection closed")
         rid = next(self._req_ids)
         self._pending[rid] = _cb
-        write_frame(self.writer, {"m": _method, "i": rid, **fields})
+        msg = {"m": _method, "i": rid, **fields}
+        if self.stamp:
+            msg.update(self.stamp)
+        d = chaos.delay_s(_method)
+        if d:
+            asyncio.get_running_loop().call_later(
+                d, write_frame, self.writer, msg
+            )
+            return
+        write_frame(self.writer, msg)
 
     def call_template(self, _method: str, _template: MsgTemplate, _cb, *var_values) -> None:
         """call_cb over a pre-encoded MsgTemplate: the constant part of the
@@ -469,10 +585,20 @@ class Connection:
         _cork_for(self.writer).write_body(_template.render(rid, *var_values))
 
     def notify(self, _method: str, **fields) -> None:
-        rpc_chaos().maybe_fail(_method)
+        chaos = rpc_chaos()
+        chaos.maybe_fail(_method)
         if self._closed:
             raise ConnectionError("connection closed")
-        write_frame(self.writer, {"m": _method, **fields})
+        msg = {"m": _method, **fields}
+        if self.stamp:
+            msg.update(self.stamp)
+        d = chaos.delay_s(_method)
+        if d:
+            asyncio.get_running_loop().call_later(
+                d, write_frame, self.writer, msg
+            )
+            return
+        write_frame(self.writer, msg)
 
     async def close(self):
         self._closed = True
@@ -637,6 +763,15 @@ class Server:
                 frame = await read_frame(reader)
                 if frame is None:
                     break
+                # network-chaos receive filter (server side): once this
+                # connection's peer is identified (the head labels it at
+                # register), frames from a partitioned peer are dropped
+                ch = netchaos.NET_CHAOS
+                if ch is not None:
+                    peer = netchaos.link_of(writer)
+                    if peer is not None and ch.link_down(peer, ch.local):
+                        ch.count("recv_dropped")
+                        continue
                 # A batch envelope fans out in-process: every logical message
                 # inside it is dispatched exactly as if it had arrived as its
                 # own frame, in envelope order.

@@ -16,17 +16,19 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import random
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from . import serialization
+from . import netchaos, serialization
 from .config import CAConfig, get_config
 from .errors import (
     ActorDiedError,
     CAError,
+    FencedError,
     GetTimeoutError,
     ObjectLostError,
     StaleObjectError,
@@ -139,6 +141,15 @@ def transfer_stats() -> Dict[str, int]:
     return dict(TRANSFER_STATS)
 
 
+def _redial_backoff(attempt: int, rng: Optional[random.Random] = None) -> float:
+    """Jittered exponential backoff for head redials: base doubles
+    0.25s→4s with attempts, scaled by a uniform [0.5, 1.5) draw so N
+    workers reconnecting to a restarted head spread out instead of
+    arriving as one synchronized storm."""
+    base = min(0.25 * (2 ** max(0, min(attempt - 1, 4))), 4.0)
+    return base * (0.5 + (rng or random).random())
+
+
 def global_worker() -> "Worker":
     if _global_worker is None:
         raise RuntimeError("not initialized — call init() first")
@@ -208,6 +219,9 @@ class _Lease:
     # node hosting the leased worker: lets the submitter tell a drain/
     # preemption kill (budget-exempt retry) from an app-level worker crash
     node: Optional[str] = None
+    # node incarnation the grant was minted under (agent-granted leases):
+    # a post-heal audit proves no outstanding grant predates the verdict
+    inc: Optional[int] = None
 
 
 class LeasePool:
@@ -344,6 +358,13 @@ class LeasePool:
         )
 
     def _adopt_lease(self, lease: "_Lease"):
+        if lease.node:
+            # chaos labeling: map the leased worker's address to its node so
+            # connections to it ride the right (src, dst) link policy
+            netchaos.register_addr(lease.addr, lease.node)
+            netchaos.register_addr(
+                self.worker._normalize_peer_addr(lease.addr), lease.node
+            )
         self.leases.append(lease)
         self.requests_outstanding -= 1
         self._drain_backlog()
@@ -823,7 +844,18 @@ class Worker:
             weakref.WeakKeyDictionary()
         )
         self._stopped = False
-        self._head_fenced = False  # head refused re-registration: must exit
+        self._head_fenced = False  # head refused/fenced this process: must exit
+        # hook for the worker-process host: invoked (on the IO loop) the
+        # moment a fence verdict lands, so zombie tasks are cancelled
+        # immediately instead of on the next watch tick
+        self._on_fenced_cb: Optional[Any] = None
+        # head-redial backoff (jittered): a head restart with N workers must
+        # not produce a synchronized reconnect storm on a fixed tick
+        self._redial_attempts = 0
+        self._redial_next = 0.0
+        # network-chaos plane: per-link partition/straggler injection (spec
+        # from config at start; runtime `ca chaos set` arrives as pushes)
+        netchaos.maybe_install_from_config(self.config, self.node_id)
         # log plane: lazily-built printer for log_batch pushes (drivers
         # subscribed via log_sub; see util/logplane.DriverLogPrinter)
         self._log_printer = None
@@ -911,7 +943,8 @@ class Worker:
             await self._start_p2p_server()
         from ..util.aio import dial  # lazy: util/__init__ reaches into core
 
-        self.head = await dial(self.head_sock, purpose="head")
+        netchaos.register_addr(self.head_sock, "n0")
+        self.head = await dial(self.head_sock, purpose="head", peer_node="n0")
         self.head.set_push_handler(self._on_push)
         reply = await self.head.call(
             "register",
@@ -924,8 +957,30 @@ class Worker:
             remote=self.client_mode,
         )
         self.total_resources = reply["resources"]
+        self._adopt_register_reply(reply)
         self._maybe_log_sub(self.head)
         self._housekeeping_task = spawn_bg(self._housekeeping())
+
+    def _adopt_register_reply(self, reply: dict) -> None:
+        """Post-register adoption: worker processes stamp their node's
+        incarnation onto every head RPC (the fencing token — a stale stamp
+        after a partition verdict is refused, which is how zombie tasks are
+        stopped before they commit duplicate side effects), and any active
+        runtime chaos schedule is installed locally."""
+        if self.mode == "worker":
+            # set OR clear: a reply without node_inc (snapshotless head
+            # restart racing the agent's rejoin) must not leave any prior
+            # stamp semantics ambiguous on the fresh connection
+            ni = reply.get("node_inc")
+            self.head.stamp = {"ninc": ni} if ni is not None else None
+        if reply.get("net_chaos"):
+            try:
+                netchaos.install(
+                    reply["net_chaos"], self.node_id,
+                    epoch=reply.get("net_chaos_epoch"),
+                )
+            except (ValueError, TypeError):
+                pass
 
     def _maybe_log_sub(self, conn) -> None:
         """Subscribe this driver to the cluster log stream (log plane):
@@ -952,6 +1007,29 @@ class Worker:
     async def _on_push(self, msg):
         if msg.get("m") == "log_batch":
             self._on_log_batch(msg)
+            return
+        if msg.get("m") == "fenced":
+            # the head refused an RPC stamped with our (stale) node
+            # incarnation: this process was declared dead — stop acting
+            from .ownership import warn_ratelimited
+
+            warn_ratelimited(
+                "worker-fenced",
+                f"head fenced this process (node {msg.get('node_id')} "
+                f"incarnation {msg.get('ninc')} superseded): cancelling "
+                f"zombie tasks and exiting",
+            )
+            self._fence_now()
+            return
+        if msg.get("m") == "net_chaos":
+            # runtime chaos broadcast (`ca chaos set`)
+            try:
+                netchaos.install(
+                    msg.get("spec") or "", self.node_id,
+                    epoch=msg.get("epoch"),
+                )
+            except (ValueError, TypeError):
+                pass
             return
         if msg.get("m") == "owner_refs":
             # the head settling against THIS owner's ledger: releasing a
@@ -997,6 +1075,10 @@ class Worker:
                 self.shm_store.free_local(name)
         elif ch == "drain":
             self._on_drain_pub(msg.get("data") or {})
+        elif ch == "nodes":
+            data = msg.get("data") or {}
+            if data.get("alive") is False and data.get("node_id"):
+                self._on_node_dead_pub(data["node_id"])
         elif ch == "client_gone":
             # a borrower process died: its holder ids, value pins, transit
             # tokens, and containment edges in this owner's ledger can never
@@ -1058,6 +1140,53 @@ class Worker:
             DRAIN_STATS["leases_recalled_total"] += len(recalled)
             self.return_leases(recalled)
 
+    def _fence_now(self) -> None:
+        """A death verdict landed (refused re-register, FencedError reply,
+        or a `fenced` push): this process must stop acting on anything
+        minted under its dead incarnation.  Sets the fence flag and fires
+        the host callback — the worker process cancels its RUNNING zombie
+        tasks immediately (side effects must not complete) instead of
+        waiting for the next watch-loop tick."""
+        if self._head_fenced:
+            return
+        self._head_fenced = True
+        cb = self._on_fenced_cb
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def _on_node_dead_pub(self, nid: str) -> None:
+        """The head declared a node dead (crash, or a partition verdict).
+        A partitioned worker's socket never closes by itself — frames just
+        vanish — so in-flight pushes toward that node would hang forever.
+        Drop its leases, purge it from the cached lease directory, and
+        close our connections to its workers NOW: pending push_task calls
+        fail with ConnectionError and the normal retry machinery resubmits
+        on surviving capacity."""
+        if nid == self.node_id:
+            return  # our own node: the fence/register path governs us
+        dead_addrs = set()
+        for pool in self._lease_pools.values():
+            hit = False
+            for l in pool.leases:
+                if l.node == nid and not l.dead:
+                    dead_addrs.add(self._normalize_peer_addr(l.addr))
+                    l.dead = True  # busy or idle: never pick/return it again
+                    hit = True
+            if hit:
+                pool.leases = [l for l in pool.leases if not l.dead]
+        ts, entries = self._lease_dir_cache
+        if entries:
+            self._lease_dir_cache = (
+                ts, [e for e in entries if e.get("node_id") != nid]
+            )
+        for addr in dead_addrs:
+            conn = self._conns.pop(addr, None)
+            if conn is not None and not conn.closed:
+                spawn_bg(conn.close())
+
     def draining_node_ids(self) -> set:
         """Node ids currently inside an announced drain window (fed by the
         head's `drain` pubs; entries expire at deadline+grace).  The serve
@@ -1098,8 +1227,18 @@ class Worker:
                     pass
             if self.head is not None and self.head.closed and not self._head_fenced:
                 # head died (restart-in-progress): keep redialing; the
-                # restarted head re-adopts us from its snapshot
-                await self._reconnect_head()
+                # restarted head re-adopts us from its snapshot.  Jittered
+                # exponential backoff: a head restart with N workers on a
+                # fixed tick produced a synchronized reconnect storm
+                if now >= self._redial_next:
+                    if await self._reconnect_head():
+                        self._redial_attempts = 0
+                        self._redial_next = 0.0
+                    else:
+                        self._redial_attempts += 1
+                        self._redial_next = now + _redial_backoff(
+                            self._redial_attempts
+                        )
             to_return = []
             for pool in self._lease_pools.values():
                 to_return.extend(pool.reap_idle(now, self.config.lease_idle_timeout_s))
@@ -1188,12 +1327,12 @@ class Worker:
         from ..util.aio import dial  # lazy: util/__init__ reaches into core
 
         try:
-            conn = await dial(self.head_sock, purpose="head")
+            conn = await dial(self.head_sock, purpose="head", peer_node="n0")
         except OSError:
             return False
         conn.set_push_handler(self._on_push)
         try:
-            await conn.call(
+            reply = await conn.call(
                 "register",
                 role=self.mode,
                 client_id=self.client_id,
@@ -1211,12 +1350,17 @@ class Worker:
         except asyncio.CancelledError:
             await conn.close()
             raise  # shutdown mid-redial: release the socket, stay cancelled
+        except FencedError:
+            await conn.close()
+            self._fence_now()  # death verdict: cancel zombies, then exit
+            return False
         except Exception as e:
             await conn.close()  # before anything that could raise (str(e) can)
             if "declared dead" in str(e):
-                self._head_fenced = True
+                self._fence_now()
             return False
         self.head = conn
+        self._adopt_register_reply(reply)
         # the restarted head lost its subscriber table: re-join the stream
         self._maybe_log_sub(conn)
         # ... and this owner's ledger digest: next owner_sync is a full one
@@ -1275,9 +1419,16 @@ class Worker:
             if r.get("granted"):
                 if blk is not None:  # optimistic: steer the next grant away
                     blk["used"] = blk.get("used", 0) + 1
+                # chaos labeling: pushes to this worker belong to its node's
+                # link (a partitioned node's pushes must vanish, not error)
+                netchaos.register_addr(r["addr"], ent.get("node_id"))
+                netchaos.register_addr(
+                    self._normalize_peer_addr(r["addr"]), ent.get("node_id")
+                )
                 return _Lease(
                     r["lease_id"], r["worker_id"], r["addr"],
                     granter=ent["addr"], node=ent.get("node_id"),
+                    inc=r.get("ninc"),
                 ), True
             denied = True
             if blk is not None:
@@ -4341,6 +4492,12 @@ class Worker:
         while True:
             try:
                 return self.run_coro(self.head.call(method, **fields))
+            except FencedError:
+                # the head refused our stamped incarnation: death verdict.
+                # Never retry — completing this call would be the duplicate
+                # side effect fencing exists to prevent.
+                self._fence_now()
+                raise
             except ConnectionError:
                 if (
                     self._stopped

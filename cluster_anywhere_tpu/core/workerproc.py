@@ -1049,11 +1049,45 @@ class WorkerProcess:
             serve_addr_tcp=addr_tcp,
         )
         set_global_worker(self.worker)
+        # fence hook: a death verdict (FencedError / refused re-register /
+        # `fenced` push) cancels running zombie tasks IMMEDIATELY — their
+        # side effects must not complete — instead of waiting a watch tick
+        self.worker._on_fenced_cb = self._fenced_now
         await self.worker.connect_async()
         spawn_bg(self._heartbeat_loop())
         spawn_bg(self._watch_head())
         # park forever; the head kills us at job teardown
         await asyncio.Event().wait()
+
+    def _fenced_now(self):
+        """Death-verdict entry point; may fire from a user thread (a task's
+        own head_call raising FencedError) — hop to the loop."""
+        try:
+            self.loop.call_soon_threadsafe(self._fenced_on_loop)
+        except RuntimeError:
+            os._exit(1)
+
+    def _fenced_on_loop(self):
+        """Death verdict landed: this worker's node incarnation was declared
+        dead (partition heal discovery).  Cancel every RUNNING task — the
+        head already resubmitted them elsewhere, so letting them finish
+        would commit duplicate side effects — then exit.  The cancellation
+        is the difference between "zombie completed, then died" and "zombie
+        died mid-flight": only the latter is at-most-once."""
+        import ctypes
+
+        for task_id in list(self._async_running):
+            t = self._async_running.get(task_id)
+            if t is not None:
+                t.cancel()
+        for task_id, tid in list(self._running_tasks.items()):
+            self._cancel_requested.append(task_id)
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError)
+            )
+        # brief grace for the cancellations to unwind, then hard exit (the
+        # agent's fence reset SIGKILLs us anyway if we linger)
+        self.loop.call_later(0.25, os._exit, 1)
 
     async def _watch_head(self):
         """Watch the head connection.  A dead head gets a reconnect grace

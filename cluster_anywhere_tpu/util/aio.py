@@ -57,18 +57,46 @@ def _warn(key: str, msg: str) -> None:
     warn_ratelimited(key, msg)
 
 
-async def dial(addr: str, timeout: float = None, purpose: str = "peer"):
+async def dial(addr: str, timeout: float = None, purpose: str = "peer",
+               peer_node: str = None):
     """Timeout-bounded protocol.connect_addr: THE way to dial a peer.
 
     Default bound is config.dial_timeout_s.  A timed-out dial raises
     ConnectionError (counted + rate-limited-warned), which every existing
-    dial site already treats as peer-unreachable."""
-    from ..core import protocol  # lazy: util must import without core loaded
+    dial site already treats as peer-unreachable.
+
+    `peer_node` labels the connection for the network-chaos plane (the
+    address registry is the fallback): a dial toward a blackholed peer
+    hangs — SYN into the void — until the link heals or the bound expires,
+    exactly like a real partitioned connect."""
+    from ..core import netchaos, protocol  # lazy: util imports without core
     from ..core.config import get_config
 
     t = get_config().dial_timeout_s if timeout is None else timeout
+    budget = t  # connect budget shrinks by any blackhole heal-wait below
+    dst = peer_node if peer_node is not None else netchaos.node_for_addr(addr)
+    ch = netchaos.NET_CHAOS
+    if ch is not None:
+        if dst is not None and ch.link_down(ch.local, dst):
+            ch.count("dials_blocked")
+            deadline = asyncio.get_running_loop().time() + t
+            while asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.05)
+                c = netchaos.NET_CHAOS
+                if c is None or not c.link_down(c.local, dst):
+                    break  # link healed mid-wait: the SYN gets through now
+            else:
+                AIO_STATS["dial_timeouts"] += 1
+                raise ConnectionError(
+                    f"dial {addr} timed out after {t:.1f}s"
+                ) from None
+            # the heal-wait spent part of the bound: the connect gets only
+            # the remainder, so the caller's total never exceeds ~t
+            budget = max(
+                0.05, deadline - asyncio.get_running_loop().time()
+            )
     try:
-        return await asyncio.wait_for(protocol.connect_addr(addr), t)
+        conn = await asyncio.wait_for(protocol.connect_addr(addr), budget)
     except asyncio.TimeoutError:
         AIO_STATS["dial_timeouts"] += 1
         _warn(
@@ -77,6 +105,10 @@ async def dial(addr: str, timeout: float = None, purpose: str = "peer"):
             f"(peer preempted or partitioned?)",
         )
         raise ConnectionError(f"dial {addr} timed out after {t:.1f}s") from None
+    # label unconditionally (a weak-dict insert): chaos installed at RUNTIME
+    # (`ca chaos set`) must cover connections that predate it
+    netchaos.label_writer(conn.writer, dst)
+    return conn
 
 
 async def read_frame(reader: "asyncio.StreamReader", timeout: float = None):

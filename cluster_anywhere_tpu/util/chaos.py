@@ -2,11 +2,16 @@
 python/ray/_private/test_utils.py:1512 ResourceKillerActor/WorkerKillerActor,
 and the RPC chaos env described in src/ray/rpc/rpc_chaos.h).
 
-Three layers:
+Four layers:
 - RPC chaos: set CA_TESTING_RPC_FAILURE="method=N,method2=M" (or the
   testing_rpc_failure config field) before init(); the first N sends of each
   named method raise ConnectionError in the sending process.  Deterministic —
-  the standard way to exercise retry paths.
+  the standard way to exercise retry paths.  CA_TESTING_RPC_DELAY="method=MS"
+  injects per-method latency instead (straggler RPCs).
+- Network chaos (core/netchaos.py): per-link blackhole/delay/flap from a
+  seeded schedule — the failure class RPC chaos cannot express (frames
+  vanish, connections hang).  NetworkPartition below drives it at runtime
+  through the head's `net_chaos` broadcast.
 - WorkerKiller: kills random pool-worker processes on a cadence while a
   workload runs, from a thread in the driver (same-host process kill; the
   multi-node analogue is Cluster.remove_node).
@@ -89,6 +94,62 @@ class WorkerKiller:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+
+class NetworkPartition:
+    """Partition two nodes for a scheduled window, cluster-wide.
+
+    start() broadcasts a seeded blackhole schedule through the head's
+    `net_chaos` RPC: every process (head, agents, workers, this driver)
+    installs the same spec against the same epoch, so both sides of the
+    link drop frames for `duration_s` and then HEAL BY SCHEDULE — a `clear`
+    broadcast could never reach the processes it partitioned, which is why
+    the heal must be pre-agreed.  Deterministic: the same seed + duration
+    yields the same injected event sequence (log the seed on test failure
+    and the run replays)."""
+
+    def __init__(self, node_a: str, node_b: str = "n0",
+                 duration_s: float = 8.0, seed: int = 0,
+                 start_after_s: float = 0.2):
+        self.node_a = node_a
+        self.node_b = node_b
+        self.duration_s = duration_s
+        self.seed = seed
+        self.start_after_s = start_after_s
+        self.epoch: Optional[float] = None
+
+    @property
+    def spec(self) -> str:
+        return (
+            f"seed={self.seed};{self.node_a}<>{self.node_b}:"
+            f"blackhole@{self.start_after_s}+{self.duration_s}"
+        )
+
+    def start(self) -> "NetworkPartition":
+        from ..core.worker import global_worker
+
+        self.epoch = time.time()
+        global_worker().head_call(
+            "net_chaos", spec=self.spec, epoch=self.epoch
+        )
+        return self
+
+    def heals_at(self) -> float:
+        """Wall-clock time the schedule re-opens the link."""
+        if self.epoch is None:
+            raise RuntimeError("partition not started")
+        return self.epoch + self.start_after_s + self.duration_s
+
+    def wait_heal(self, grace_s: float = 0.5) -> None:
+        """Sleep until just past the scheduled heal."""
+        time.sleep(max(0.0, self.heals_at() - time.time()) + grace_s)
+
+    def clear(self) -> None:
+        """Broadcast an empty spec (reachable processes only — use after
+        the scheduled heal to drop the bookkeeping everywhere)."""
+        from ..core.worker import global_worker
+
+        global_worker().head_call("net_chaos", spec="")
 
 
 class PreemptionSimulator:

@@ -1,0 +1,509 @@
+"""Partition tolerance: the network-chaos plane (core/netchaos.py) and the
+incarnation fencing that survives it.
+
+Fast tier-1 paths: seeded-schedule determinism, blackhole/flap/delay
+injection at the protocol layer, zero-cost-when-disabled, the RPC latency
+knob, redial-backoff jitter, and the head's incarnation mint/fence (stale
+register refused with FencedError, fresh rejoin bumps the token).
+
+The full chaos acceptance — head<->node blackhole mid-workload, death
+verdict, resubmission, heal, at-most-once side effects, zombie-free rejoin —
+is marked `slow`; its seed is printed so a failure replays exactly
+(CA_PARTITION_SEED=<seed>)."""
+
+import asyncio
+import os
+import signal
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.core import netchaos
+from cluster_anywhere_tpu.core import protocol as P
+from cluster_anywhere_tpu.core.errors import FencedError
+from cluster_anywhere_tpu.core.protocol import reset_rpc_chaos
+
+SEED = int(os.environ.get("CA_PARTITION_SEED", "1234"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_netchaos():
+    """Chaos state is process-global: never leak it into other tests."""
+    yield
+    netchaos.clear()
+    netchaos.set_local_node(os.environ.get("CA_NODE_ID", "n0"))
+    reset_rpc_chaos("")
+
+
+# ------------------------------------------------------------- spec parsing
+def test_netchaos_spec_parse():
+    nc = netchaos.NetworkChaos(
+        "seed=7;epoch=100.0;n0<>node1:blackhole@1+8;n0>node2:delay=0.05,"
+        "jitter=0.01;node3<>n0:flap=0.8/0.4@2.0",
+        local="n0", now=0.0,
+    )
+    assert nc.seed == 7 and nc.epoch == 100.0
+    assert ("n0", "node1") in nc.policies and ("node1", "n0") in nc.policies
+    assert ("n0", "node2") in nc.policies
+    assert ("node2", "n0") not in nc.policies  # `>` is one-directional
+    pol = nc.policies[("n0", "node2")]
+    assert pol.delay_s == 0.05 and pol.jitter_s == 0.01
+    bh = nc.policies[("n0", "node1")]
+    assert bh.bh_start == 1.0 and bh.bh_end == 9.0
+
+
+def test_netchaos_bad_specs_raise():
+    for bad in (
+        "n0-node1:blackhole",          # bad link separator
+        "n0<>node1:frobnicate",        # unknown action
+        "n0<>node1",                   # missing actions
+        "n0<>node1:flap=0/1",          # non-positive phase
+    ):
+        with pytest.raises(ValueError):
+            netchaos.NetworkChaos(bad, local="n0", now=0.0)
+    # install() surfaces the parse error too (a typo'd schedule that
+    # silently injects nothing would invalidate the chaos test using it)
+    with pytest.raises(ValueError):
+        netchaos.install("n0<>node1:frobnicate")
+    assert netchaos.NET_CHAOS is None
+
+
+def test_netchaos_blackhole_window_and_events():
+    nc = netchaos.NetworkChaos(
+        "seed=1;n0<>node1:blackhole@1+3", local="n0", now=0.0
+    )
+    assert not nc.link_down("n0", "node1", now=0.5)
+    assert nc.link_down("n0", "node1", now=1.0)
+    assert nc.link_down("n0", "node1", now=3.9)
+    assert not nc.link_down("n0", "node1", now=4.0)
+    # unknown links are never touched
+    assert not nc.link_down("n0", "nodeX", now=2.0)
+    kinds = [(e[0], e[1], e[2]) for e in nc.events]
+    assert ("down", "n0", "node1") in kinds and ("up", "n0", "node1") in kinds
+
+
+# ------------------------------------------------------------- determinism
+def test_netchaos_seeded_schedule_is_deterministic():
+    spec = "seed=42;a<>b:flap=0.5/0.3;a>c:delay=0.01,jitter=0.02"
+    nc1 = netchaos.NetworkChaos(spec, local="a", now=0.0)
+    nc2 = netchaos.NetworkChaos(spec, local="a", now=0.0)
+    # identical flap transition schedules out to a horizon
+    s1 = nc1.flap_schedule("a", "b", 30.0)
+    s2 = nc2.flap_schedule("a", "b", 30.0)
+    assert s1 == s2 and len(s1) > 10
+    # identical per-frame decision sequences over the same scripted times
+    times = [i * 0.037 for i in range(400)]
+    d1 = [(nc1.link_down("a", "b", now=t), round(nc1.frame_delay("a", "c"), 9)) for t in times]
+    d2 = [(nc2.link_down("a", "b", now=t), round(nc2.frame_delay("a", "c"), 9)) for t in times]
+    assert d1 == d2
+    # the schedule actually flaps (both states observed)
+    states = {s for s, _ in d1}
+    assert states == {True, False}
+    # a different seed yields a different schedule
+    nc3 = netchaos.NetworkChaos(spec.replace("seed=42", "seed=43"), local="a", now=0.0)
+    assert nc3.flap_schedule("a", "b", 30.0) != s1
+    # interleaved queries cannot perturb the schedule (index-derived phases)
+    nc4 = netchaos.NetworkChaos(spec, local="a", now=0.0)
+    nc4.link_down("a", "b", now=2.0)   # partial extension first
+    assert nc4.flap_schedule("a", "b", 30.0) == s1
+
+
+def test_netchaos_zero_cost_when_disabled():
+    """Disabled = NET_CHAOS is None: every hook is one module-global check,
+    nothing is labeled, nothing is counted."""
+    assert netchaos.install("") is None
+    assert netchaos.NET_CHAOS is None
+    # labeling-free writers resolve to no link, so even an active instance
+    # would skip them; with no instance the send path never consults policy
+    class W:  # weakref-able stand-in
+        pass
+
+    assert netchaos.link_of(W()) is None
+    assert netchaos.status() == {"active": False}
+
+
+# --------------------------------------------------- protocol-layer injection
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_protocol_blackhole_drops_then_heals(tmp_path):
+    """Frames on a labeled writer vanish while the link is down (the
+    connection HANGS, it does not error) and flow again after the scheduled
+    heal — injected at the cork, observed end-to-end through a real
+    unix-socket Server."""
+
+    async def run():
+        path = str(tmp_path / "bh.sock")
+        got = []
+
+        async def handler(state, msg, reply, reply_err):
+            got.append(msg.get("seq"))
+            reply()
+
+        srv = P.Server(path, handler)
+        await srv.start()
+        netchaos.set_local_node("n0")
+        nc = netchaos.install(f"seed={SEED};n0>node9:blackhole@0+0.6")
+        conn = await P.connect_addr(path)
+        netchaos.label_writer(conn.writer, "node9")
+        conn.notify("ping", seq=1)  # in-window: dropped silently
+        await asyncio.sleep(0.2)
+        assert got == []
+        assert nc.stats["frames_dropped"] >= 1
+        # the connection is still open — a partition hangs, never errors
+        assert not conn.closed
+        await asyncio.sleep(0.5)  # past the scheduled heal
+        conn.notify("ping", seq=2)
+        deadline = asyncio.get_running_loop().time() + 5
+        while not got and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert got == [2]
+        await conn.close()
+        await srv.stop()
+
+    _run(run())
+
+
+def test_protocol_recv_filter_drops_partitioned_peer(tmp_path):
+    """A chaos-enabled RECEIVER drops frames arriving FROM a partitioned
+    peer even when that peer never installed a spec (one process can
+    simulate a symmetric partition against chaos-less senders).  The
+    one-directional policy (node9>n0) leaves our SEND side up: the request
+    reaches the server, and only its reply vanishes — the call HANGS."""
+
+    async def run():
+        path = str(tmp_path / "recv.sock")
+
+        async def handler(state, msg, reply, reply_err):
+            reply(pong=True)
+
+        srv = P.Server(path, handler)
+        await srv.start()
+        conn = await P.connect_addr(path)
+        netchaos.set_local_node("n0")
+        nc = netchaos.install(f"seed={SEED};node9>n0:blackhole@0+30")
+        netchaos.label_writer(conn.writer, "node9")
+        with pytest.raises(asyncio.TimeoutError):
+            await conn.call("ping", timeout=0.5)
+        assert nc.stats["recv_dropped"] >= 1
+        assert not conn.closed  # hangs, never errors: partition semantics
+        netchaos.clear()
+        r = await conn.call("ping", timeout=5)
+        assert r.get("pong") is True
+        await conn.close()
+        await srv.stop()
+
+    _run(run())
+
+
+def test_protocol_delay_link_defers_frames(tmp_path):
+    """delay=X adds per-frame latency on the labeled link, preserving FIFO."""
+
+    async def run():
+        path = str(tmp_path / "delay.sock")
+        got = []
+
+        async def handler(state, msg, reply, reply_err):
+            got.append(msg.get("seq"))
+
+        srv = P.Server(path, handler)
+        await srv.start()
+        netchaos.set_local_node("n0")
+        nc = netchaos.install("seed=0;n0>node9:delay=0.15")
+        conn = await P.connect_addr(path)
+        netchaos.label_writer(conn.writer, "node9")
+        t0 = asyncio.get_running_loop().time()
+        conn.notify("ping", seq=1)
+        conn.notify("ping", seq=2)
+        deadline = t0 + 5
+        while len(got) < 2 and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        dt = asyncio.get_running_loop().time() - t0
+        assert got == [1, 2], got  # FIFO preserved through the delay path
+        assert dt >= 0.14, f"frames arrived too fast for a 150ms link: {dt}"
+        assert nc.stats["frames_delayed"] >= 1
+        await conn.close()
+        await srv.stop()
+
+    _run(run())
+
+
+# ----------------------------------------------------- RPC latency injection
+def test_rpc_delay_injects_per_method_latency(tmp_path):
+    """CA_TESTING_RPC_DELAY="method=MS": matching sends wait MS ms first
+    (straggler RPCs), other methods are untouched."""
+
+    async def run():
+        path = str(tmp_path / "rpcdelay.sock")
+
+        async def handler(state, msg, reply, reply_err):
+            reply(ok2=True)
+
+        srv = P.Server(path, handler)
+        await srv.start()
+        conn = await P.connect_addr(path)
+        reset_rpc_chaos("", "kv_put=120")
+        t0 = asyncio.get_running_loop().time()
+        await conn.call("kv_put", key="k", value=b"v")
+        slow = asyncio.get_running_loop().time() - t0
+        t0 = asyncio.get_running_loop().time()
+        await conn.call("kv_get", key="k")
+        fast = asyncio.get_running_loop().time() - t0
+        assert slow >= 0.11, f"injected delay missing: {slow}"
+        assert fast < 0.1, f"uninjected method was delayed: {fast}"
+        await conn.close()
+        await srv.stop()
+
+    _run(run())
+
+
+def test_rpc_delay_validates_method_names():
+    """Typo'd method names in the delay spec raise at parse time (same
+    contract validation as the failure knob)."""
+    with pytest.raises(ValueError, match="unknown RPC method"):
+        reset_rpc_chaos("", "definitely_not_a_method=10")
+
+
+# --------------------------------------------------------- redial jitter
+def test_redial_backoff_is_jittered_and_bounded():
+    import random
+
+    from cluster_anywhere_tpu.core.worker import _redial_backoff
+
+    rng = random.Random(7)
+    first = [_redial_backoff(1, rng) for _ in range(50)]
+    # bounded: attempt 1 base is 0.25s, jitter in [0.5, 1.5)
+    assert all(0.125 <= d < 0.375 for d in first)
+    # jittered: not a fixed tick
+    assert len({round(d, 6) for d in first}) > 10
+    # grows with attempts, capped at 4s base (6s with max jitter)
+    late = [_redial_backoff(20, rng) for _ in range(50)]
+    assert all(2.0 <= d < 6.0 for d in late)
+    assert min(late) > max(first)
+
+
+# ------------------------------------------------- incarnation mint + fence
+def test_incarnation_fence_and_fresh_rejoin():
+    """Kill a node agent; once the head issues the death verdict, (a) an
+    agent re-register carrying the dead incarnation is refused with
+    FencedError, (b) a stamped authority RPC under the stale token is
+    refused, and (c) a fresh rejoin under the same node id mints a strictly
+    larger incarnation."""
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+    from cluster_anywhere_tpu.core.config import CAConfig
+
+    cfg = CAConfig()
+    cfg.health_check_period_s = 0.5
+    cfg.health_check_failure_threshold = 3
+    c = Cluster(head_resources={"CPU": 1}, config=cfg)
+    nid = c.add_node(num_cpus=1)
+    c.connect()
+    try:
+        c.wait_for_nodes(2)
+        row = next(n for n in ca.nodes() if n["node_id"] == nid)
+        inc0 = row["incarnation"]
+        assert inc0 >= 1
+        c.remove_node(nid)  # SIGKILL: silent death
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            row = next((n for n in ca.nodes() if n["node_id"] == nid), None)
+            if row is not None and not row["alive"]:
+                break
+            time.sleep(0.1)
+        assert row is not None and not row["alive"], "death verdict missing"
+
+        bc = P.BlockingClient(c.head_tcp)
+        try:
+            # (a) zombie re-register with the dead incarnation: refused
+            with pytest.raises(FencedError):
+                bc.call(
+                    "register", role="agent", client_id=nid,
+                    addr="tcp:127.0.0.1:1", resources={"CPU": 1}, ninc=inc0,
+                )
+            # (b) stale-stamped authority RPC: refused before dispatch
+            with pytest.raises(FencedError):
+                bc.call(
+                    "kv_put", ns="fence", key="k", value=b"v",
+                    node_id=nid, ninc=inc0,
+                )
+        finally:
+            bc.close()
+        # the refused commit must not have landed
+        from cluster_anywhere_tpu.core.worker import global_worker
+
+        w = global_worker()
+        assert w.head_call("kv_keys", ns="fence")["keys"] == []
+        assert w.head_call("stats")["stats"].get("fenced_rpcs", 0) >= 2
+        # (c) a REAL fresh agent under the same node id joins at a bumped
+        # incarnation
+        c.add_node(num_cpus=1, node_id=nid)
+        deadline = time.time() + 30
+        row = None
+        while time.time() < deadline:
+            row = next((n for n in ca.nodes() if n["node_id"] == nid), None)
+            if row is not None and row["alive"]:
+                break
+            time.sleep(0.1)
+        assert row is not None and row["alive"]
+        assert row["incarnation"] > inc0
+
+        @ca.remote
+        def one():
+            return 1
+
+        assert ca.get([one.remote() for _ in range(4)], timeout=60) == [1] * 4
+    finally:
+        c.shutdown()
+
+
+# ------------------------------------------------------- the slow acceptance
+@pytest.mark.slow
+def test_partition_chaos_acceptance():
+    """THE partition acceptance: blackhole head<->node mid-workload with
+    side-effect-counting tasks.  Asserts the full story — death verdict,
+    resubmission onto survivors, at-most-once commits (zombie commits
+    fenced, not duplicated), zombie actor killed at the heal, zero grants
+    surviving the verdict, and a fresh-incarnation rejoin.
+
+    Deterministic schedule: seed printed below; replay a failure with
+    CA_PARTITION_SEED=<seed>."""
+    print(f"\n[partition-chaos] seed={SEED} (replay: CA_PARTITION_SEED={SEED})")
+    from cluster_anywhere_tpu.cluster_utils import Cluster
+    from cluster_anywhere_tpu.core.config import CAConfig
+    from cluster_anywhere_tpu.core.worker import global_worker
+    from cluster_anywhere_tpu.util.chaos import NetworkPartition
+
+    from cluster_anywhere_tpu.core.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cfg = CAConfig()
+    cfg.health_check_period_s = 0.5
+    cfg.health_check_failure_threshold = 3
+    c = Cluster(head_resources={"CPU": 2}, config=cfg)
+    nid = c.add_node(num_cpus=2)
+    c.connect()
+    try:
+        c.wait_for_nodes(2)
+        w = global_worker()
+        row = next(n for n in ca.nodes() if n["node_id"] == nid)
+        inc0 = row["incarnation"]
+
+        # a zombie-actor probe started on the to-be-partitioned node (soft
+        # affinity: the restart may land anywhere).  After the verdict the
+        # head restarts it on a survivor while the ORIGINAL process still
+        # runs on the partitioned node — two candidate authorities.  The
+        # heal must resolve to exactly one: the zombie process dies.
+        @ca.remote(max_restarts=4)
+        class Probe:
+            def pid(self):
+                return os.getpid()
+
+        probe = Probe.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nid, soft=True)
+        ).remote()
+        zombie_pid = ca.get(probe.pid.remote(), timeout=30)
+
+        @ca.remote(max_retries=5)
+        def commit(i, sleep_s):
+            import os as _os
+            import time as _t
+
+            from cluster_anywhere_tpu.core.worker import global_worker as _gw
+
+            _t.sleep(sleep_s)
+            # the side effect: an attempt-keyed, incarnation-stamped KV
+            # commit — stale-incarnation attempts are REFUSED by the fence
+            _gw().head_call(
+                "kv_put", ns="se",
+                key=f"{i}:{_os.urandom(4).hex()}", value=b"1",
+            )
+            return i
+
+        n_tasks = 8
+        refs = [commit.remote(i, 3.0) for i in range(n_tasks)]
+        time.sleep(0.4)  # tasks are running on BOTH nodes
+        part = NetworkPartition(nid, "n0", duration_s=8.0, seed=SEED).start()
+
+        # --- the head declares the silent node dead --------------------
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            row = next((n for n in ca.nodes() if n["node_id"] == nid), None)
+            if row is None or not row["alive"]:
+                break
+            time.sleep(0.05)
+        assert row is None or not row["alive"], (
+            f"no death verdict (seed={SEED})"
+        )
+
+        # --- tasks resubmit onto the surviving side --------------------
+        assert ca.get(refs, timeout=120) == list(range(n_tasks)), (
+            f"workload lost tasks across the partition (seed={SEED})"
+        )
+
+        # --- heal: the node discovers its verdict and rejoins fresh ----
+        part.wait_heal()
+        deadline = time.time() + 40
+        row = None
+        while time.time() < deadline:
+            row = next((n for n in ca.nodes() if n["node_id"] == nid), None)
+            if row is not None and row["alive"] and row["incarnation"] > inc0:
+                break
+            time.sleep(0.1)
+        assert row is not None and row["alive"] and row["incarnation"] > inc0, (
+            f"node never rejoined at a fresh incarnation (seed={SEED}): {row}"
+        )
+
+        # --- at-most-once side effects ---------------------------------
+        keys = w.head_call("kv_keys", ns="se")["keys"]
+        per_task = {
+            i: len([k for k in keys if k.startswith(f"{i}:")])
+            for i in range(n_tasks)
+        }
+        assert all(v == 1 for v in per_task.values()), (
+            f"at-most-once violated (seed={SEED}): commits per task "
+            f"{per_task} (>1 = zombie duplicate, 0 = lost)"
+        )
+        # the fence actually fired during the heal (stale register or
+        # stale-stamped RPC — either discovery path counts)
+        assert w.head_call("stats")["stats"].get("fenced_rpcs", 0) >= 1
+
+        # --- zero zombie grants / zombie actor dead --------------------
+        used = sum(
+            b.get("used", 0)
+            for b in (row.get("lease_blocks") or {}).values()
+        )
+        assert used == 0, f"zombie grants survived the heal (seed={SEED})"
+        deadline = time.time() + 30
+        new_pid = None
+        while time.time() < deadline:
+            try:
+                new_pid = ca.get(probe.pid.remote(), timeout=10)
+                if new_pid != zombie_pid:
+                    break
+            except Exception:
+                time.sleep(0.3)
+        assert new_pid is not None and new_pid != zombie_pid, (
+            f"probe actor never superseded its zombie (seed={SEED})"
+        )
+        # exactly one authority: the pre-verdict actor process is DEAD
+        deadline = time.time() + 15
+        zombie_dead = False
+        while time.time() < deadline:
+            try:
+                os.kill(zombie_pid, 0)
+            except ProcessLookupError:
+                zombie_dead = True
+                break
+            time.sleep(0.2)
+        assert zombie_dead, (
+            f"zombie actor process {zombie_pid} still alive after the heal "
+            f"(seed={SEED})"
+        )
+        # the workload still works end to end on the healed cluster
+        assert ca.get(commit.remote(99, 0.0), timeout=60) == 99
+        part.clear()
+    finally:
+        c.shutdown()
