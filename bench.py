@@ -506,6 +506,23 @@ def bench_train_plane():
     return out
 
 
+def bench_dag_plane():
+    """Compiled-DAG plane rows (compiled tick vs RPC actor-call latency and
+    throughput, 3-actor chain A/B, serve TTFT with the compiled stream on
+    vs off) as a BENCH-json block.  The structural claim is the latency
+    ratio (compiled tick >= 10x below the sync RPC path); absolute us on
+    this shared host is context."""
+    from cluster_anywhere_tpu.microbenchmark import run_dag_plane
+
+    rows = run_dag_plane(quick=True)
+    out = {}
+    for name, value, _unit in rows:
+        key = name.replace("dag ", "").replace(" ", "_").replace("-", "_")
+        out[key] = round(value, 3)
+    log(f"dagplane: {out}")
+    return out
+
+
 def bench_chaos_plane():
     """Partition-tolerance rows (head<->node blackhole mid-workload:
     detect->fence->heal timeline, at-most-once commit proof, zombie-grant
@@ -540,6 +557,11 @@ def main():
         trainplane = bench_train_plane()
     except Exception as e:
         log(f"train plane bench failed: {e!r}")
+    dagplane = {}
+    try:
+        dagplane = bench_dag_plane()
+    except Exception as e:
+        log(f"dag plane bench failed: {e!r}")
     chaosplane = {}
     try:
         chaosplane = bench_chaos_plane()
@@ -570,6 +592,8 @@ def main():
         out["serveplane"] = serveplane
     if trainplane:
         out["trainplane"] = trainplane
+    if dagplane:
+        out["dagplane"] = dagplane
     if chaosplane:
         out["chaosplane"] = chaosplane
     if model_skip is not None:

@@ -34,6 +34,8 @@ from .core.errors import (
     ActorDiedError,
     ActorError,
     CAError,
+    DagTimeoutError,
+    DeadActorError,
     GetTimeoutError,
     ObjectLostError,
     TaskCancelledError,
@@ -100,6 +102,8 @@ __all__ = [
     "TaskError",
     "ActorError",
     "ActorDiedError",
+    "DeadActorError",
+    "DagTimeoutError",
     "WorkerCrashedError",
     "ObjectLostError",
     "GetTimeoutError",

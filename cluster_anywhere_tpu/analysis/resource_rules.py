@@ -144,6 +144,19 @@ REGISTRY: Tuple[Pair, ...] = (
         release_arg_methods=frozenset({"free_slice"}),
         double_release_is_error=True,
     ),
+    # shm channels (channel/shm_channel.py): the backing /dev/shm segment is
+    # freed by release(), not close() — close() only raises the shared
+    # shutdown flag; a channel that is closed but never released leaks its
+    # mmap and (writer-side) the on-disk segment until session sweep
+    Pair(
+        name="shm-channel",
+        acquire_calls=frozenset({
+            "ShmChannel", "BufferedShmChannel",
+            "ShmChannel.open", "BufferedShmChannel.open",
+            "open_channel", "shm_channel.open_channel",
+        }),
+        release_methods=frozenset({"release"}),
+    ),
     # spill files ride the fd pair at creation (os.open O_EXCL) and the
     # unlink below for the on-disk name
     Pair(

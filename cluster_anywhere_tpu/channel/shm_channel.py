@@ -41,6 +41,18 @@ _SPILL_BIT = 1 << 63  # payload_len high bit: payload is a spilled ObjectRef
 _DEFAULT_BUFFER = 8 * 1024 * 1024
 _POLL_S = 20e-6
 
+# hot-path counters, plain ints bumped without a lock (same contract as
+# protocol.WIRE_STATS: a lost increment under a race is acceptable, a lock
+# in a microsecond-scale channel write is not); util/metrics delta-ships
+# them as ca_channel_* cluster counters on every flush
+CHANNEL_STATS = {
+    "writes": 0,           # payloads published (per underlying slot write)
+    "reads": 0,            # payloads consumed
+    "spills": 0,           # oversized payloads routed through the object store
+    "backpressure_waits": 0,  # writes that found a reader ack outstanding
+    "closes": 0,           # close() flags raised
+}
+
 
 class ChannelClosedError(Exception):
     """Raised by read/write when the channel has been shut down."""
@@ -200,6 +212,10 @@ class ShmChannel(ChannelInterface):
         with no intermediate contiguous blob)."""
         want = self.version
         for r in range(self.num_readers):
+            if self._get(5 + r) < want:
+                # a reader hasn't consumed the previous version yet: this
+                # write is about to block on backpressure
+                CHANNEL_STATS["backpressure_waits"] += 1
             self._wait_ge(5 + r, want, deadline)  # acks only ever increase
         pos = self.header_size
         for c in chunks:
@@ -235,11 +251,13 @@ class ShmChannel(ChannelInterface):
             ref = ca.put(value)
             payload = pack(ref)
             chunks, total, spilled = [payload], len(payload), True
+            CHANNEL_STATS["spills"] += 1
         self._enter()
         try:
             self._write_payload(chunks, total, spilled, deadline)
         finally:
             self._exit()
+        CHANNEL_STATS["writes"] += 1
         # _write_payload waited for all acks of the previous version, and
         # readers only ack after fetching a spilled payload — so the prior
         # spilled object (if any) has been consumed.  Drop its ref, and keep
@@ -276,13 +294,35 @@ class ShmChannel(ChannelInterface):
         except ChannelClosedError:
             pass  # released mid-read: the ack is writer bookkeeping only —
             # the value was already read in full, so deliver it
+        CHANNEL_STATS["reads"] += 1
         return value
+
+    def wait_consumed(self, timeout: Optional[float] = None) -> bool:
+        """Writer-side drain barrier: block until every reader has acked the
+        last published version (i.e. the final write has been consumed), so
+        release() can't unlink the segment under a reader that hasn't mapped
+        or read it yet.  Returns False on timeout or close."""
+        deadline = None if timeout is None else _now() + timeout
+        want = self.version
+        try:
+            self._enter()
+        except ChannelClosedError:
+            return False
+        try:
+            for r in range(self.num_readers):
+                self._wait_ge(5 + r, want, deadline)
+            return True
+        except (ChannelClosedError, TimeoutError):
+            return False
+        finally:
+            self._exit()
 
     def close(self):
         try:
             self._enter()
         except ChannelClosedError:
             return  # already released locally; nothing to flag
+        CHANNEL_STATS["closes"] += 1
         try:
             self._set(3, _FLAG_CLOSED)
             if self._fx is not None:
@@ -362,6 +402,14 @@ class BufferedShmChannel(ChannelInterface):
         v = self._chans[self._rseq % len(self._chans)].read(timeout)
         self._rseq += 1
         return v
+
+    def wait_consumed(self, timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else _now() + timeout
+        for c in self._chans:
+            left = None if deadline is None else max(0.0, deadline - _now())
+            if not c.wait_consumed(left):
+                return False
+        return True
 
     def close(self):
         for c in self._chans:

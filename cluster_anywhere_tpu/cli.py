@@ -375,6 +375,21 @@ def cmd_status(args):
                 print(f"  {k}: {v:.4g}" if isinstance(v, float) else f"  {k}: {v}")
     except Exception:
         pass
+    # compiled-DAG plane: execute/result volume, channel traffic, and the
+    # failure-semantics counters (timeouts, actor deaths, recompiles) — the
+    # hot path that bypasses RPC should be visible without the dashboard
+    try:
+        from .util.state import dag_plane
+
+        dp = dag_plane()
+        if dp["dag"].get("executions") or dp["channel"].get("writes"):
+            print("== compiled DAG plane (cluster-aggregated) ==")
+            for k, v in sorted(dp["dag"].items()):
+                print(f"  dag_{k}: {v}")
+            for k, v in sorted(dp["channel"].items()):
+                print(f"  channel_{k}: {v}")
+    except Exception:
+        pass
     # train plane: active/recent runs (attempt, world size, last checkpoint)
     # and the elastic counters — a preemption mid-run should read as a
     # PREEMPTING->RUNNING transition with a fresh checkpoint, not a mystery
@@ -839,6 +854,13 @@ def cmd_microbenchmark(args):
 
         run_serve_plane(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "dag", False):
+        # owns its own clusters (compiled-DAG vs RPC actor-call latency and
+        # throughput, 3-actor chain A/B, serve TTFT on/off A/B)
+        from .microbenchmark import run_dag_plane
+
+        run_dag_plane(quick=getattr(args, "quick", False))
+        return
     if getattr(args, "train_elastic", False):
         # owns its own clusters (drain-aware proactive restart vs reactive
         # poll-failure restart: warning->resumed latency + steps lost)
@@ -1127,6 +1149,11 @@ def main(argv=None):
         "--serve", dest="serve_plane", action="store_true",
         help="serving-plane envelope: open-loop SSE req/s + TTFT/p99, "
         "admission shedding A/B, prefix-cache A/B, drain-under-load proof",
+    )
+    sp.add_argument(
+        "--dag", action="store_true",
+        help="compiled-DAG plane A/B: compiled tick vs RPC actor-call "
+        "latency/throughput, 3-actor chain, serve TTFT on/off",
     )
     sp.add_argument(
         "--train-elastic", dest="train_elastic", action="store_true",

@@ -42,6 +42,41 @@ class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (e.g. restarting)."""
 
 
+class DeadActorError(ActorDiedError):
+    """An actor hosting a compiled-DAG loop died mid-execute (infrastructure
+    death, as opposed to an application error — those travel through the
+    channels as _DagError payloads).  Carries the failed actor and the DAG
+    nodes it hosted; the DAG is torn down and `recompile()` rebuilds it
+    against the restarted actor."""
+
+    def __init__(self, actor_id: str, nodes: tuple = (), detail: str = ""):
+        self.actor_id = actor_id
+        self.nodes = tuple(nodes)
+        names = ", ".join(self.nodes) or "?"
+        msg = (
+            f"compiled-DAG actor {actor_id} died mid-execute "
+            f"(hosted nodes: {names})"
+        )
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class DagTimeoutError(CAError, TimeoutError):
+    """A compiled-DAG execute did not produce its outputs within
+    config.dag_execute_timeout_s (or the per-call timeout).  Names the node
+    whose output channel stalled so the hang is attributable."""
+
+    def __init__(self, node: str, timeout_s: float, phase: str = "read"):
+        self.node = node
+        self.timeout_s = timeout_s
+        self.phase = phase
+        super().__init__(
+            f"compiled-DAG {phase} timed out after {timeout_s:g}s waiting on "
+            f"node {node}"
+        )
+
+
 class ObjectLostError(CAError):
     """Object data is unavailable and could not be recovered."""
 

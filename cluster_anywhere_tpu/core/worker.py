@@ -4375,10 +4375,17 @@ class Worker:
             self._store_error(oids, e)
             return
         attempts = 1 + max(0, opts.get("max_task_retries", 0))
+        # the +1 grants one address-refresh resend after an ambiguous
+        # ConnectionError (restart transparency for idempotent calls).
+        # no_resend suppresses it: incarnation-bound calls — compiled-DAG
+        # actor loops — must fail with ActorDiedError rather than silently
+        # re-run on the restarted actor, where they would reopen their
+        # channels at stale stream positions and wedge the whole DAG.
+        resend = 0 if opts.get("no_resend") else 1
         last_err: Optional[BaseException] = None
         refresh = False
         trace = opts.get("_trace")
-        for _ in range(attempts + 1):
+        for _ in range(attempts + resend):
             try:
                 addr = await self._actor_addr(aid, refresh=refresh)
                 conn = await self.conn_to(addr)

@@ -94,6 +94,12 @@ class WorkerProcess:
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ca-exec"
         )
+        # compiled-DAG loops (__ca_exec__) live for the DAG's lifetime and
+        # block on channel reads; hosting them on the actor's single dispatch
+        # executor would freeze every other sync RPC to this actor for as
+        # long as a DAG is compiled.  Lazy dedicated pool instead — one
+        # thread per live loop, created on first compile.
+        self._dag_executor: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self.actor: Optional[ActorContext] = None
         self._exiting = False
         # producer-side backpressure state per streaming task:
@@ -551,8 +557,13 @@ class WorkerProcess:
                     return out
                 sem = self._semaphore_for(method)
                 async with sem if sem is not None else contextlib.nullcontext():
+                    ex = (
+                        self._dag_pool()
+                        if msg["method"] == "__ca_exec__"
+                        else self._executor_for(method)
+                    )
                     out = await self.loop.run_in_executor(
-                        self._executor_for(method),
+                        ex,
                         self._exec_sync, method, msg, task_id, msg["actor_id"],
                     )
                 self._record_event(task_id, ev_name, "actor_task", t0, True, trace=tr)
@@ -755,6 +766,16 @@ class WorkerProcess:
                 if ex is not None:
                     return ex
         return self.executor
+
+    def _dag_pool(self):
+        """Dedicated executor for compiled-DAG loops, pinned off the RPC
+        dispatch path: the cap bounds runaway compiles, not steady state
+        (one thread per concurrently-compiled DAG on this actor)."""
+        if self._dag_executor is None:
+            self._dag_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=32, thread_name_prefix="ca-dag-loop"
+            )
+        return self._dag_executor
 
     def _semaphore_for(self, fn):
         """Concurrency-group bound for async methods: thread pools can't cap

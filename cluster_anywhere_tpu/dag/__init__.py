@@ -21,9 +21,12 @@ from .node import (
     InputNode,
     MultiOutputNode,
 )
-from .compiled import CompiledDAG, CompiledDAGRef
+from ..core.errors import DagTimeoutError, DeadActorError
+from .compiled import DAG_STATS, CompiledDAG, CompiledDAGRef
 
 __all__ = [
+    "DagTimeoutError",
+    "DeadActorError",
     "DAGNode",
     "InputNode",
     "InputAttributeNode",

@@ -22,6 +22,7 @@ from ..channel.shm_channel import (
     ChannelClosedError,
     open_channel,
 )
+from ..core.errors import DagTimeoutError, DeadActorError
 from .node import (
     ClassMethodNode,
     DAGNode,
@@ -30,7 +31,25 @@ from .node import (
     MultiOutputNode,
 )
 
-_DEFAULT_TIMEOUT = 300.0
+# driver-side DAG-plane counters (plain ints, same contract as
+# channel.shm_channel.CHANNEL_STATS): util/metrics delta-ships them as
+# ca_dag_* cluster counters; `ca status` and util.state.dag_plane() read the
+# aggregate
+DAG_STATS = {
+    "compiles": 0,            # CompiledDAG graphs compiled (incl. recompiles)
+    "recompiles": 0,          # rebuilds after an actor restart
+    "executions": 0,          # execute() submissions
+    "results": 0,             # ticks whose outputs the driver consumed
+    "backpressure_waits": 0,  # execute() blocked at max_inflight_executions
+    "timeouts": 0,            # DagTimeoutError raised
+    "actor_deaths": 0,        # DeadActorError raised (loop died mid-execute)
+    "teardowns": 0,           # teardown() completions
+}
+
+# driver poll slice while waiting on channels: short enough that actor death
+# surfaces promptly, long enough that a healthy tick never pays for it (the
+# futex read wakes on publish, not at the slice boundary)
+_DEATH_POLL_S = 0.2
 
 
 class _DagError:
@@ -212,12 +231,19 @@ class CompiledDAGRef:
 
 class CompiledDAG:
     def __init__(self, root: DAGNode, max_inflight_executions: int = 2,
-                 buffer_size: Optional[int] = None):
+                 buffer_size: Optional[int] = None,
+                 execute_timeout_s: Optional[float] = None):
+        from ..core.config import get_config
+
         self._root = root
         self._max_inflight = max(1, max_inflight_executions)
         self._buffer_size = buffer_size or 8 * 1024 * 1024
-        self._timeout = _DEFAULT_TIMEOUT
+        self._timeout = (
+            execute_timeout_s if execute_timeout_s is not None
+            else get_config().dag_execute_timeout_s
+        )
         self._torn_down = False
+        self._dead: Optional[DeadActorError] = None
         self._exec_seq = 0
         self._read_seq = 0
         self._result_cache: Dict[int, Any] = {}
@@ -327,7 +353,18 @@ class CompiledDAG:
         raw_schedules = generate_actor_schedules(ops, op_edges)
 
         self._loop_refs = []
+        self._loop_actors: List[str] = []  # parallel to _loop_refs
         self._handles = handles
+        # node labels for typed errors: "<method> (node <id>)" and the set
+        # of nodes each actor hosts (DeadActorError names the failed ones)
+        self._node_methods = {n._id: n._method_name for n in compute}
+        self._actor_nodes = {
+            key: tuple(
+                f"{n._method_name} (node {n._id})"
+                for n in compute if owner(n) == key
+            )
+            for key in handles
+        }
         self._actor_schedules: Dict[str, List[tuple]] = {}
         for key, handle in handles.items():
             node_ops: Dict[int, dict] = {}
@@ -391,13 +428,20 @@ class CompiledDAG:
                 schedule.append(("read", ref[0]) if kind == "read" else (kind, ref))
             self._actor_schedules[key] = schedule
 
-            from ..core.actor import ActorMethod
-
-            ref = ActorMethod(handle, "__ca_exec__").remote(
-                _dag_actor_loop, schedule, node_ops, reader_specs, writer_specs,
-                self._timeout,
+            # no_resend: the loop is incarnation-bound.  If the actor dies
+            # the ref must resolve with ActorDiedError (feeding _check_loops)
+            # instead of being transparently re-sent to the restarted
+            # incarnation, whose re-run loop would reopen these channels at
+            # stale stream positions and never produce the lost tick.
+            ref = handle._submit(
+                "__ca_exec__",
+                (_dag_actor_loop, schedule, node_ops, reader_specs,
+                 writer_specs, self._timeout),
+                {},
+                {"num_returns": 1, "no_resend": True},
             )
             self._loop_refs.append(ref)
+            self._loop_actors.append(key)
 
         # driver-side reader handles for outputs; duplicate leaves in a
         # MultiOutputNode share one channel that is read once per tick
@@ -417,19 +461,84 @@ class CompiledDAG:
         # partially-read tick state (survives a TimeoutError so channel
         # streams never misalign): node_id -> value for the current tick
         self._partial_vals: Dict[int, Any] = {}
+        DAG_STATS["compiles"] += 1
+        from ..util.metrics import _ensure_flusher
+
+        _ensure_flusher()  # stats dicts only ship while the flusher runs
+
+    # ----------------------------------------------------------- fault watch
+
+    def _check_loops(self):
+        """Distinguish infrastructure death from a slow tick: a loop ref only
+        resolves when its actor loop EXITS, which before teardown means the
+        actor died (or the loop crashed outside user code).  App errors never
+        come this way — they travel through the channels as _DagError.
+        Raises DeadActorError (after tearing the DAG down) on death."""
+        if not self._loop_refs:
+            return
+        from ..core import api as ca
+
+        try:
+            done, _ = ca.wait(
+                self._loop_refs, num_returns=len(self._loop_refs), timeout=0
+            )
+        except Exception:
+            return  # wait plumbing unavailable: the deadline still bounds us
+        if not done:
+            return
+        ref = done[0]
+        key = self._loop_actors[self._loop_refs.index(ref)]
+        detail = "actor loop exited mid-execute"
+        try:
+            ca.get(ref)
+        except BaseException as e:  # noqa: BLE001 — folded into the typed error
+            detail = repr(e)
+        err = DeadActorError(key, self._actor_nodes.get(key, ()), detail)
+        DAG_STATS["actor_deaths"] += 1
+        self._dead = err
+        self.teardown()
+        raise err
+
+    def _raise_if_unusable(self):
+        if self._dead is not None:
+            raise self._dead
+        if self._torn_down:
+            raise RuntimeError("compiled DAG has been torn down")
 
     # ---------------------------------------------------------------- execute
 
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
-        if self._torn_down:
-            raise RuntimeError("compiled DAG has been torn down")
+        import time as _time
+
+        self._raise_if_unusable()
         if self._input_node is not None:
             payload = (tuple(args), kwargs)
             if getattr(self._input_node, "_tensor_transport", False):
                 from ..channel.device_transport import pack_device_value
 
                 payload = pack_device_value(payload)
-            self._channels[self._INPUT_ID].write(payload, self._timeout)
+            chan = self._channels[self._INPUT_ID]
+            deadline = _time.monotonic() + self._timeout
+            waited = False
+            # sliced write: at max_inflight the input channel blocks on the
+            # slowest reader's ack (backpressure); slicing keeps actor death
+            # from turning that into a silent hang
+            while True:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    DAG_STATS["timeouts"] += 1
+                    raise DagTimeoutError(
+                        "InputNode (backpressure)", self._timeout, phase="execute"
+                    )
+                try:
+                    chan.write(payload, min(_DEATH_POLL_S, remaining))
+                    break
+                except TimeoutError:
+                    if not waited:
+                        waited = True
+                        DAG_STATS["backpressure_waits"] += 1
+                    self._check_loops()
+        DAG_STATS["executions"] += 1
         ref = CompiledDAGRef(self, self._exec_seq)
         self._exec_seq += 1
         return ref
@@ -442,19 +551,40 @@ class CompiledDAG:
 
         return await asyncio.to_thread(self.execute, *args, **kwargs)
 
+    def _read_one(self, nid: int, deadline: float, timeout_s: float):
+        """Read one output channel in death-aware slices: a healthy tick
+        wakes on publish (futex), a dead producer surfaces as DeadActorError
+        from _check_loops, and the deadline surfaces as a typed error naming
+        the stalled node — never a bare hang."""
+        import time as _time
+
+        reader = self._driver_readers[nid]
+        while True:
+            # clamp to 0 rather than pre-raising: a 0-timeout read still
+            # returns a value that is already published (poll semantics)
+            remaining = max(0.0, deadline - _time.monotonic())
+            try:
+                return reader.read(min(_DEATH_POLL_S, remaining))
+            except TimeoutError:
+                self._check_loops()
+                if _time.monotonic() >= deadline:
+                    DAG_STATS["timeouts"] += 1
+                    raise DagTimeoutError(
+                        f"{self._node_methods.get(nid, '?')} (node {nid})",
+                        timeout_s,
+                    ) from None
+
     def _read_result(self, seq: int, timeout: Optional[float]):
         import time as _time
 
+        self._raise_if_unusable()
         t = self._timeout if timeout is None else timeout
         deadline = _time.monotonic() + t
         while self._read_seq <= seq:
             for nid in self._driver_read_order:
                 if nid in self._partial_vals:
                     continue  # already read before an earlier timeout
-                # clamp to 0 rather than pre-raising: a 0-timeout read still
-                # returns a value that is already published (poll semantics)
-                remaining = max(0.0, deadline - _time.monotonic())
-                v = self._driver_readers[nid].read(remaining)
+                v = self._read_one(nid, deadline, t)
                 if not isinstance(v, _DagError):
                     from ..channel.device_transport import maybe_unpack
 
@@ -464,6 +594,7 @@ class CompiledDAG:
             self._partial_vals = {}
             self._result_cache[self._read_seq] = outs
             self._read_seq += 1
+            DAG_STATS["results"] += 1
         outs = self._result_cache.pop(seq)
         for o in outs:
             if isinstance(o, _DagError):
@@ -481,6 +612,11 @@ class CompiledDAG:
                 chan.close()
             except Exception:
                 pass
+        for r in getattr(self, "_driver_readers", {}).values():
+            try:
+                r.close()
+            except Exception:
+                pass
         from ..core import api as ca
 
         try:
@@ -492,6 +628,27 @@ class CompiledDAG:
                 chan.release()
             except Exception:
                 pass
+        for r in getattr(self, "_driver_readers", {}).values():
+            try:
+                r.release()
+            except Exception:
+                pass
+        DAG_STATS["teardowns"] += 1
+
+    def recompile(self):
+        """Rebuild channels and actor loops against the CURRENT incarnation
+        of every actor — recovery path after DeadActorError when the failed
+        actor has a restart budget (max_restarts).  In-flight executions are
+        lost (their results died with the old loops); sequence numbers reset
+        so fresh executes read fresh channels."""
+        self.teardown()
+        self._torn_down = False
+        self._dead = None
+        self._exec_seq = 0
+        self._read_seq = 0
+        self._result_cache = {}
+        DAG_STATS["recompiles"] += 1
+        self._compile()
 
     def __del__(self):
         try:

@@ -82,10 +82,15 @@ class DAGNode:
     def _execute_impl(self, args, kwargs, input_args, input_kwargs):
         raise NotImplementedError
 
-    def experimental_compile(self, *, max_inflight_executions: int = 2, buffer_size: Optional[int] = None):
+    def experimental_compile(self, *, max_inflight_executions: int = 2,
+                             buffer_size: Optional[int] = None,
+                             execute_timeout_s: Optional[float] = None):
         from .compiled import CompiledDAG
 
-        return CompiledDAG(self, max_inflight_executions=max_inflight_executions, buffer_size=buffer_size)
+        return CompiledDAG(
+            self, max_inflight_executions=max_inflight_executions,
+            buffer_size=buffer_size, execute_timeout_s=execute_timeout_s,
+        )
 
     def visualize(self) -> str:
         """ASCII rendering of the graph (reference: dag/vis_utils.py)."""

@@ -305,6 +305,66 @@ class ContinuousLLMServer:
         finally:
             self._forget(req)
 
+    def dag_stream(self, request) -> dict:
+        """Compiled-DAG streaming: decode-step -> detokenize -> stream-out
+        without a per-token RPC.  Submits the prompt, pre-opens a shm
+        channel, and returns its spec; a forwarder thread pushes
+        {"token_id","text"} frames into the channel and the proxy-side
+        DagStreamReader futex-waits on them.  The only RPC left on the hot
+        path is this handshake."""
+        import threading
+
+        import numpy as np
+
+        from ..channel.shm_channel import BufferedShmChannel, ChannelClosedError
+        from ..core.config import get_config
+        from ..serve.dag_stream import DAG_EOF, DAG_ERR
+
+        cfg = get_config()
+        prompt, req, q = self._submit(_parse_body(request))
+        ch = BufferedShmChannel(
+            num_readers=1, num_buffers=max(2, cfg.serve_dag_stream_buffers)
+        )
+        spec = ch.spec()
+
+        def forward():
+            try:
+                while True:
+                    t = q.get(timeout=120)
+                    if t is None:
+                        ch.write(DAG_EOF, timeout=30)
+                        # drain barrier: release() unlinks the segment, so
+                        # wait until the proxy acked the EOF frame first
+                        ch.wait_consumed(30.0)
+                        return
+                    if isinstance(t, BaseException):
+                        ch.write(
+                            {DAG_ERR: f"LLM engine pump died: {t!r}"}, timeout=30
+                        )
+                        ch.wait_consumed(30.0)
+                        return
+                    # 120s matches the RPC path's queue timeout: a consumer
+                    # stalled longer than that loses the stream either way
+                    ch.write(
+                        {
+                            "token_id": int(t),
+                            "text": self.tok.decode(np.asarray([t], np.int32)),
+                        },
+                        timeout=120,
+                    )
+            except (ChannelClosedError, TimeoutError):
+                pass  # proxy abandoned the stream; free the decode slot below
+            except Exception:
+                pass
+            finally:
+                self._forget(req)
+                ch.release()
+
+        threading.Thread(
+            target=forward, daemon=True, name="ca-dag-stream"
+        ).start()
+        return spec
+
 
 class StreamingLLMIngress(ContinuousLLMServer):
     """ContinuousLLMServer whose __call__ STREAMS when the HTTP client asks

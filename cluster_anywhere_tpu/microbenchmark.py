@@ -1256,6 +1256,172 @@ def run_serve_plane(quick: bool = False) -> List[Tuple[str, float, str]]:
     return results
 
 
+def run_dag_plane(quick: bool = False) -> List[Tuple[str, float, str]]:
+    """`ca microbenchmark --dag`: compiled-DAG plane A/B.
+
+    (1) Actor-call A/B on one actor: per-call RPC latency (sync p50) and
+        async throughput vs compiled-DAG tick latency over pre-opened shm
+        channels (driver write -> futex wake -> compute -> futex wake ->
+        driver read; zero RPCs in steady state) and pipelined throughput at
+        max_inflight_executions.
+    (2) 3-actor chain A/B: chained RPC per item vs one compiled graph.
+    (3) Serve TTFT A/B: ContinuousLLMServer SSE below the knee with
+        config.serve_compiled_dag OFF vs ON — a fresh cluster per mode,
+        env-toggled so the proxy process inherits the setting."""
+    import socket
+
+    from .core import api as ca
+    from .dag import InputNode
+
+    results: List[Tuple[str, float, str]] = []
+
+    def record(name: str, value: float, unit: str):
+        results.append((name, value, unit))
+        print(f"{name}: {value:,.2f} {unit}")
+
+    # ---------------- phase 1+2: actor-call / chain A/B -------------------
+    owns = not ca.is_initialized()
+    if owns:
+        ca.init(num_cpus=4)
+
+    @ca.remote
+    class Relay:
+        def step(self, x):
+            return x
+
+    actors = [Relay.remote() for _ in range(3)]
+    a = actors[0]
+    ca.get([x.step.remote(0) for x in actors])
+
+    n_lat = 200 if quick else 1000
+    n_thru = 2000 if quick else 10000
+
+    def sync_p50(fn) -> float:
+        lats = []
+        for i in range(n_lat):
+            t0 = time.perf_counter()
+            fn(i)
+            lats.append(time.perf_counter() - t0)
+        return _pct(lats, 0.5)
+
+    rpc_p50 = sync_p50(lambda i: ca.get(a.step.remote(i)))
+    record("dag rpc actor-call sync p50", rpc_p50 * 1e6, "us")
+    record(
+        "dag rpc actor-call async",
+        _rate(n_thru, lambda: ca.get([a.step.remote(i) for i in range(n_thru)])),
+        "/s",
+    )
+
+    inflight = 8
+    with InputNode() as inp:
+        node = a.step.bind(inp)
+    cd = node.experimental_compile(max_inflight_executions=inflight)
+    assert cd.execute(0).get() == 0  # warm channels + loop
+    dag_p50 = sync_p50(lambda i: cd.execute(i).get())
+    record("dag compiled tick sync p50", dag_p50 * 1e6, "us")
+    record("dag compiled vs rpc sync latency", rpc_p50 / max(dag_p50, 1e-9), "x")
+
+    def pipelined():
+        refs = []
+        for i in range(n_thru):
+            refs.append(cd.execute(i))
+            if len(refs) >= inflight:
+                refs.pop(0).get()
+        while refs:
+            refs.pop(0).get()
+
+    record("dag compiled pipelined", _rate(n_thru, pipelined), "/s")
+    cd.teardown()
+
+    # 3-hop chain: driver -> a -> b -> c -> driver
+    rpc3_p50 = sync_p50(
+        lambda i: ca.get(
+            actors[2].step.remote(actors[1].step.remote(actors[0].step.remote(i)))
+        )
+    )
+    record("dag rpc 3-actor chain sync p50", rpc3_p50 * 1e6, "us")
+    with InputNode() as inp:
+        x = actors[0].step.bind(inp)
+        x = actors[1].step.bind(x)
+        x = actors[2].step.bind(x)
+    cd3 = x.experimental_compile(max_inflight_executions=inflight)
+    assert cd3.execute(0).get() == 0
+    dag3_p50 = sync_p50(lambda i: cd3.execute(i).get())
+    record("dag compiled 3-actor chain sync p50", dag3_p50 * 1e6, "us")
+    record(
+        "dag compiled vs rpc 3-actor latency", rpc3_p50 / max(dag3_p50, 1e-9), "x"
+    )
+    cd3.teardown()
+    from .core.actor import kill as _kill
+
+    for x in actors:
+        _kill(x)
+    if owns:
+        ca.shutdown()
+
+    # ---------------- phase 3: serve TTFT A/B -----------------------------
+    if not owns:
+        print("(serve TTFT A/B skipped: caller owns the cluster; the A/B "
+              "needs a fresh cluster per mode)")
+        return results
+    from . import serve
+    from .llm.processor import ProcessorConfig
+    from .llm.serve_llm import build_continuous_llm_deployment
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    host = "127.0.0.1"
+    mnt = 8 if quick else 16
+    n_req = 8 if quick else 16
+    prev = os.environ.get("CA_SERVE_COMPILED_DAG")
+    try:
+        for label, flag in (("rpc-stream", "0"), ("compiled", "1")):
+            # env-toggled BEFORE init so the proxy's process inherits it
+            os.environ["CA_SERVE_COMPILED_DAG"] = flag
+            ca.init(num_cpus=4)
+            port = free_port()
+            serve.start(host=host, port=port)
+            cfg = ProcessorConfig(max_prompt_len=64, max_new_tokens=mnt)
+            app = build_continuous_llm_deployment(
+                cfg, slots=4, num_replicas=1, sse_ingress=True,
+            )
+            serve.run(app, name="llmdag", route_prefix="/llmdag")
+            time.sleep(1.0)
+
+            def body(i: int) -> dict:
+                return {
+                    "prompt": f"request {i:04d} " + "x" * 16,
+                    "max_new_tokens": mnt,
+                }
+
+            for i in range(2):  # compile prefill/decode before timing
+                st, _, _, _ = _sse_request(host, port, "/llmdag", body(i))
+                assert st == 200, f"warmup request failed: HTTP {st}"
+            ttfts, events = [], 0
+            for i in range(n_req):
+                st, ttft, _, ne = _sse_request(host, port, "/llmdag", body(10 + i))
+                if st == 200 and ttft is not None:
+                    ttfts.append(ttft)
+                    events += ne
+            record(f"dag serve {label} TTFT p50", _pct(ttfts, 0.5) * 1e3, "ms")
+            record(f"dag serve {label} TTFT p99", _pct(ttfts, 0.99) * 1e3, "ms")
+            record(f"dag serve {label} events", float(events), "ev")
+            serve.delete("llmdag")
+            serve.shutdown()
+            ca.shutdown()
+    finally:
+        if prev is None:
+            os.environ.pop("CA_SERVE_COMPILED_DAG", None)
+        else:
+            os.environ["CA_SERVE_COMPILED_DAG"] = prev
+    return results
+
+
 def run_partition_chaos(quick: bool = False, seed: int = 1234) -> List[Tuple[str, float, str]]:
     """`ca microbenchmark --partition`: the partition-tolerance timeline.
 

@@ -160,6 +160,14 @@ class EnvRunner:
     def _act_t(self, actions):
         return self.act_pipe(actions) if self.act_pipe is not None else actions
 
+    def sync_sample(self, params, num_steps: int) -> Dict[str, np.ndarray]:
+        """Fused set_weights + sample for the compiled-DAG experience edge:
+        weights arrive through the DAG's input channel (one shm write,
+        broadcast to every runner) and the rollout leaves over this node's
+        tensor-transport output channel — no per-iteration RPCs."""
+        self.set_weights(params)
+        return self.sample(num_steps)
+
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
         """Collect num_steps per env. Returns flat [T*N, ...] arrays plus
         bootstrap values and episode metrics."""

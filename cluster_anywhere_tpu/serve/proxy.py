@@ -96,6 +96,10 @@ class ProxyActor:
         self._admission: Dict[str, _AdmissionState] = {}  # route_prefix ->
         self._routes_lock = threading.Lock()
         self._miss_lock = threading.Lock()
+        # deployment -> False once a dag_stream handshake failed (no such
+        # method, or a replica whose shm segment this proxy can't map);
+        # avoids paying a doomed extra RPC on every subsequent SSE request
+        self._dag_stream_ok: Dict[str, bool] = {}
         self._refresh_gen = 0
         self._loop = global_worker().loop
         self._server = None
@@ -392,6 +396,37 @@ class ProxyActor:
             if admitted is not None:
                 self._release(*admitted)
 
+    async def _open_stream(self, handle, req: Request, loop):
+        """Pick the token transport for one SSE request.
+
+        Compiled-DAG path (config.serve_compiled_dag, default on): ONE RPC
+        handshake asks the replica's `dag_stream` for a pre-opened shm
+        channel spec, then every token travels writer->futex->reader with
+        no RPC at all (see serve/dag_stream.py).  Falls back to the
+        per-token streaming-RPC path when the deployment has no dag_stream
+        method or the segment can't be mapped (cross-host replica), and
+        remembers the failure per deployment.
+        """
+        from ..core.config import get_config
+
+        dep_key = f"{handle.app}/{handle.deployment}"
+        if get_config().serve_compiled_dag and self._dag_stream_ok.get(dep_key, True):
+            try:
+                spec = await loop.run_in_executor(
+                    None,
+                    lambda: handle.options(method_name="dag_stream")
+                    .remote(req)
+                    .result(timeout_s=30),
+                )
+                from .dag_stream import open_dag_stream
+
+                return open_dag_stream(spec)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self._dag_stream_ok[dep_key] = False
+        return handle.options(stream=True).remote(req)
+
     async def _respond_sse(self, writer, handle, req: Request, loop, dep_tag=None):
         import json as _json
         import queue as _queue
@@ -404,7 +439,7 @@ class ProxyActor:
         q: _queue.Queue = _queue.Queue(maxsize=64)
         _END = object()
         abandoned = threading.Event()
-        resp_gen = handle.options(stream=True).remote(req)
+        resp_gen = await self._open_stream(handle, req, loop)
 
         def qput(item) -> bool:
             # abandonment-aware put: a dead consumer stops reading the

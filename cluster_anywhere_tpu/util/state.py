@@ -213,6 +213,31 @@ def transfer_plane() -> Dict[str, Any]:
     }
 
 
+def dag_plane() -> Dict[str, Any]:
+    """Compiled-DAG-plane summary: cluster-aggregated ca_dag_* counters
+    (executions/results, backpressure, the failure-semantics series —
+    timeouts, actor deaths, recompiles) and the ca_channel_* counters of the
+    shm transport underneath (writes/reads, spill-throughs, backpressure
+    waits) — the one-call view of the sub-millisecond hot path."""
+    from .metrics import get_metrics_snapshot
+
+    dag: Dict[str, int] = {}
+    channel: Dict[str, int] = {}
+    try:
+        for name, rec in get_metrics_snapshot().items():
+            if rec.get("type") != "counter":
+                continue
+            if name.startswith("ca_dag_"):
+                dag[name[len("ca_dag_"):]] = int(sum(rec.get("data", {}).values()))
+            elif name.startswith("ca_channel_"):
+                channel[name[len("ca_channel_"):]] = int(
+                    sum(rec.get("data", {}).values())
+                )
+    except Exception:
+        pass
+    return {"dag": dag, "channel": channel}
+
+
 def serve_plane() -> Dict[str, Any]:
     """Serving-plane summary: per-deployment target vs actual replicas,
     per-replica node/queue/draining state and the last autoscale decision

@@ -1,0 +1,237 @@
+"""Compiled-DAG hot-path hardening tests: teardown on actor death
+mid-execute (killed writer AND killed reader side), transparent recompile
+after restart, typed timeouts naming the stalled node, and the chaos proof
+that the compiled path never touches the lease plane."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu.dag import DagTimeoutError, DeadActorError, InputNode
+
+
+@ca.remote
+class Stage:
+    def __init__(self):
+        self.pid = os.getpid()
+
+    def whoami(self):
+        return os.getpid()
+
+    def step(self, x):
+        return x + 1
+
+    def slow(self, x):
+        time.sleep(2.0)
+        return x
+
+
+def _kill_actor_proc(handle):
+    pid = ca.get(handle.whoami.remote(), timeout=30)
+    os.kill(pid, signal.SIGKILL)
+    return pid
+
+
+def test_dead_writer_actor_raises_typed_error_no_hang(ca_cluster_module):
+    """Kill the output-producing actor while the driver blocks on its
+    channel: get() must surface DeadActorError naming the hosted nodes
+    within the death-poll granularity — never hang to the full timeout."""
+    a = Stage.remote()
+    with InputNode() as inp:
+        node = a.slow.bind(inp)
+    dag = node.experimental_compile(execute_timeout_s=60.0)
+    try:
+        ref = dag.execute(1)
+        _kill_actor_proc(a)
+        t0 = time.monotonic()
+        with pytest.raises(DeadActorError) as ei:
+            ref.get()
+        # bounded detection: well under the 60s execute timeout
+        assert time.monotonic() - t0 < 30.0
+        assert "slow" in str(ei.value)  # names the failed node's method
+        # the DAG is dead, not wedged: later calls raise the same typed error
+        with pytest.raises(DeadActorError):
+            dag.execute(2)
+    finally:
+        dag.teardown()
+
+
+def test_dead_reader_actor_unblocks_backpressured_execute(ca_cluster_module):
+    """Kill the input-consuming actor while execute() is blocked on input-
+    channel backpressure (max_inflight reached): the sliced write must
+    detect the death and raise DeadActorError instead of hanging."""
+    a = Stage.remote()
+    with InputNode() as inp:
+        node = a.slow.bind(inp)
+    dag = node.experimental_compile(
+        max_inflight_executions=1, execute_timeout_s=60.0
+    )
+    try:
+        dag.execute(1)  # actor now sleeps 2s inside slow()
+        t0 = time.monotonic()
+        _kill_actor_proc(a)
+        with pytest.raises(DeadActorError):
+            # inflight=1: this write backpressures until the (dead) reader
+            # acks — death detection must break the wait
+            for i in range(3):
+                dag.execute(10 + i)
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        dag.teardown()
+
+
+def test_actor_restart_recompile_resumes(ca_cluster_module):
+    """An actor with a restart budget dies mid-DAG; recompile() rebuilds
+    channels and loops against the restarted incarnation and the DAG
+    serves again."""
+    b = Stage.options(max_restarts=1).remote()
+    with InputNode() as inp:
+        node = b.step.bind(inp)
+    dag = node.experimental_compile(execute_timeout_s=60.0)
+    try:
+        assert dag.execute(1).get() == 2
+        old_pid = _kill_actor_proc(b)
+        with pytest.raises(DeadActorError):
+            dag.execute(2).get()
+        # wait for the supervisor to restart the actor before recompiling
+        deadline = time.monotonic() + 30
+        new_pid = None
+        while time.monotonic() < deadline:
+            try:
+                new_pid = ca.get(b.whoami.remote(), timeout=10)
+                if new_pid != old_pid:
+                    break
+            except Exception:
+                time.sleep(0.2)
+        assert new_pid is not None and new_pid != old_pid
+        dag.recompile()
+        assert dag.execute(3).get() == 4
+        from cluster_anywhere_tpu.dag import DAG_STATS
+
+        assert DAG_STATS["recompiles"] >= 1
+    finally:
+        dag.teardown()
+
+
+def test_dag_timeout_names_stalled_node(ca_cluster_module):
+    """A stalled tick surfaces as DagTimeoutError naming the node the
+    driver was waiting on, after the configured timeout — not a hang and
+    not a bare TimeoutError."""
+    a = Stage.remote()
+    with InputNode() as inp:
+        node = a.slow.bind(inp)  # sleeps 2s per tick
+    dag = node.experimental_compile(execute_timeout_s=0.5)
+    try:
+        ref = dag.execute(1)
+        t0 = time.monotonic()
+        with pytest.raises(DagTimeoutError) as ei:
+            ref.get()
+        dt = time.monotonic() - t0
+        assert 0.4 <= dt < 2.5
+        msg = str(ei.value)
+        assert "slow" in msg and "0.5" in msg
+        # the actor finishes its sleep and the late value is still readable:
+        # a timeout leaves the ref unconsumed, so get() can retry
+        assert ref.get(timeout=10) == 1
+    finally:
+        dag.teardown()
+
+
+def test_compiled_executes_skip_lease_plane_under_chaos(ca_cluster_module):
+    """Delay every lease RPC by 300ms (ca chaos delay on the lease plane):
+    compiled-DAG ticks stay fast because the hot path holds no leases and
+    issues no RPCs — while a fresh task submission visibly eats the delay.
+    The structural claim behind 'the driver leaves the RPC dispatch path'."""
+    from cluster_anywhere_tpu.core.protocol import reset_rpc_chaos
+
+    a = Stage.remote()
+    with InputNode() as inp:
+        node = a.step.bind(inp)
+    dag = node.experimental_compile(execute_timeout_s=60.0)
+    try:
+        assert dag.execute(0).get() == 1  # warm channels + loop
+        reset_rpc_chaos("", "request_lease=300")
+        try:
+            t0 = time.monotonic()
+            n = 50
+            for i in range(n):
+                assert dag.execute(i).get() == i + 1
+            per_tick = (time.monotonic() - t0) / n
+            # far under the injected delay: the compiled path never sends a
+            # lease RPC (one crossing would already cost 300ms)
+            assert per_tick < 0.1, f"compiled tick {per_tick:.3f}s under lease chaos"
+        finally:
+            reset_rpc_chaos("")
+    finally:
+        dag.teardown()
+
+
+def test_serve_compiled_dag_stream_end_to_end(ca_cluster_module):
+    """SSE through the proxy rides the compiled shm stream when the
+    deployment exposes dag_stream (one handshake RPC, then frames cross
+    writer->futex->reader): the proxy must deliver the channel frames, not
+    the RPC-stream generator's."""
+    import socket
+    import threading
+
+    from cluster_anywhere_tpu import serve
+    from cluster_anywhere_tpu.channel.shm_channel import (
+        BufferedShmChannel,
+        ChannelClosedError,
+    )
+    from cluster_anywhere_tpu.serve.dag_stream import DAG_EOF
+
+    @serve.deployment
+    class DualPath:
+        def __call__(self, req):
+            for i in range(4):
+                yield f"rpc{i}"  # only seen if the compiled path is skipped
+
+        def dag_stream(self, req):
+            ch = BufferedShmChannel(num_readers=1, num_buffers=4)
+
+            def forward():
+                try:
+                    for i in range(4):
+                        ch.write(f"dag{i}", timeout=30)
+                    ch.write(DAG_EOF, timeout=30)
+                    ch.wait_consumed(30.0)
+                except (ChannelClosedError, TimeoutError):
+                    pass
+                finally:
+                    ch.release()
+
+            threading.Thread(target=forward, daemon=True).start()
+            return ch.spec()
+
+    serve.run(DualPath.bind(), name="dagsse", route_prefix="/dagsse")
+    serve.start()
+    from cluster_anywhere_tpu.core.actor import get_actor
+
+    proxy = get_actor("SERVE_PROXY")
+    url = ca.get(proxy.ready.remote(), timeout=30)
+    host, port = url.replace("http://", "").split(":")
+    try:
+        s = socket.create_connection((host, int(port)), timeout=30)
+        s.sendall(
+            b"GET /dagsse HTTP/1.1\r\nHost: x\r\n"
+            b"Accept: text/event-stream\r\n\r\n"
+        )
+        s.settimeout(30)
+        buf = b""
+        while b"data: dag3" not in buf and b"data: rpc3" not in buf:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        s.close()
+        text = buf.decode()
+        assert "Content-Type: text/event-stream" in text
+        # compiled frames, not the RPC generator's
+        assert all(f"data: dag{i}" in text for i in range(4)), text
+        assert "rpc" not in text, text
+    finally:
+        serve.delete("dagsse")

@@ -364,6 +364,34 @@ def res_fixture(tmp_path, body):
     )
 
 
+def test_shm_channel_leak_fires_and_released_is_clean(tmp_path):
+    """A BufferedShmChannel that can leave the function without release()
+    (close() alone doesn't free the segment) is a leak; releasing on every
+    path is clean."""
+    report = res_fixture(tmp_path, """
+        def leaky(spec, flag):
+            ch = open_channel(spec, 0)
+            if flag:
+                return None         # early exit with the segment mapped
+            ch.release()
+            return True
+
+        def leaky_ctor(n):
+            ch = BufferedShmChannel(num_readers=n)
+            return None             # dropped without release
+
+        def clean(spec):
+            ch = open_channel(spec, 0)
+            try:
+                return ch.read(1.0)
+            finally:
+                ch.release()
+        """)
+    leaks = [f for f in report["findings"] if f.rule.startswith("res-leak")]
+    assert sorted({f.context for f in leaks}) == ["leaky", "leaky_ctor"]
+    assert not [f for f in report["findings"] if f.context == "clean"]
+
+
 def test_leak_on_raise_fires_and_finally_is_clean(tmp_path):
     report = res_fixture(tmp_path, """
         def leaky(p):
