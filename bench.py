@@ -540,6 +540,26 @@ def bench_chaos_plane():
     return out
 
 
+def bench_obsplane():
+    """Flight-recorder cost rows (armed record events/s, disabled-path gate
+    rate, journal memory at the default ring cap, task throughput with the
+    plane on vs off) as a BENCH-json block.  The structural claims: the
+    disabled path is one attribute load + branch (tens of ns), and the
+    on/off task-throughput ratio stays within host noise."""
+    from cluster_anywhere_tpu.microbenchmark import run_obsplane
+
+    rows = run_obsplane(quick=True)
+    out = {}
+    for name, value, _unit in rows:
+        key = (
+            name.replace("obsplane ", "").replace(" ", "_")
+            .replace("/", "_per_")
+        )
+        out[key] = round(value, 3)
+    log(f"obsplane: {out}")
+    return out
+
+
 def main():
     _, best_actor, _, logplane, drainplane, ownerplane, metricsplane = bench_core()
     transferplane = {}
@@ -567,6 +587,11 @@ def main():
         chaosplane = bench_chaos_plane()
     except Exception as e:
         log(f"chaos plane bench failed: {e!r}")
+    obsplane = {}
+    try:
+        obsplane = bench_obsplane()
+    except Exception as e:
+        log(f"obs plane bench failed: {e!r}")
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -596,6 +621,8 @@ def main():
         out["dagplane"] = dagplane
     if chaosplane:
         out["chaosplane"] = chaosplane
+    if obsplane:
+        out["obsplane"] = obsplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

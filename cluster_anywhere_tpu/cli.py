@@ -501,24 +501,42 @@ def cmd_list(args):
     ca.shutdown()
 
 
+def _render_log_trace(data: str) -> str:
+    """Pretty-print trace-filtered JSONL records as `[wid span] line`."""
+    out = []
+    for line in data.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        sid = (rec.get("trace") or {}).get("sid", "")
+        out.append(f"[{rec.get('wid', '?')} {sid}] {rec.get('line', '')}")
+    return "\n".join(out)
+
+
 def cmd_logs(args):
-    """`ca logs [<worker|task|actor|node|head>] [--tail N] [--follow]` —
-    reads/tails wherever the log lives: the head proxies cross-node reads
-    through the owning node's agent (no shared filesystem needed)."""
+    """`ca logs [<worker|task|actor|node|head>] [--tail N] [--follow]
+    [--trace <id>]` — reads/tails wherever the log lives: the head proxies
+    cross-node reads through the owning node's agent (no shared filesystem
+    needed).  `--trace` keeps only lines whose print site ran under that
+    trace id (span stamps from the structured capture)."""
     ca = _connect(args)
     from cluster_anywhere_tpu.core.worker import global_worker
 
     w = global_worker()
+    trace = getattr(args, "trace", None)
     failed = False
     try:
         try:
-            reply = w.head_call("log_fetch", id=args.worker_id, tail=args.tail)
+            reply = w.head_call(
+                "log_fetch", id=args.worker_id, tail=args.tail, trace=trace
+            )
         except (FileNotFoundError, RuntimeError, ConnectionError) as e:
             print(f"ca logs: {e}", file=sys.stderr)
             failed = True
             return
         if reply["data"]:
-            print(reply["data"])
+            print(_render_log_trace(reply["data"]) if trace else reply["data"])
         if not args.follow:
             return
         off = reply["off"]
@@ -526,7 +544,9 @@ def cmd_logs(args):
             while True:
                 time.sleep(0.3)
                 try:
-                    reply = w.head_call("log_fetch", id=args.worker_id, off=off)
+                    reply = w.head_call(
+                        "log_fetch", id=args.worker_id, off=off, trace=trace
+                    )
                 except FileNotFoundError:
                     continue  # rotated away: keep polling from the new file
                 except (RuntimeError, ConnectionError) as e:
@@ -534,7 +554,11 @@ def cmd_logs(args):
                     failed = True
                     return
                 if reply["data"]:
-                    sys.stdout.write(reply["data"])
+                    data = (
+                        _render_log_trace(reply["data"]) + "\n"
+                        if trace else reply["data"]
+                    )
+                    sys.stdout.write(data)
                     sys.stdout.flush()
                 off = reply["off"]
         except KeyboardInterrupt:
@@ -543,6 +567,87 @@ def cmd_logs(args):
         ca.shutdown()
         if failed:
             sys.exit(1)
+
+
+def _format_flight_event(e, t0=None):
+    """One journal line: `+12.345s node/proc plane:event {fields}`."""
+    ts = e.get("ts") or 0.0
+    rel = f"+{ts - t0:8.3f}s" if t0 is not None else time.strftime(
+        "%H:%M:%S", time.localtime(ts)
+    )
+    origin = f"{e.get('node') or '?'}/{e.get('proc') or '?'}"
+    tr = (e.get("trace") or {}).get("tid")
+    skip = {"ts", "seq", "plane", "event", "node", "proc", "trace"}
+    fields = " ".join(
+        f"{k}={v}" for k, v in e.items() if k not in skip
+    )
+    line = f"{rel}  {origin:24s} {e.get('plane', '?')}:{e.get('event', '?')}"
+    if fields:
+        line += f"  {fields}"
+    if tr:
+        line += f"  [trace {tr}]"
+    return line
+
+
+def cmd_events(args):
+    """`ca events [--trace <id>] [--plane <p>] [--node <n>]` — the head's
+    merged flight-recorder journal, newest-last."""
+    ca = _connect(args)
+    from cluster_anywhere_tpu.util import state
+
+    try:
+        r = state.flightrec_events(
+            trace=args.trace, plane=args.plane, node=args.node,
+            event=args.event, limit=args.limit,
+        )
+        if args.json:
+            print(json.dumps(r, indent=2, default=str))
+            return
+        evs = r.get("events", [])
+        if not r.get("enabled", True):
+            print("flight recorder disabled (flightrec_plane=False)")
+        print(f"== ca events: {len(evs)} shown / {r.get('total', 0)} in ring ==")
+        for e in evs:
+            print(_format_flight_event(e))
+    finally:
+        ca.shutdown()
+
+
+def cmd_incident(args):
+    """`ca incident` — reconstruct the causal cross-node timeline of the
+    recent window: every plane's decision events in time order, with
+    relative offsets from the first event (the incident trigger)."""
+    ca = _connect(args)
+    from cluster_anywhere_tpu.util import state
+
+    try:
+        r = state.incident(
+            trace=args.trace, plane=args.plane, node=args.node,
+            window_s=args.window, limit=args.limit,
+        )
+        if args.json:
+            print(json.dumps(r, indent=2, default=str))
+            return
+        evs = r.get("events", [])
+        if not r.get("enabled", True):
+            print("flight recorder disabled (flightrec_plane=False)")
+        if not evs:
+            print(f"no flight-recorder events in the last {args.window:g}s")
+            return
+        planes = ", ".join(
+            f"{p}={n}" for p, n in sorted(r.get("planes", {}).items())
+        )
+        print(
+            f"== ca incident: {len(evs)} events over {r.get('span_s', 0):.1f}s "
+            f"across {len(r.get('nodes', []))} node(s) =="
+        )
+        print(f"   planes: {planes}")
+        t0 = evs[0].get("ts") or 0.0
+        print(f"   t0 = {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(t0))}")
+        for e in evs:
+            print(_format_flight_event(e, t0=t0))
+    finally:
+        ca.shutdown()
 
 
 def _node_metrics_addr(args, node_id: str):
@@ -693,6 +798,14 @@ def cmd_top(args):
         ("head_leases_granted", "head leases/s"),
         ("head_rpc_messages_recv", "head RPC msg/s"),
         ("head_actor_restarts", "actor restarts/s"),
+        # post-PR-7 planes: compiled-DAG ticks, serve requests + sheds,
+        # train reports, transfer pulls, flight-recorder events
+        ("ca_dag_executions", "dag ticks/s"),
+        ("ca_serve_request_latency_seconds_count", "serve reqs/s"),
+        ("ca_serve_shed_total", "serve sheds/s"),
+        ("ca_train_preempt_restarts_total", "train preempts/s"),
+        ("ca_transfer_pulls", "transfer pulls/s"),
+        ("ca_flightrec_recorded", "flightrec ev/s"),
     ]
     gauge_rows = [
         ("head_n_workers", "workers"),
@@ -875,6 +988,13 @@ def cmd_microbenchmark(args):
 
         run_partition_chaos(quick=getattr(args, "quick", False))
         return
+    if getattr(args, "obsplane", False):
+        # owns its own clusters (flight-recorder cost model: armed record
+        # rate, disabled-path gate, journal memory, tasks/s on/off A/B)
+        from .microbenchmark import run_obsplane
+
+        run_obsplane(quick=getattr(args, "quick", False))
+        return
 
     import cluster_anywhere_tpu as ca
 
@@ -1039,7 +1159,45 @@ def main(argv=None):
         "--follow", "-f", action="store_true",
         help="keep streaming new lines (Ctrl-C to stop)",
     )
+    sp.add_argument(
+        "--trace", default=None, metavar="TRACE_ID",
+        help="only lines printed under this trace id (structured capture)",
+    )
     sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser(
+        "events",
+        help="flight recorder: cross-node control-plane decision events",
+    )
+    addr(sp)
+    sp.add_argument("--trace", default=None, help="filter by trace id")
+    sp.add_argument(
+        "--plane", default=None,
+        help="filter by plane (fence/drain/chaos/dag/serve/train/transfer/"
+        "ownership/node/actor)",
+    )
+    sp.add_argument("--node", default=None, help="filter by node id")
+    sp.add_argument("--event", default=None, help="filter by event substring")
+    sp.add_argument("--limit", type=int, default=200, help="newest N events")
+    sp.add_argument("--json", action="store_true", help="raw JSON output")
+    sp.set_defaults(fn=cmd_events)
+
+    sp = sub.add_parser(
+        "incident",
+        help="causal incident timeline from the flight recorder "
+        "(fence → cancel → heal → rejoin, cross-node)",
+    )
+    addr(sp)
+    sp.add_argument("--trace", default=None, help="follow one trace id")
+    sp.add_argument("--plane", default=None, help="restrict to one plane")
+    sp.add_argument("--node", default=None, help="restrict to one node")
+    sp.add_argument(
+        "--window", type=float, default=600.0,
+        help="look back this many seconds (default 600)",
+    )
+    sp.add_argument("--limit", type=int, default=2000)
+    sp.add_argument("--json", action="store_true", help="raw JSON output")
+    sp.set_defaults(fn=cmd_incident)
 
     sp = sub.add_parser("metrics", help="Prometheus metrics snapshot")
     addr(sp)
@@ -1166,6 +1324,11 @@ def main(argv=None):
         help="partition-tolerance chaos: head<->node blackhole mid-workload "
         "(detect->fence->heal timeline, at-most-once side effects, "
         "zombie-free rejoin at a fresh incarnation)",
+    )
+    sp.add_argument(
+        "--obsplane", action="store_true",
+        help="flight-recorder cost model: armed record events/s, disabled "
+        "gate rate, journal memory at cap, tasks/s with the plane on/off",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

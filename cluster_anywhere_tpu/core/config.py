@@ -162,6 +162,18 @@ class CAConfig:
     timeseries_max_series: int = 1024
     # event-loop lag self-measurement period for the head (seconds)
     loop_lag_period_s: float = 0.25
+    # --- flight recorder (util/flightrec.py) ---
+    # per-process bounded ring journal of plane decision events (fence
+    # mints/refusals, drain FSM transitions, netchaos firings, DAG
+    # recompiles/timeouts, serve shed/drain, train barrier phases, transfer
+    # failover, owner adoption), shipped head-ward on the metrics-delta
+    # path.  Off = util.flightrec.REC stays None and every record site is a
+    # single `is None` branch.
+    flightrec_plane: bool = True
+    # per-process ring capacity (drop-oldest beyond this)
+    flightrec_ring_len: int = 4096
+    # head-side merged journal capacity
+    flightrec_head_len: int = 50_000
     # deterministic RPC fault injection, modeled on the reference's
     # RAY_testing_rpc_failure (src/ray/rpc/rpc_chaos.h): "method=N" pairs,
     # failing the first N matching RPCs.

@@ -1,9 +1,26 @@
 """Exception hierarchy, mirroring the user-visible error surface of the
 reference (python/ray/exceptions.py): task errors wrap the remote traceback,
 actor errors mark dead actors, object-loss and timeout errors are distinct.
+
+Failure-class errors (FencedError, DeadActorError, DagTimeoutError,
+ObjectLostError) carry a flight-recorder slice: the raising process's recent
+decision events (`.flight_events`, plain picklable dicts), so the exception
+that reaches the driver brings its own black box — `ca incident` and plain
+repr-debugging both read it without another round trip to the cluster.
 """
 
 from __future__ import annotations
+
+
+def _flight_slice(plane=None):
+    """Recent flight-recorder events from THIS process ([] when the plane is
+    disabled).  Lazy import: errors must stay importable everywhere."""
+    try:
+        from ..util import flightrec
+
+        return flightrec.recent(32, plane=plane)
+    except Exception:
+        return []
 
 
 class CAError(Exception):
@@ -52,6 +69,7 @@ class DeadActorError(ActorDiedError):
     def __init__(self, actor_id: str, nodes: tuple = (), detail: str = ""):
         self.actor_id = actor_id
         self.nodes = tuple(nodes)
+        self.flight_events = _flight_slice(plane="dag")
         names = ", ".join(self.nodes) or "?"
         msg = (
             f"compiled-DAG actor {actor_id} died mid-execute "
@@ -71,6 +89,7 @@ class DagTimeoutError(CAError, TimeoutError):
         self.node = node
         self.timeout_s = timeout_s
         self.phase = phase
+        self.flight_events = _flight_slice(plane="dag")
         super().__init__(
             f"compiled-DAG {phase} timed out after {timeout_s:g}s waiting on "
             f"node {node}"
@@ -79,6 +98,10 @@ class DagTimeoutError(CAError, TimeoutError):
 
 class ObjectLostError(CAError):
     """Object data is unavailable and could not be recovered."""
+
+    def __init__(self, *args):
+        self.flight_events = _flight_slice()
+        super().__init__(*args)
 
 
 class GetTimeoutError(CAError, TimeoutError):
@@ -114,3 +137,7 @@ class FencedError(CAError):
     its outstanding leases and zombie tasks, tear down, and rejoin as a
     fresh incarnation — completing in-flight side effects would duplicate
     work the head already resubmitted elsewhere."""
+
+    def __init__(self, *args):
+        self.flight_events = _flight_slice(plane="fence")
+        super().__init__(*args)

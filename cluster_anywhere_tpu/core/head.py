@@ -389,6 +389,21 @@ class Head:
         # aggregated user metrics (MetricsAgent analogue)
         self.task_events: deque = deque(maxlen=50_000)
         self.metrics: Dict[str, dict] = {}  # name -> {type, desc, data{tags_key: ...}}
+        # flight recorder: cluster-merged journal of plane decision events.
+        # Worker/agent slices arrive piggybacked on metrics_report /
+        # node_sync; head-origin decisions mirror in via _log_event and the
+        # head's own recorder (netchaos etc. running in this process).
+        self.flightrec: deque = deque(
+            maxlen=int(getattr(config, "flightrec_head_len", 50_000))
+        )
+        self._flightrec_on = bool(getattr(config, "flightrec_plane", True))
+        if self._flightrec_on:
+            from ..util import flightrec as _flightrec
+
+            _flightrec.init(
+                cap=int(getattr(config, "flightrec_ring_len", 4096)),
+                node_id=LOCAL_NODE, proc="head",
+            )
         # metrics plane: time-series retention (ring buffers, two downsample
         # tiers) sampled off this table + head stats by the monitor loop, so
         # dashboards/`ca top` get rates and history without Prometheus
@@ -724,15 +739,57 @@ class Head:
                 except Exception as e:
                     self._log_event("snapshot_save_failed", error=repr(e))
 
+    # head event kind -> flight-recorder plane (prefix match, first wins);
+    # unmatched kinds file under "head"
+    _FLIGHTREC_PLANES = (
+        ("rpc_fenced", "fence"),
+        ("agent_register_fenced", "fence"),
+        ("node_readopted", "fence"),
+        ("net_chaos", "chaos"),
+        ("drain", "drain"),
+        ("node_drain", "drain"),
+        ("object_lost", "ownership"),
+        ("owners_adopted", "ownership"),
+        ("owner", "ownership"),
+        ("actor", "actor"),
+        ("node", "node"),
+        ("serve", "serve"),
+        ("train", "train"),
+        ("job", "job"),
+    )
+
     def _log_event(self, kind: str, **fields):
         import json as _json
 
+        ts = time.time()
+        if self._flightrec_on:
+            # mirror into the merged journal: head decisions and shipped
+            # worker slices interleave in one queryable ring
+            plane = "head"
+            for prefix, p in self._FLIGHTREC_PLANES:
+                if kind.startswith(prefix):
+                    plane = p
+                    break
+            self.flightrec.append(
+                {"ts": ts, "plane": plane, "event": kind, "node": LOCAL_NODE,
+                 "proc": "head", **fields}
+            )
         try:
             self._event_log.write(
-                _json.dumps({"ts": time.time(), "event": kind, **fields}) + "\n"
+                _json.dumps({"ts": ts, "event": kind, **fields}) + "\n"
             )
         except Exception:
             pass
+
+    def _ingest_flightrec(self, evs) -> None:
+        """Merge a shipped journal slice (metrics_report / node_sync
+        piggyback) into the cluster ring.  Slices from different nodes
+        interleave by arrival; queries sort by timestamp."""
+        if not evs or not self._flightrec_on:
+            return
+        for ev in evs:
+            if isinstance(ev, dict):
+                self.flightrec.append(ev)
 
     # ---------------------------------------------------------------- utils
     def _pub(self, channel: str, data: dict):
@@ -2122,7 +2179,7 @@ class Head:
             "client_addr", "lease_dir",
             "list_actors", "list_workers", "list_task_events", "list_objects",
             "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
-            "get_actor", "task_events", "metrics_report",
+            "get_actor", "task_events", "metrics_report", "flightrec",
             "log_sub", "log_batch", "log_fetch", "timeseries", "profile",
         }
     )
@@ -2478,6 +2535,8 @@ class Head:
                 from ..util.metrics import merge_metric_records
 
                 merge_metric_records(self.metrics, msg["metrics"])
+            if "flightrec" in msg:
+                self._ingest_flightrec(msg["flightrec"])
 
     async def _h_node_sync(self, state, msg, reply, reply_err):
         """Delta-synced node state (the ray_syncer analogue, head-ward):
@@ -2510,6 +2569,10 @@ class Head:
             from ..util.metrics import merge_metric_records
 
             merge_metric_records(self.metrics, msg["metrics"])
+        if "flightrec" in msg:
+            # flight-recorder piggyback: the node's queued journal slices
+            # (workers + agent) merge into the cluster ring the same way
+            self._ingest_flightrec(msg["flightrec"])
 
     async def _h_owner_sync(self, state, msg, reply, reply_err):
         """An owner's ledger digest (versioned delta, or full on reconnect):
@@ -2902,11 +2965,17 @@ class Head:
         return (rec.node_id, rec.worker_id)
 
     async def _log_fetch_data(self, ident, tail: int = 200, off=None,
-                              structured: bool = False) -> dict:
+                              structured: bool = False,
+                              trace: Optional[str] = None) -> dict:
         """Read/tail a log wherever it lives: local files directly, other
-        nodes through their agent's log_read RPC (no shared filesystem)."""
+        nodes through their agent's log_read RPC (no shared filesystem).
+        `trace` filters to lines stamped with that trace id (log records
+        carry the ambient span of the code that printed them) — it implies
+        the structured JSONL read, since the raw capture has no stamps."""
         from ..util.logplane import node_log_dir, tail_file
 
+        if trace:
+            structured = True
         node_id, name = self._resolve_log_target(ident)
         if node_id == LOCAL_NODE:
             if structured:
@@ -2923,6 +2992,8 @@ class Head:
                 raise FileNotFoundError(
                     f"no log for {ident!r} yet (expected at {path})"
                 )
+            if trace:
+                data = self._filter_log_trace(data, trace)
             return {"data": data, "off": new_off, "node_id": node_id}
         node = self.nodes.get(node_id)
         if node is None or not node.up or node.conn is None or node.conn.closed:
@@ -2940,7 +3011,23 @@ class Head:
             raise RuntimeError(
                 f"node {node_id!r} (owner of {ident!r}) stopped answering"
             )
-        return {"data": r["data"], "off": r["off"], "node_id": node_id}
+        out = {"data": r["data"], "off": r["off"], "node_id": node_id}
+        if trace:
+            out["data"] = self._filter_log_trace(out["data"], trace)
+        return out
+
+    @staticmethod
+    def _filter_log_trace(data: str, trace: str) -> str:
+        """Keep only JSONL records stamped with this trace id."""
+        kept = []
+        for line in data.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (rec.get("trace") or {}).get("tid") == trace:
+                kept.append(line)
+        return "\n".join(kept) + ("\n" if kept else "")
 
     def _log_counter_totals(self) -> Dict[str, int]:
         """Cluster-wide ca_log_* capture counters summed from the metrics
@@ -2962,6 +3049,7 @@ class Head:
                 tail=int(msg.get("tail") or 200),
                 off=msg.get("off"),
                 structured=bool(msg.get("structured")),
+                trace=msg.get("trace"),
             )
         except (FileNotFoundError, RuntimeError, ValueError) as e:
             reply_err(e)
@@ -3909,6 +3997,46 @@ class Head:
         from ..util.metrics import merge_metric_records
 
         merge_metric_records(self.metrics, msg.get("metrics"))
+        self._ingest_flightrec(msg.get("flightrec"))
+
+    def _flightrec_query(
+        self, *, trace=None, plane=None, node=None, event=None,
+        since=None, limit=1000,
+    ) -> Dict[str, Any]:
+        """Filter/sort the cluster-merged flight-recorder journal.  Shared
+        by the `flightrec` RPC and the dashboard's /api/flightrec route."""
+        events = list(self.flightrec)
+        if trace:
+            events = [
+                e for e in events if (e.get("trace") or {}).get("tid") == trace
+            ]
+        if plane:
+            events = [e for e in events if e.get("plane") == plane]
+        if node:
+            events = [e for e in events if e.get("node") == node]
+        if event:
+            events = [e for e in events if event in (e.get("event") or "")]
+        if since is not None:
+            events = [e for e in events if e.get("ts", 0) >= float(since)]
+        events.sort(key=lambda e: e.get("ts", 0))
+        limit = int(limit)
+        if limit and len(events) > limit:
+            events = events[-limit:]
+        return {
+            "events": events, "total": len(self.flightrec),
+            "enabled": self._flightrec_on,
+        }
+
+    async def _h_flightrec(self, state, msg, reply, reply_err):
+        """Flight-recorder query: the cluster-merged decision journal,
+        filtered by trace id / plane / node / event substring / since-ts,
+        sorted by timestamp.  Backs `ca events`, `ca incident`,
+        `util.state.flightrec_events`, and dashboard /api/flightrec."""
+        reply(**self._flightrec_query(
+            trace=msg.get("trace"), plane=msg.get("plane"),
+            node=msg.get("node"), event=msg.get("event"),
+            since=msg.get("since"), limit=msg.get("limit", 1000),
+        ))
 
     async def _h_metrics_snapshot(self, state, msg, reply, reply_err):
         reply(metrics=self.metrics)
@@ -4229,9 +4357,16 @@ class Head:
 
     async def _monitor_loop(self):
         period = self.config.health_check_period_s
+        from ..util import flightrec as _flightrec
+
         while not self._shutdown.is_set():
             await asyncio.sleep(min(period, 0.2))
             now = time.monotonic()
+            if self._flightrec_on and _flightrec.REC is not None:
+                # head-process recorder (netchaos and other shared code
+                # running here) drains straight into the merged ring — the
+                # head is its own aggregator, no piggyback needed
+                self._ingest_flightrec(_flightrec.REC.drain())
             if (
                 self.timeseries is not None
                 and now - self._last_ts_sample

@@ -286,6 +286,16 @@ class NetworkChaos:
             self.events.append(
                 ("down" if down else "up", src, dst, round(t, 3))
             )
+            # flight recorder: the schedule firing, with the seed so a chaos
+            # incident timeline can be replayed from the journal alone
+            from ..util import flightrec
+
+            if flightrec.REC is not None:
+                flightrec.REC.record(
+                    "chaos", "link_down" if down else "link_up",
+                    src=src, dst=dst, t_rel=round(t, 3),
+                    seed=self.seed, spec=self.spec,
+                )
         return down
 
     def frame_delay(self, src: Optional[str], dst: Optional[str]) -> float:

@@ -263,6 +263,17 @@ class NodeAgent:
         }
         self._http_server = None
         self.metrics_addr = None
+        # flight recorder: the agent journals its own decisions (fence
+        # resets, drain handling) and forwards workers' journal slices
+        # head-ward on the same node_sync piggyback as metric deltas
+        self._flightrec_pending: list = []
+        if getattr(self.config, "flightrec_plane", True):
+            from ..util import flightrec
+
+            flightrec.init(
+                cap=getattr(self.config, "flightrec_ring_len", 4096),
+                node_id=self.node_id, proc="agent",
+            )
 
     # --------------------------------------------------------------- workers
     def _spawn_worker(self, wid: str, purpose: str, pool: str) -> None:
@@ -443,6 +454,17 @@ class NodeAgent:
                     f"node {self.node_id}: metrics head-ship queue full, "
                     f"dropped {over} oldest delta records",
                 )
+            # flight-recorder piggyback: worker journal slices queue for the
+            # next node_sync tick, bounded with the same drop-oldest policy
+            frev = msg.get("flightrec") or []
+            if frev:
+                from ..util.flightrec import FLIGHTREC_STATS
+
+                self._flightrec_pending.extend(frev)
+                over = len(self._flightrec_pending) - RESTAGE_CAP
+                if over > 0:
+                    del self._flightrec_pending[:over]
+                    FLIGHTREC_STATS["dropped"] += over
         elif m == "profile":
             # sampling profiler relay target: profile THIS agent process
             # (workers serve their own `profile`; the head resolves routing)
@@ -635,11 +657,16 @@ class NodeAgent:
                     )
                     if pending:
                         hb["metrics"] = pending
+                    frp = self._take_pending_flightrec()
+                    if frp:
+                        hb["flightrec"] = frp
                     try:
                         self.head.notify("node_heartbeat", **self._auth(hb))
                     except Exception:
                         if pending:
                             self._restage_pending_metrics(pending)
+                        if frp:
+                            self._restage_pending_flightrec(frp)
                         raise
             except Exception:
                 pass
@@ -663,6 +690,27 @@ class NodeAgent:
     def _take_pending_metrics(self) -> list:
         pending, self._metrics_pending = self._metrics_pending, []
         return pending
+
+    def _take_pending_flightrec(self) -> list:
+        """Queued worker journal slices plus this agent's own unshipped
+        events, in arrival order (the agent's recorder drains here — agents
+        run no metrics flusher of their own)."""
+        from ..util import flightrec
+
+        pending, self._flightrec_pending = self._flightrec_pending, []
+        if flightrec.REC is not None:
+            pending.extend(flightrec.REC.drain())
+        return pending
+
+    def _restage_pending_flightrec(self, evs: list) -> None:
+        from ..util.flightrec import FLIGHTREC_STATS
+        from ..util.metrics import RESTAGE_CAP
+
+        self._flightrec_pending[:0] = evs
+        over = len(self._flightrec_pending) - RESTAGE_CAP
+        if over > 0:
+            del self._flightrec_pending[:over]
+            FLIGHTREC_STATS["dropped"] += over
 
     def _restage_pending_metrics(self, records: list) -> None:
         """A head send failed after the queue was drained: put the records
@@ -711,6 +759,9 @@ class NodeAgent:
         pending = self._take_pending_metrics() if self._metrics_pending else []
         if pending:
             extra["metrics"] = pending
+        frp = self._take_pending_flightrec()
+        if frp:
+            extra["flightrec"] = frp
         try:
             if d is None:
                 self.head.notify("node_sync", node_id=self.node_id, **extra)
@@ -719,6 +770,8 @@ class NodeAgent:
         except Exception:
             if pending:
                 self._restage_pending_metrics(pending)
+            if frp:
+                self._restage_pending_flightrec(frp)
             raise
 
     async def _log_ship_loop(self):
@@ -828,8 +881,14 @@ class NodeAgent:
             return
         self._fencing = True
         try:
+            from ..util import flightrec
             from .ownership import warn_ratelimited
 
+            if flightrec.REC is not None:
+                flightrec.REC.record(
+                    "fence", "fence_reset",
+                    incarnation=self.incarnation, n_workers=len(self.procs),
+                )
             warn_ratelimited(
                 "agent-fenced",
                 f"node {self.node_id} incarnation {self.incarnation} was "

@@ -856,6 +856,18 @@ class Worker:
         # network-chaos plane: per-link partition/straggler injection (spec
         # from config at start; runtime `ca chaos set` arrives as pushes)
         netchaos.maybe_install_from_config(self.config, self.node_id)
+        # flight recorder: journal this process's plane decisions; slices
+        # ship on the metrics-delta piggyback (util/metrics.flush_once)
+        if getattr(self.config, "flightrec_plane", True):
+            from ..util import flightrec, metrics as _metrics
+
+            flightrec.init(
+                cap=getattr(self.config, "flightrec_ring_len", 4096),
+                node_id=self.node_id, proc=self.client_id,
+            )
+            # the journal ships on the metrics flush: arm the flusher now —
+            # a process that never mints a Metric must still ship its events
+            _metrics._ensure_flusher()
         # log plane: lazily-built printer for log_batch pushes (drivers
         # subscribed via log_sub; see util/logplane.DriverLogPrinter)
         self._log_printer = None
@@ -1126,6 +1138,13 @@ class Worker:
             return
         window = float(data.get("deadline_s") or 0.0) + self._DRAIN_GRACE_S
         self._draining_nodes[nid] = time.monotonic() + window
+        from ..util import flightrec
+
+        if flightrec.REC is not None:
+            flightrec.REC.record(
+                "drain", "drain_pub", target_node=nid,
+                reason=data.get("reason"), deadline_s=data.get("deadline_s"),
+            )
         # steer new local grants away: the cached lease directory may name
         # the draining agent for up to a TTL — drop it now
         ts, entries = self._lease_dir_cache
@@ -1150,6 +1169,12 @@ class Worker:
         if self._head_fenced:
             return
         self._head_fenced = True
+        from ..util import flightrec
+
+        if flightrec.REC is not None:
+            flightrec.REC.record(
+                "fence", "fenced", client_id=self.client_id,
+            )
         cb = self._on_fenced_cb
         if cb is not None:
             try:
@@ -3099,7 +3124,12 @@ class Worker:
         oid = ObjectID(oid_b)
         local_name, mv = self.shm_store.create_for_import(oid, total)
         try:
-            await self._pull_into(oid_b, mv, total, reply)
+            # cross-plane tracing: the pull is a span under whatever task
+            # is waiting on it (no-op without an ambient trace)
+            from ..util import tracing as _tracing
+
+            with _tracing.span(f"transfer:pull:{oid_b.hex()[:8]}"):
+                await self._pull_into(oid_b, mv, total, reply)
         except BaseException:
             mv.release()
             self.shm_store.abort_import(local_name)  # aborted pull: reclaim
@@ -3182,6 +3212,14 @@ class Worker:
                 # already drained them or a re-locate round picks them up
                 last_err = errs[0]
                 TRANSFER_STATS["source_failovers"] += 1
+                from ..util import flightrec
+
+                if flightrec.REC is not None:
+                    flightrec.REC.record(
+                        "transfer", "source_failover", oid=oid_b.hex(),
+                        source=src.get("addr"), error=repr(errs[0]),
+                        chunks_left=len(pending),
+                    )
 
         stalled = 0
         rounds = 0

@@ -23,6 +23,7 @@ from ..channel.shm_channel import (
     open_channel,
 )
 from ..core.errors import DagTimeoutError, DeadActorError
+from ..util import flightrec
 from .node import (
     ClassMethodNode,
     DAGNode,
@@ -50,6 +51,24 @@ DAG_STATS = {
 # surfaces promptly, long enough that a healthy tick never pays for it (the
 # futex read wakes on publish, not at the slice boundary)
 _DEATH_POLL_S = 0.2
+
+
+class _TraceEnv:
+    """Trace context riding a channel payload (tentpole: span id in channel
+    meta).  The driver wraps the input payload with its ambient context, each
+    actor re-wraps its cross-process writes with its own tick span, so a
+    compiled-DAG tick renders in `ca timeline` as one connected trace.  Only
+    minted while a trace is active — untraced ticks ship bare payloads and
+    pay nothing but one isinstance on the read side."""
+
+    __slots__ = ("tr", "value")
+
+    def __init__(self, tr, value):
+        self.tr = tr
+        self.value = value
+
+    def __reduce__(self):
+        return (_TraceEnv, (self.tr, self.value))
 
 
 class _DagError:
@@ -118,6 +137,12 @@ def _dag_actor_loop(instance, schedule: List[tuple], node_ops: Dict[int, dict],
 
         return pack_device_value(v)
 
+    try:
+        from ..util import tracing as _trc
+    except Exception:  # pragma: no cover — tracing must never kill the loop
+        _trc = None
+    import time as _time
+
     ticks = 0
     try:
         while True:
@@ -125,6 +150,8 @@ def _dag_actor_loop(instance, schedule: List[tuple], node_ops: Dict[int, dict],
             tick_vals: Dict[int, Any] = {}
             err: Optional[_DagError] = None
             closed = False
+            tick_tok = None  # trace token: set by the first enveloped read
+            tick_t0 = 0.0
 
             def resolve(spec):
                 kind, ref = spec
@@ -147,6 +174,14 @@ def _dag_actor_loop(instance, schedule: List[tuple], node_ops: Dict[int, dict],
                         # block without deadline: teardown closes the channel
                         # to wake us
                         v = readers[ref].read(None)
+                        if isinstance(v, _TraceEnv):
+                            # channel meta: adopt the upstream trace for this
+                            # tick (first envelope wins) before touching the
+                            # payload, so tensor landing runs inside the span
+                            if tick_tok is None and _trc is not None:
+                                tick_tok = _trc.push_execution(v.tr)
+                                tick_t0 = _time.time()
+                            v = v.value
                         if ref in tensor_chans and not isinstance(v, _DagError):
                             try:
                                 v = _to_device(v)
@@ -186,10 +221,32 @@ def _dag_actor_loop(instance, schedule: List[tuple], node_ops: Dict[int, dict],
                             except BaseException as e:  # noqa: BLE001 — surfaced to driver
                                 out = _DagError(e)
                                 err = err or out
+                        if tick_tok is not None:
+                            # re-wrap under THIS actor's tick span: the next
+                            # hop (actor or driver) parents on it, chaining
+                            # the channel ops into one causal trace
+                            cur = _trc.current()
+                            if cur is not None:
+                                out = _TraceEnv(
+                                    {"tid": cur["tid"], "sid": cur["sid"]}, out
+                                )
                         writers[ref].write(out, timeout)
                 except ChannelClosedError:
                     closed = True
                     break
+            if tick_tok is not None:
+                if not closed:
+                    cur = _trc.current()
+                    w = _trc._current_worker()
+                    _trc.record_task_event(
+                        "", "dag:tick", "span", "SPAN",
+                        trace=cur,
+                        worker_id=w.client_id if w is not None else None,
+                        node_id=w.node_id if w is not None else None,
+                        start=tick_t0,
+                        end=_time.time(),
+                    )
+                _trc.pop_execution(tick_tok)
             if closed:
                 break
             ticks += 1
@@ -493,6 +550,14 @@ class CompiledDAG:
             ca.get(ref)
         except BaseException as e:  # noqa: BLE001 — folded into the typed error
             detail = repr(e)
+        # record BEFORE constructing the error: DeadActorError snapshots the
+        # recent dag-plane events into .flight_events, and this one is the
+        # root cause the incident view must lead with
+        if flightrec.REC is not None:
+            flightrec.REC.record(
+                "dag", "dag_actor_death", actor=key, detail=detail,
+                nodes=list(self._actor_nodes.get(key, ())),
+            )
         err = DeadActorError(key, self._actor_nodes.get(key, ()), detail)
         DAG_STATS["actor_deaths"] += 1
         self._dead = err
@@ -508,7 +573,10 @@ class CompiledDAG:
     # ---------------------------------------------------------------- execute
 
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        import contextlib
         import time as _time
+
+        from ..util import tracing as _trc
 
         self._raise_if_unusable()
         if self._input_node is not None:
@@ -518,26 +586,45 @@ class CompiledDAG:
 
                 payload = pack_device_value(payload)
             chan = self._channels[self._INPUT_ID]
-            deadline = _time.monotonic() + self._timeout
-            waited = False
-            # sliced write: at max_inflight the input channel blocks on the
-            # slowest reader's ack (backpressure); slicing keeps actor death
-            # from turning that into a silent hang
-            while True:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    DAG_STATS["timeouts"] += 1
-                    raise DagTimeoutError(
-                        "InputNode (backpressure)", self._timeout, phase="execute"
+            # trace propagation (tentpole): the input write carries the
+            # driver's span in the channel meta; actor ticks parent on it.
+            # Untraced path: one contextvar read + one branch.
+            traced = _trc.is_enabled() or _trc.current() is not None
+            span_cm = (
+                _trc.span("dag:execute") if traced
+                else contextlib.nullcontext(None)
+            )
+            with span_cm as sctx:
+                if sctx is not None:
+                    payload = _TraceEnv(
+                        {"tid": sctx["tid"], "sid": sctx["sid"]}, payload
                     )
-                try:
-                    chan.write(payload, min(_DEATH_POLL_S, remaining))
-                    break
-                except TimeoutError:
-                    if not waited:
-                        waited = True
-                        DAG_STATS["backpressure_waits"] += 1
-                    self._check_loops()
+                deadline = _time.monotonic() + self._timeout
+                waited = False
+                # sliced write: at max_inflight the input channel blocks on
+                # the slowest reader's ack (backpressure); slicing keeps
+                # actor death from turning that into a silent hang
+                while True:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        DAG_STATS["timeouts"] += 1
+                        if flightrec.REC is not None:
+                            flightrec.REC.record(
+                                "dag", "dag_timeout", node="InputNode",
+                                phase="execute", timeout_s=self._timeout,
+                            )
+                        raise DagTimeoutError(
+                            "InputNode (backpressure)", self._timeout,
+                            phase="execute",
+                        )
+                    try:
+                        chan.write(payload, min(_DEATH_POLL_S, remaining))
+                        break
+                    except TimeoutError:
+                        if not waited:
+                            waited = True
+                            DAG_STATS["backpressure_waits"] += 1
+                        self._check_loops()
         DAG_STATS["executions"] += 1
         ref = CompiledDAGRef(self, self._exec_seq)
         self._exec_seq += 1
@@ -564,15 +651,21 @@ class CompiledDAG:
             # returns a value that is already published (poll semantics)
             remaining = max(0.0, deadline - _time.monotonic())
             try:
-                return reader.read(min(_DEATH_POLL_S, remaining))
+                v = reader.read(min(_DEATH_POLL_S, remaining))
+                if isinstance(v, _TraceEnv):
+                    v = v.value  # driver consumes; trace ends here
+                return v
             except TimeoutError:
                 self._check_loops()
                 if _time.monotonic() >= deadline:
                     DAG_STATS["timeouts"] += 1
-                    raise DagTimeoutError(
-                        f"{self._node_methods.get(nid, '?')} (node {nid})",
-                        timeout_s,
-                    ) from None
+                    node = f"{self._node_methods.get(nid, '?')} (node {nid})"
+                    if flightrec.REC is not None:
+                        flightrec.REC.record(
+                            "dag", "dag_timeout", node=node, phase="read",
+                            timeout_s=timeout_s,
+                        )
+                    raise DagTimeoutError(node, timeout_s) from None
 
     def _read_result(self, seq: int, timeout: Optional[float]):
         import time as _time
@@ -648,6 +741,11 @@ class CompiledDAG:
         self._read_seq = 0
         self._result_cache = {}
         DAG_STATS["recompiles"] += 1
+        if flightrec.REC is not None:
+            flightrec.REC.record(
+                "dag", "dag_recompile", actors=len(self._handles),
+                channels=len(self._channels),
+            )
         self._compile()
 
     def __del__(self):

@@ -12,6 +12,7 @@ Endpoints:
   GET /api/tasks      recent task events
   GET /api/pgs        placement groups
   GET /api/serve      serving plane (replica targets, drain, last autoscale)
+  GET /api/flightrec  flight-recorder journal (trace/plane/node/event filters)
   GET /metrics        Prometheus text (user + runtime metrics)
 
 Zero extra process: the head owns every table locally, so requests are
@@ -60,6 +61,8 @@ th { color: #9aa5b1; font-weight: 600; }
 <h2>Placement groups</h2><table id="pgs"></table>
 <h2>Task timeline <span id="tlaxis"></span></h2><div id="tl"></div>
 <h2>Recent tasks</h2><table id="tasks"></table>
+<h2>Flight recorder <span id="frstats" style="color:#9aa5b1;font-weight:400"></span></h2>
+<table id="flightrec"></table>
 <h2>Logs <select id="logsel"><option value="">(choose a process)</option></select>
 <span id="logstats"></span></h2>
 <pre id="logview" style="background:#161b22;border:1px solid #2a3038;padding:8px;
@@ -206,6 +209,8 @@ function spark(label, pts, unit) {
     '<div style="font-size:12px" class="ok">' + cur + " " + unit + "</div></div>";
 }
 async function refreshSparks() {
+  // one sparkline per plane: core scheduling + the post-PR-7 planes
+  // (dag / serve / train / transfer) + the flight recorder itself
   const names = [
     ["head_tasks_pushed", "tasks/s", 1],
     ["head_objects_created", "obj/s", 1],
@@ -213,27 +218,54 @@ async function refreshSparks() {
     ["ca_head_loop_lag_seconds", "ms lag", 0],
     ["head_nodes_draining", "draining", 0],
     ["ca_owner_owner_gc", "owner gc/s", 1],
+    ["ca_dag_executions", "dag ticks/s", 1, "dag_executions"],
+    ["ca_serve_request_latency_seconds_count", "req/s", 1, "serve_requests"],
+    ["ca_serve_shed_total", "shed/s", 1, "serve_shed"],
+    ["ca_train_preempt_restarts_total", "preempt/s", 1, "train_preempts"],
+    ["ca_transfer_pulls", "pulls/s", 1, "transfer_pulls"],
+    ["ca_flightrec_recorded", "ev/s", 1, "flightrec_events"],
   ];
   const r = await (await fetch("/api/timeseries?rate=1&names=" +
     names.map(n => n[0]).join(","))).json();
   if (r.meta && r.meta.disabled) return;
   let html = "";
-  names.forEach(([n, unit, isRate]) => {
+  names.forEach(([n, unit, isRate, label]) => {
     const tagged = r.series[n];
     if (!tagged) return;
     let pts = Object.values(tagged)[0].points;
     if (n === "ca_head_loop_lag_seconds") pts = pts.map(p => [p[0], p[1]*1000]);
-    if (pts.length > 1) html += spark(n.replace(/^head_|^ca_head_/, ""), pts, unit);
+    if (pts.length > 1)
+      html += spark(label || n.replace(/^head_|^ca_head_/, ""), pts, unit);
   });
   document.getElementById("sparks").innerHTML = html;
   document.getElementById("tsmeta").textContent =
     (r.meta.n_series||0) + " series, " +
     ((r.meta.memory_bytes||0)/1024).toFixed(0) + " KiB retained";
 }
+async function refreshFlight() {
+  const r = await (await fetch("/api/flightrec?limit=25")).json();
+  document.getElementById("frstats").textContent = r.enabled
+    ? " " + (r.total||0) + " events retained"
+    : " (disabled: flightrec_plane=false)";
+  const evs = (r.events||[]).slice().reverse();
+  document.getElementById("flightrec").innerHTML =
+    row(["time", "node/proc", "event", "detail", "trace"], "th") +
+    evs.map(e => {
+      const extra = Object.entries(e)
+        .filter(([k]) => !["ts","seq","plane","event","node","proc","trace"].includes(k))
+        .map(([k, v]) => k + "=" + (typeof v === "object" ? JSON.stringify(v) : v))
+        .join(" ");
+      return row([new Date(e.ts * 1000).toLocaleTimeString(),
+        esc((e.node||"") + (e.proc ? "/" + e.proc : "")),
+        esc(e.plane + ":" + e.event), esc(extra.slice(0, 120)),
+        esc(e.trace ? e.trace.tid : "")]);
+    }).join("");
+}
 document.getElementById("logsel").addEventListener("change", refreshLogs);
 refresh(); setInterval(refresh, 2000);
 refreshLogs(); setInterval(refreshLogs, 3000);
 refreshSparks(); setInterval(refreshSparks, 5000);
+refreshFlight(); setInterval(refreshFlight, 4000);
 </script></body></html>"""
 
 
@@ -471,6 +503,19 @@ class Dashboard:
                 **h._log_counter_totals(),
             }
             return self._json(out)
+        if path == "/api/flightrec":
+            # flight-recorder journal: cluster-merged decision events with
+            # the same filters as the `flightrec` head RPC / `ca events`
+            return self._json(
+                h._flightrec_query(
+                    trace=params.get("trace") or None,
+                    plane=params.get("plane") or None,
+                    node=params.get("node") or None,
+                    event=params.get("event") or None,
+                    since=float(params["since"]) if params.get("since") else None,
+                    limit=int(params.get("limit", 200)),
+                )
+            )
         if path == "/metrics":
             from .util.metrics import render_prometheus
 

@@ -23,6 +23,7 @@ from typing import Any, Dict, List
 
 from ..core import api as ca
 from ..core.actor import get_actor, kill
+from ..util import flightrec
 from .config import DeploymentConfig, DeploymentStatus
 from .replica import Replica
 
@@ -388,6 +389,13 @@ class ServeController:
                 for rid in newly:
                     st.draining_marked[rid] = now
             self._bump_version(st)
+            if flightrec.REC is not None:
+                flightrec.REC.record(
+                    "serve", "serve_replica_draining", deployment=st.key(),
+                    replicas=sorted(newly),
+                    nodes=sorted({st.replica_nodes.get(r) for r in newly
+                                  if st.replica_nodes.get(r)}),
+                )
 
     def _bump_version(self, st: _DeploymentState):
         with self._lock:
@@ -433,6 +441,11 @@ class ServeController:
                 st.replica_nodes.pop(rid, None)
         if dead:
             self._bump_version(st)
+            if flightrec.REC is not None:
+                flightrec.REC.record(
+                    "serve", "serve_replica_dead", deployment=st.key(),
+                    replicas=dead,
+                )
         changed = False
         # replacements FIRST: spawn until the ACTIVE (non-draining) count
         # reaches target.  Draining replicas keep serving their in-flight
@@ -474,6 +487,13 @@ class ServeController:
                 if t.get("node_id"):
                     st.replica_nodes[rid] = t["node_id"]
             changed = True
+            if flightrec.REC is not None:
+                # replacement or migration target: pairs with the draining /
+                # dead event that caused it in the incident timeline
+                flightrec.REC.record(
+                    "serve", "serve_replica_started", deployment=st.key(),
+                    replica=rid, node=t.get("node_id"),
+                )
         # normal downscale: retire surplus ACTIVE replicas (draining ones
         # are on their own retirement track below)
         while len(st.active_rids()) > st.target:
@@ -484,6 +504,11 @@ class ServeController:
                 st.replica_nodes.pop(rid, None)
             self._retire_replica(st, rid, h)
             changed = True
+            if flightrec.REC is not None:
+                flightrec.REC.record(
+                    "serve", "serve_replica_retired", deployment=st.key(),
+                    replica=rid, reason="downscale",
+                )
         # drain retirement: once replacements are up, retire each draining
         # replica when its last in-flight request (including SSE streams)
         # finishes.  The grace window matters: routers only refresh on-route
@@ -508,6 +533,13 @@ class ServeController:
                     st.replica_nodes.pop(rid, None)
                 self._retire_replica(st, rid, h)
                 changed = True
+                if flightrec.REC is not None:
+                    # zero-drop migration complete: last in-flight request
+                    # finished, replacements carried the traffic
+                    flightrec.REC.record(
+                        "serve", "serve_replica_retired", deployment=st.key(),
+                        replica=rid, reason="drained",
+                    )
         if changed:
             self._bump_version(st)
         st.status = (
@@ -562,6 +594,12 @@ class ServeController:
                 "to": decided[2],
                 "avg_ongoing": round(avg, 3),
             }
+            if flightrec.REC is not None:
+                flightrec.REC.record(
+                    "serve", "serve_autoscale", deployment=st.key(),
+                    direction=decided[0], from_replicas=decided[1],
+                    to_replicas=decided[2], avg_ongoing=round(avg, 3),
+                )
 
 
 def get_or_create_controller():

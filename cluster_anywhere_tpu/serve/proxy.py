@@ -13,6 +13,8 @@ import traceback
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
+from ..util import flightrec
+from ..util import tracing as _tracing
 from ..util.aio import drain, spawn_logged
 
 _proxy_metrics = {}
@@ -342,6 +344,23 @@ class ProxyActor:
 
     async def _dispatch(self, req: Request, writer: asyncio.StreamWriter):
         admitted = None
+        # cross-plane trace (tentpole): adopt the client's W3C traceparent
+        # header, or mint a root when tracing is enabled — the request span
+        # parents every downstream task/stream, so `ca timeline` renders
+        # proxy -> replica -> channel ops as one connected trace
+        tr_in = _tracing.parse_traceparent(req.headers.get("traceparent"))
+        if tr_in is not None:
+            tr_req = {
+                "tid": tr_in["tid"], "sid": _tracing.new_span_id(),
+                "psid": tr_in["sid"],
+            }
+        elif _tracing.is_enabled():
+            tr_req = {"tid": _tracing.new_trace_id(), "sid": _tracing.new_span_id()}
+        else:
+            tr_req = None
+        wire = {"tid": tr_req["tid"], "sid": tr_req["sid"]} if tr_req else None
+        tr_hdr = {"traceparent": _tracing.format_traceparent(wire)} if wire else None
+        t0 = time.time()
         try:
             match = self._match(req.path)
             if match is None:
@@ -365,24 +384,43 @@ class ProxyActor:
                 # queueing unboundedly — past the saturation knee a bounded
                 # queue is the only way p99 stays bounded
                 _shed_metrics()["shed"].inc(1, tags={**dep_tag, "reason": s.reason})
+                if flightrec.REC is not None:
+                    flightrec.REC.record(
+                        "serve", "serve_shed",
+                        deployment=dep_tag["deployment"], reason=s.reason,
+                        code=s.code, limit=s.limit, path=req.path,
+                        **({"trace": wire} if wire else {}),
+                    )
                 await self._respond(
                     writer, s.code,
                     {"error": "request shed", "reason": s.reason, "limit": s.limit},
-                    extra_headers={"Retry-After": f"{s.retry_after:g}"},
+                    extra_headers={"Retry-After": f"{s.retry_after:g}", **(tr_hdr or {})},
                 )
                 return
             loop = asyncio.get_running_loop()
             if "text/event-stream" in req.headers.get("accept", ""):
                 # SSE: iterate the deployment's generator, one event per item
                 # (reference proxy StreamingResponse path; LLM token streams)
-                await self._respond_sse(writer, handle, req, loop, dep_tag)
+                await self._respond_sse(
+                    writer, handle, req, loop, dep_tag, wire=wire, tr_hdr=tr_hdr
+                )
                 return
+
             # handle.remote() blocks briefly (routing) and result() blocks
-            # until done — run both off the event loop
-            result = await loop.run_in_executor(
-                None, lambda: handle.remote(req).result(timeout_s=60)
-            )
-            await self._respond(writer, 200, result)
+            # until done — run both off the event loop.  run_in_executor does
+            # NOT propagate contextvars, so the request trace is installed
+            # inside the worker thread, around the submission.
+            def _call():
+                if wire is None:
+                    return handle.remote(req).result(timeout_s=60)
+                tok = _tracing.push_execution(wire)
+                try:
+                    return handle.remote(req).result(timeout_s=60)
+                finally:
+                    _tracing.pop_execution(tok)
+
+            result = await loop.run_in_executor(None, _call)
+            await self._respond(writer, 200, result, extra_headers=tr_hdr)
         except asyncio.CancelledError:
             try:
                 writer.close()
@@ -395,8 +433,17 @@ class ProxyActor:
         finally:
             if admitted is not None:
                 self._release(*admitted)
+            if tr_req is not None:
+                w = _tracing._current_worker()
+                _tracing.record_task_event(
+                    "", f"serve:{req.method} {req.path}", "span", "SPAN",
+                    trace=tr_req,
+                    worker_id=w.client_id if w is not None else None,
+                    node_id=w.node_id if w is not None else None,
+                    start=t0, end=time.time(),
+                )
 
-    async def _open_stream(self, handle, req: Request, loop):
+    async def _open_stream(self, handle, req: Request, loop, wire=None):
         """Pick the token transport for one SSE request.
 
         Compiled-DAG path (config.serve_compiled_dag, default on): ONE RPC
@@ -409,14 +456,32 @@ class ProxyActor:
         """
         from ..core.config import get_config
 
+        def _traced(fn):
+            # executor threads start with a fresh context: install the
+            # request trace around the submission so the replica-side spans
+            # chain under the proxy's span
+            if wire is None:
+                return fn
+
+            def wrapped():
+                tok = _tracing.push_execution(wire)
+                try:
+                    return fn()
+                finally:
+                    _tracing.pop_execution(tok)
+
+            return wrapped
+
         dep_key = f"{handle.app}/{handle.deployment}"
         if get_config().serve_compiled_dag and self._dag_stream_ok.get(dep_key, True):
             try:
                 spec = await loop.run_in_executor(
                     None,
-                    lambda: handle.options(method_name="dag_stream")
-                    .remote(req)
-                    .result(timeout_s=30),
+                    _traced(
+                        lambda: handle.options(method_name="dag_stream")
+                        .remote(req)
+                        .result(timeout_s=30)
+                    ),
                 )
                 from .dag_stream import open_dag_stream
 
@@ -425,21 +490,26 @@ class ProxyActor:
                 raise
             except Exception:
                 self._dag_stream_ok[dep_key] = False
-        return handle.options(stream=True).remote(req)
+        return await loop.run_in_executor(
+            None, _traced(lambda: handle.options(stream=True).remote(req))
+        )
 
-    async def _respond_sse(self, writer, handle, req: Request, loop, dep_tag=None):
+    async def _respond_sse(self, writer, handle, req: Request, loop, dep_tag=None,
+                           wire=None, tr_hdr=None):
         import json as _json
         import queue as _queue
 
+        extras = "".join(f"{k}: {v}\r\n" for k, v in (tr_hdr or {}).items())
         writer.write(
             b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
-            b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
+            b"Cache-Control: no-cache\r\n" + extras.encode()
+            + b"Connection: close\r\n\r\n"
         )
         await drain(writer)
         q: _queue.Queue = _queue.Queue(maxsize=64)
         _END = object()
         abandoned = threading.Event()
-        resp_gen = await self._open_stream(handle, req, loop)
+        resp_gen = await self._open_stream(handle, req, loop, wire=wire)
 
         def qput(item) -> bool:
             # abandonment-aware put: a dead consumer stops reading the
@@ -496,6 +566,15 @@ class ProxyActor:
                     _shed_metrics()["abandoned"].inc(
                         1, tags=dep_tag or {"deployment": f"{handle.app}/{handle.deployment}"}
                     )
+                    if flightrec.REC is not None:
+                        flightrec.REC.record(
+                            "serve", "serve_stream_abandoned",
+                            deployment=(dep_tag or {}).get(
+                                "deployment", f"{handle.app}/{handle.deployment}"
+                            ),
+                            path=req.path,
+                            **({"trace": wire} if wire else {}),
+                        )
                     return
         except asyncio.CancelledError:
             # proxy shutdown: stop the upstream too, then stay cancelled

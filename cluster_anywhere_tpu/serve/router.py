@@ -10,6 +10,7 @@ version goes stale.
 
 from __future__ import annotations
 
+import contextvars
 import random
 import threading
 import time
@@ -382,12 +383,19 @@ class DeploymentHandle:
             "method": self._method,
             "multiplexed_model_id": self._multiplexed_model_id,
         }
+        # the dispatch thread starts with an empty context: carry the
+        # caller's contextvars (ambient trace, log attribution) across so
+        # the replica call joins the request's trace instead of losing it
+        # at the thread hop
+        ctx = contextvars.copy_context()
         if self._stream:
             fut = self._router._dispatch.submit(
-                self._router.route_streaming, meta, args, kwargs
+                ctx.run, self._router.route_streaming, meta, args, kwargs
             )
             return DeploymentResponseGenerator(fut)
-        fut = self._router._dispatch.submit(self._router.route, meta, args, kwargs)
+        fut = self._router._dispatch.submit(
+            ctx.run, self._router.route, meta, args, kwargs
+        )
         return DeploymentResponse(fut)
 
     def to_spec(self) -> Dict[str, str]:

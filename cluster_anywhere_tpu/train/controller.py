@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..util import flightrec
 from .backend_executor import BackendExecutor
 from .checkpoint import Checkpoint, CheckpointManager
 from .config import (
@@ -232,6 +233,13 @@ class TrainController:
         timeout = self.run_config.failure_config.preempt_barrier_timeout_s
         deadline = time.monotonic() + timeout
         accepted = executor.request_checkpoint()
+        if flightrec.REC is not None:
+            flightrec.REC.record(
+                "train", "train_preempt_barrier", phase="requested",
+                run=self.experiment_name, attempt=self._attempt,
+                accepted=sum(bool(a) for a in accepted), ranks=len(accepted),
+                timeout_s=timeout,
+            )
         if not any(accepted):
             # no rank had a running session to barrier on (the warning
             # raced group bring-up, or every loop already returned):
@@ -260,6 +268,12 @@ class TrainController:
                 acked = True
                 break
             time.sleep(self.poll_interval_s)
+        if flightrec.REC is not None:
+            flightrec.REC.record(
+                "train", "train_preempt_barrier",
+                phase=("acked" if acked else "rank_died" if died else "timeout"),
+                run=self.experiment_name, attempt=self._attempt,
+            )
         if acked:
             TRAIN_STATS["preempt_barrier_acked_total"] += 1
         elif not died:
@@ -310,6 +324,11 @@ class TrainController:
         self._attempt = attempt
         self._world_size = n
         self._publish_digest(force=True)
+        if flightrec.REC is not None:
+            flightrec.REC.record(
+                "train", "train_attempt_start", run=self.experiment_name,
+                attempt=attempt, world_size=n,
+            )
 
         def _kind() -> FailureKind:
             gang = set(executor.worker_node_ids())
@@ -372,6 +391,12 @@ class TrainController:
                     # the next step boundary and rebuild BEFORE the kill
                     TRAIN_STATS["preempt_restarts_total"] += 1
                     self._preempt_restarts += 1
+                    if flightrec.REC is not None:
+                        flightrec.REC.record(
+                            "train", "train_preempt_detected",
+                            run=self.experiment_name, attempt=attempt,
+                            draining_nodes=gang_draining,
+                        )
                     self._preempt_barrier(executor)
                     return (
                         FailureKind.PREEMPTION,

@@ -14,11 +14,14 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+import time
 import uuid
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+from ..util import flightrec
+from ..util import tracing as _tracing
 from .checkpoint import Checkpoint
 
 
@@ -80,6 +83,9 @@ class _Session:
         # driver's keep-K eviction of the old attempt's entry delete the new
         # attempt's data
         self.attempt_token = uuid.uuid4().hex[:8]
+        # step-span clock: report() boundaries delimit train:step spans in
+        # `ca timeline` (the loop itself is user code we cannot wrap)
+        self._step_t0 = time.time()
 
     def report(
         self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None
@@ -106,6 +112,7 @@ class _Session:
                     os.makedirs(os.path.dirname(dest), exist_ok=True)
                     shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
                 entry["checkpoint_path"] = dest
+        barrier_ack = False
         with self.lock:
             self.reports.append(entry)
             self.report_seq += 1
@@ -118,6 +125,33 @@ class _Session:
                 # it tears the group down on the strength of that ack
                 self.ckpt_request.clear()
                 self.ckpt_acked = True
+                barrier_ack = True
+        if barrier_ack and flightrec.REC is not None:
+            # rank-side half of the preemption barrier: pairs with the
+            # controller's train_preempt_barrier phases in `ca incident`
+            flightrec.REC.record(
+                "train", "train_ckpt_barrier_ack",
+                rank=self.context.world_rank, seq=entry["seq"],
+                attempt=getattr(self.context, "attempt", None),
+            )
+        now = time.time()
+        tr = _tracing.current()
+        if tr is not None or _tracing.is_enabled():
+            ctx = (
+                {"tid": tr["tid"], "sid": _tracing.new_span_id(), "psid": tr["sid"]}
+                if tr is not None
+                else {"tid": _tracing.new_trace_id(), "sid": _tracing.new_span_id()}
+            )
+            w = _tracing._current_worker()
+            _tracing.record_task_event(
+                "", f"train:step:{entry['seq']}", "span", "SPAN",
+                trace=ctx,
+                worker_id=w.client_id if w is not None else None,
+                node_id=w.node_id if w is not None else None,
+                start=self._step_t0, end=now,
+                rank=self.context.world_rank,
+            )
+        self._step_t0 = now
 
     def drain_reports(self) -> List[Dict[str, Any]]:
         with self.lock:

@@ -275,6 +275,24 @@ def _logplane_records() -> List[dict]:
     return _counter_deltas("ca_log_", LOG_STATS, _logplane_shipped, _LOGPLANE_DESCS)
 
 
+_flightrec_shipped: Dict[str, int] = {}
+_FLIGHTREC_DESCS = {
+    "recorded": "flight-recorder decision events journaled by this process",
+    "dropped": "flight-recorder events rotated out of the bounded ring",
+    "shipped": "flight-recorder events shipped head-ward (metrics piggyback)",
+}
+
+
+def _flightrec_records() -> List[dict]:
+    """Flight-recorder health counters (util/flightrec.py FLIGHTREC_STATS)
+    as ca_flightrec_* records: journal volume plus ring-drop accounting."""
+    from .flightrec import FLIGHTREC_STATS
+
+    return _counter_deltas(
+        "ca_flightrec_", FLIGHTREC_STATS, _flightrec_shipped, _FLIGHTREC_DESCS
+    )
+
+
 # drained-but-unsent records: a send that fails after the drain (head closed
 # or unreachable in the window between drain and notify) re-stages its batch
 # here instead of losing the deltas; the next flush ships them first so
@@ -379,14 +397,26 @@ def flush_once():
     batch.extend(_drain_records())
     batch.extend(_train_records())
     batch.extend(_logplane_records())
+    batch.extend(_flightrec_records())
     batch.extend(_metrics_records())
-    if not batch:
+    # flight-recorder piggyback: the journal's unshipped slice rides the
+    # metrics_report this flush already sends (zero new standalone RPCs); a
+    # failed send rewinds the recorder's ship cursor alongside _restage
+    from . import flightrec as _fr
+
+    frev = _fr.REC.drain() if _fr.REC is not None else []
+    if not batch and not frev:
         return
+
+    def _restage_all():
+        _restage(batch)
+        if frev and _fr.REC is not None:
+            _fr.REC.restage(frev)
 
     async def _send_agent():
         try:
             conn = await w.conn_to(agent_addr)
-            conn.notify("metrics_report", metrics=batch)
+            conn.notify("metrics_report", metrics=batch, flightrec=frev)
             METRICS_STATS["agent_shipped"] += len(batch)
         except asyncio.CancelledError:
             raise  # shutdown: drop the batch rather than re-route it
@@ -397,15 +427,15 @@ def flush_once():
 
     def _send_head():
         if w.head is None or w.head.closed:
-            _restage(batch)
+            _restage_all()
             return
         try:
-            w.head.notify("metrics_report", metrics=batch)
+            w.head.notify("metrics_report", metrics=batch, flightrec=frev)
             METRICS_STATS["head_shipped"] += len(batch)
         except Exception:
             # head died between drain and send: the deltas are already out of
             # the metric objects — re-stage them or they are lost for good
-            _restage(batch)
+            _restage_all()
 
     def _send():
         if agent_addr is not None:

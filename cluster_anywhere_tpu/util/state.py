@@ -388,6 +388,67 @@ def metrics_plane() -> Dict[str, Any]:
     }
 
 
+# ------------------------------------------------------------- flight recorder
+
+
+def flightrec_events(
+    *,
+    trace: Optional[str] = None,
+    plane: Optional[str] = None,
+    node: Optional[str] = None,
+    event: Optional[str] = None,
+    since: Optional[float] = None,
+    limit: int = 1000,
+) -> Dict[str, Any]:
+    """The head's merged flight-recorder journal: per-process decision
+    events (fence mints/refusals, drain FSM transitions, netchaos firings,
+    DAG recompiles/timeouts, serve shed/drain/migration, train preemption
+    barriers, transfer failovers, owner adoption), shipped on the metrics
+    piggyback and merged into one ts-ordered cluster ring.  Filters:
+    `trace` (trace id), `plane`, `node`, `event` (substring), `since`
+    (epoch seconds).  Returns {"events", "total", "enabled"}."""
+    return _head(
+        "flightrec", trace=trace, plane=plane, node=node, event=event,
+        since=since, limit=limit,
+    )
+
+
+def incident(
+    *,
+    trace: Optional[str] = None,
+    node: Optional[str] = None,
+    plane: Optional[str] = None,
+    window_s: float = 600.0,
+    limit: int = 2000,
+) -> Dict[str, Any]:
+    """Reconstruct a causal incident timeline from the flight recorder: the
+    last `window_s` of decision events across every node and plane, ordered
+    by time, with per-plane counts and the node set involved — the view that
+    turns 'the job failed' into 'blackhole → fence → cancel → heal →
+    rejoin'.  Filter to one `trace` to follow a single request/job."""
+    import time as _time
+
+    since = (_time.time() - window_s) if window_s else None
+    r = flightrec_events(
+        trace=trace, node=node, plane=plane, since=since, limit=limit
+    )
+    evs = r.get("events", [])
+    planes: Dict[str, int] = defaultdict(int)
+    nodes = set()
+    for e in evs:
+        planes[e.get("plane") or "?"] += 1
+        if e.get("node"):
+            nodes.add(e["node"])
+    return {
+        "events": evs,
+        "planes": dict(planes),
+        "nodes": sorted(nodes),
+        "span_s": (evs[-1]["ts"] - evs[0]["ts"]) if len(evs) > 1 else 0.0,
+        "total": r.get("total", len(evs)),
+        "enabled": r.get("enabled", True),
+    }
+
+
 # ------------------------------------------------------------------ timeline
 
 _PHASE_ORDER = {
@@ -558,6 +619,30 @@ def timeline(
             }
         )
 
+    # flight-recorder instants: control-plane decisions (fence, drain, shed,
+    # recompile, chaos windows) as instant markers on their origin process's
+    # lane, so causal context lines up with the spans it explains
+    try:
+        fr = _head("flightrec", limit=min(limit, 5000)).get("events", [])
+    except Exception:
+        fr = []
+    for e in fr:
+        if e.get("ts") is None:
+            continue
+        pid = pid_of(e.get("proc") or e.get("node") or "flightrec")
+        events.append(
+            {
+                "name": f"{e.get('plane', '?')}:{e.get('event', '?')}",
+                "cat": "flightrec",
+                "ph": "i",
+                "s": "p",
+                "ts": e["ts"] * 1e6,
+                "pid": pid,
+                "tid": 1,
+                "args": {k: v for k, v in e.items() if k != "ts"},
+            }
+        )
+
     # process-name metadata so Perfetto shows client ids, not bare pids
     for proc, pid in pids.items():
         events.append(
@@ -620,6 +705,8 @@ __all__ = [
     "metrics_plane",
     "timeseries",
     "profile",
+    "flightrec_events",
+    "incident",
     "timeline",
     "get_log",
     "get_log_records",

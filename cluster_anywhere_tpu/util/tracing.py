@@ -198,6 +198,45 @@ def pop_execution(token) -> None:
     _ctx.reset(token)
 
 
+# --------------------------------------------------------- W3C traceparent
+# Serve HTTP requests carry trace context as a standard `traceparent`
+# header (https://www.w3.org/TR/trace-context/): 00-<32hex>-<16hex>-<flags>.
+# Internal ids are shorter (16-hex trace, 8-hex span) so formatting
+# zero-pads; parsing keeps the incoming ids verbatim — ids are opaque
+# strings everywhere in this codebase, so an externally-minted 32-hex trace
+# id flows through tasks, spans and the flight recorder unchanged.
+def format_traceparent(tr: Dict[str, str]) -> str:
+    tid = (tr.get("tid") or "")[:32].ljust(32, "0")
+    sid = (tr.get("sid") or "")[:16].ljust(16, "0")
+    return f"00-{tid}-{sid}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Dict[str, str]]:
+    """Parse an incoming traceparent into a wire context {"tid", "sid"}
+    (the receiving side parents on "sid", exactly like a task's tr field).
+    Returns None on anything malformed — a bad header is not an error,
+    just an untraced request."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    tid, sid = parts[1], parts[2]
+    try:
+        int(tid, 16), int(sid, 16)
+    except ValueError:
+        return None
+    if int(tid, 16) == 0 or int(sid, 16) == 0:
+        return None
+    # strip the zero-padding format_traceparent added so internally-minted
+    # ids round-trip to their native width
+    if tid.endswith("0" * 16) and int(tid[:16], 16):
+        tid = tid[:16]
+    if sid.endswith("0" * 8) and int(sid[:8], 16):
+        sid = sid[:8]
+    return {"tid": tid, "sid": sid}
+
+
 # ------------------------------------------------------------------ enable
 def enable():
     """Idempotently enable tracing: trace-context generation + propagation,

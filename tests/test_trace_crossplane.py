@@ -1,0 +1,212 @@
+"""Cross-plane trace propagation (flight-recorder tentpole, part 2): a
+serve HTTP request carrying a W3C `traceparent` header must echo the header
+back AND surface as one connected trace — proxy request span, replica task
+events — under the client's trace id; an SSE stream does the same through
+the streaming path; and a compiled-DAG execute under tracing links the
+driver's `dag:execute` span to the actor-loop `dag:tick` spans through the
+channel-meta trace envelope."""
+
+import http.client
+import socket
+import time
+
+import pytest
+
+import cluster_anywhere_tpu as ca
+from cluster_anywhere_tpu import serve
+from cluster_anywhere_tpu.core.worker import global_worker
+from cluster_anywhere_tpu.dag import InputNode
+from cluster_anywhere_tpu.util import tracing
+
+HOST = "127.0.0.1"
+
+# externally-minted W3C ids: 32-hex trace (wider than the internal 16-hex
+# format — must flow through verbatim), 16-hex parent span
+EXT_TID = "deadbeefcafef00d" * 2
+EXT_SID = "c0ffee11aa55bb77"
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind((HOST, 0))
+        return s.getsockname()[1]
+
+
+PORT = _free_port()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def traced_serve_cluster():
+    if ca.is_initialized():
+        ca.shutdown()
+    tracing.enable()
+    ca.init(num_cpus=8)
+    serve.start(host=HOST, port=PORT)
+    yield
+    ca.shutdown()
+    tracing.disable()
+
+
+def _get(path, headers=None, stream=False):
+    """One HTTP GET; returns (status, resp_headers_dict, body_bytes)."""
+    conn = http.client.HTTPConnection(HOST, PORT, timeout=30)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        r = conn.getresponse()
+        body = r.read()
+        return r.status, {k.lower(): v for k, v in r.getheaders()}, body
+    finally:
+        conn.close()
+
+
+def _events_with_tid(tid, timeout=20.0, need=lambda evs: bool(evs)):
+    """Poll the head's task-event ring for events under one trace id."""
+    w = global_worker()
+    deadline = time.monotonic() + timeout
+    got = []
+    while time.monotonic() < deadline:
+        evs = w.head_call("list_task_events", limit=50_000)["events"]
+        got = [e for e in evs if (e.get("trace") or {}).get("tid") == tid]
+        if need(got):
+            return got
+        time.sleep(0.25)
+    return got
+
+
+def test_serve_request_traceparent_roundtrip_and_connected_trace():
+    """An incoming traceparent is adopted (not re-minted), echoed on the
+    response, and the request renders as proxy span + replica task events
+    under the SAME externally-minted trace id."""
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            return {"path": request.path}
+
+    serve.run(Echo.bind(), name="traceapp", route_prefix="/traceapp")
+    time.sleep(1.0)  # proxy route poller
+    try:
+        hdr = f"00-{EXT_TID}-{EXT_SID}-01"
+        st, rh, body = _get("/traceapp", headers={"traceparent": hdr})
+        assert st == 200, body
+        # response carries the trace onward: same trace id, fresh span id
+        tp = rh.get("traceparent")
+        assert tp is not None, f"no traceparent echoed: {rh}"
+        parsed = tracing.parse_traceparent(tp)
+        assert parsed is not None and parsed["tid"] == EXT_TID, tp
+        assert parsed["sid"] != EXT_SID  # proxy minted its own span
+
+        def connected(evs):
+            spans = [e for e in evs if e.get("state") == "SPAN"]
+            tasks = [e for e in evs if e.get("task_id")]
+            return any(
+                (e.get("name") or "").startswith("serve:GET /traceapp")
+                for e in spans
+            ) and bool(tasks)
+
+        evs = _events_with_tid(EXT_TID, need=connected)
+        assert connected(evs), f"trace not connected: {evs}"
+        # the replica-side execution joined the client's trace
+        names = {e.get("name") for e in evs if e.get("task_id")}
+        assert any(n for n in names), names
+    finally:
+        serve.delete("traceapp")
+
+
+def test_serve_sse_stream_traced_end_to_end():
+    """An SSE request under a traceparent streams its events AND appears in
+    the head ring as a `serve:GET` span plus replica-side events sharing
+    the trace id — the proxy -> replica -> stream chain is one trace."""
+    tid = "5eeb1e55" * 4  # 32-hex, distinct from EXT_TID
+
+    @serve.deployment
+    class Tokens:
+        def __call__(self, request):
+            for i in range(5):
+                yield {"token": i}
+
+    serve.run(Tokens.bind(), name="ssetrace", route_prefix="/ssetrace")
+    time.sleep(1.0)
+    try:
+        hdr = {
+            "traceparent": f"00-{tid}-{EXT_SID}-01",
+            "accept": "text/event-stream",
+        }
+        st, rh, body = _get("/ssetrace", headers=hdr)
+        assert st == 200, body
+        assert body.count(b"data:") >= 5, body
+        tp = tracing.parse_traceparent(rh.get("traceparent"))
+        assert tp is not None and tp["tid"] == tid, rh
+
+        def connected(evs):
+            spans = [
+                e for e in evs
+                if e.get("state") == "SPAN"
+                and (e.get("name") or "").startswith("serve:GET /ssetrace")
+            ]
+            others = [e for e in evs if e not in spans]
+            return bool(spans) and bool(others)
+
+        evs = _events_with_tid(tid, need=connected)
+        assert connected(evs), f"SSE trace not connected: {evs}"
+    finally:
+        serve.delete("ssetrace")
+
+
+@ca.remote
+class Stage:
+    def step(self, x):
+        return x + 1
+
+
+def test_compiled_dag_execute_and_tick_share_one_trace():
+    """dag.execute under tracing mints a `dag:execute` span whose context
+    rides the input channel meta; the actor loop adopts it and records a
+    `dag:tick` span — both land in the head ring under one trace id."""
+    a = Stage.remote()
+    with InputNode() as inp:
+        node = a.step.bind(inp)
+    dag = node.experimental_compile(execute_timeout_s=60.0)
+    try:
+        before = {
+            (e.get("trace") or {}).get("tid")
+            for e in global_worker().head_call(
+                "list_task_events", limit=50_000)["events"]
+            if e.get("name") == "dag:execute"
+        }
+        assert dag.execute(1).get() == 2
+
+        def one_trace():
+            evs = global_worker().head_call(
+                "list_task_events", limit=50_000)["events"]
+            ex = [
+                e for e in evs
+                if e.get("name") == "dag:execute"
+                and (e.get("trace") or {}).get("tid") not in before
+            ]
+            for e in ex:
+                tid = (e.get("trace") or {}).get("tid")
+                if tid and any(
+                    t.get("name") == "dag:tick"
+                    and (t.get("trace") or {}).get("tid") == tid
+                    for t in evs
+                ):
+                    return e, tid
+            return None
+
+        deadline = time.monotonic() + 20
+        found = None
+        while time.monotonic() < deadline and found is None:
+            found = one_trace()
+            if found is None:
+                time.sleep(0.25)
+        assert found is not None, "dag:execute and dag:tick never shared a tid"
+        # the tick span ran on the actor's worker, not the driver
+        _, tid = found
+        evs = _events_with_tid(tid)
+        ticks = [e for e in evs if e.get("name") == "dag:tick"]
+        execs = [e for e in evs if e.get("name") == "dag:execute"]
+        assert ticks and execs
+        assert ticks[0].get("worker_id") != execs[0].get("worker_id")
+    finally:
+        dag.teardown()
