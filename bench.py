@@ -560,6 +560,25 @@ def bench_obsplane():
     return out
 
 
+def bench_ha_plane():
+    """Head-failover rows (SIGKILL the active head with a warm standby
+    subscribed: detect->promote->first-op latency, acked-KV loss, duplicate
+    side effects, epoch bump) as a BENCH-json block.  The structural claims
+    are loss = 0 and dup = 0; the failover latencies are host-noisy
+    context."""
+    from cluster_anywhere_tpu.microbenchmark import run_ha_plane
+
+    rows = run_ha_plane(quick=True)
+    out = {}
+    for name, value, _unit in rows:
+        key = (
+            name.replace("ha ", "").replace("->", "_to_").replace(" ", "_")
+        )
+        out[key] = round(value, 3)
+    log(f"haplane: {out}")
+    return out
+
+
 def main():
     _, best_actor, _, logplane, drainplane, ownerplane, metricsplane = bench_core()
     transferplane = {}
@@ -592,6 +611,11 @@ def main():
         obsplane = bench_obsplane()
     except Exception as e:
         log(f"obs plane bench failed: {e!r}")
+    haplane = {}
+    try:
+        haplane = bench_ha_plane()
+    except Exception as e:
+        log(f"ha plane bench failed: {e!r}")
     if _device_probe_ok():
         model_skip = bench_model()
     else:
@@ -623,6 +647,8 @@ def main():
         out["chaosplane"] = chaosplane
     if obsplane:
         out["obsplane"] = obsplane
+    if haplane:
+        out["haplane"] = haplane
     if model_skip is not None:
         # the skip reason travels in the json, not just stderr: a missing
         # model row must be distinguishable from a never-attempted one

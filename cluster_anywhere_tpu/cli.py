@@ -924,6 +924,105 @@ def cmd_dashboard(args):
     print(open(path).read().strip())
 
 
+def cmd_head(args):
+    """HA plane control: run a warm-standby head (foreground, like `ca
+    join`), promote a standby to active, or print every head's role/epoch/
+    replication watermark."""
+    import glob as _glob
+    import json as _json
+
+    from cluster_anywhere_tpu.core.api import _find_session
+    from cluster_anywhere_tpu.core.config import CAConfig
+    from cluster_anywhere_tpu.core.protocol import BlockingClient
+
+    if args.action == "standby":
+        if args.head:
+            # cross-host standby: its own session dir, replicating over TCP
+            root = CAConfig().session_dir_root
+            sdir = os.path.join(root, f"standby{args.rank}_{os.getpid()}")
+            os.makedirs(sdir, exist_ok=True)
+            head_addr = args.head
+        else:
+            sdir = _find_session(args.address or "auto", CAConfig().session_dir_root)
+            head_addr = open(os.path.join(sdir, "head.addr")).read().strip()
+        os.environ["CA_SESSION_DIR"] = sdir
+        os.environ["CA_HEAD_ADDR"] = head_addr
+        os.environ["CA_HEAD_STANDBY"] = "1"
+        os.environ["CA_HEAD_STANDBY_RANK"] = str(args.rank)
+        os.environ["CA_HEAD_PERSIST"] = "1"
+        os.environ.setdefault("CA_CONFIG_JSON", CAConfig().to_json())
+        from cluster_anywhere_tpu.core.head import main as head_main
+
+        print(f"standby head (rank {args.rank}) replicating from {head_addr}")
+        head_main()
+        return
+
+    sdir = _find_session(args.address or "auto", CAConfig().session_dir_root)
+
+    def _ha_status(addr):
+        c = BlockingClient(addr)
+        c._sock.settimeout(5.0)
+        try:
+            r = c.call("ha_status")
+        finally:
+            c.close()
+        return {k: v for k, v in r.items() if k not in ("i", "ok")}
+
+    if args.action == "promote":
+        path = os.path.join(sdir, f"head.standby{args.rank}.addr")
+        if not os.path.exists(path):
+            raise SystemExit(f"no standby at rank {args.rank} in {sdir}")
+        addr = open(path).read().strip()
+        c = BlockingClient(addr)
+        c._sock.settimeout(30.0)
+        try:
+            r = c.call("head_promote")
+        finally:
+            c.close()
+        print(
+            f"promoted {addr}: epoch {r.get('epoch')} "
+            f"(replicated seq {r.get('seq')}, watermark {r.get('watermark')})"
+        )
+        return
+
+    # status: the active head plus every advertised standby
+    rows = []
+    try:
+        active = open(os.path.join(sdir, "head.addr")).read().strip()
+    except FileNotFoundError:
+        active = ""
+    if active:
+        try:
+            rows.append(_ha_status(active))
+        except Exception as e:
+            rows.append({"addr": active, "role": f"unreachable ({e})"})
+    for path in sorted(_glob.glob(os.path.join(sdir, "head.standby*.addr"))):
+        addr = open(path).read().strip()
+        if any(r.get("addr") == addr for r in rows):
+            continue  # a promoted standby already answered as the active
+        try:
+            rows.append(_ha_status(addr))
+        except Exception as e:
+            rows.append({"addr": addr, "role": f"unreachable ({e})"})
+    if getattr(args, "json", False):
+        print(_json.dumps(rows, indent=2, default=str))
+        return
+    for r in rows:
+        role = r.get("role", "?")
+        line = f"{r.get('addr', '?'):<28} {role:<9} epoch={r.get('epoch', '?')}"
+        if role == "active":
+            line += (
+                f" seq={r.get('seq')} standbys={len(r.get('standbys') or [])}"
+                f" repl_lag={r.get('repl_lag')}"
+            )
+        elif role == "standby":
+            line += (
+                f" rank={r.get('rank')} watermark={r.get('watermark')}"
+                f" syncing_from={r.get('active_addr')}"
+            )
+        print(line)
+
+
 def cmd_microbenchmark(args):
     """Single-node microbenchmarks (reference _private/ray_perf.py main):
     the canonical table — tasks/actors sync+async, put/get call rates, put
@@ -994,6 +1093,14 @@ def cmd_microbenchmark(args):
         from .microbenchmark import run_obsplane
 
         run_obsplane(quick=getattr(args, "quick", False))
+        return
+    if getattr(args, "ha", False):
+        # owns its own clusters (SIGKILL the active head mid-workload:
+        # detect->promote->first-successful-op latency, acked-KV loss=0,
+        # duplicate side effects=0, replication-lag ceiling)
+        from .microbenchmark import run_ha_plane
+
+        run_ha_plane(quick=getattr(args, "quick", False))
         return
 
     import cluster_anywhere_tpu as ca
@@ -1174,7 +1281,7 @@ def main(argv=None):
     sp.add_argument(
         "--plane", default=None,
         help="filter by plane (fence/drain/chaos/dag/serve/train/transfer/"
-        "ownership/node/actor)",
+        "ownership/node/actor/ha)",
     )
     sp.add_argument("--node", default=None, help="filter by node id")
     sp.add_argument("--event", default=None, help="filter by event substring")
@@ -1266,6 +1373,25 @@ def main(argv=None):
     addr(sp)
     sp.set_defaults(fn=cmd_dashboard)
 
+    sp = sub.add_parser(
+        "head",
+        help="HA plane: run a warm-standby head / promote a standby / "
+        "show head roles+epochs",
+    )
+    sp.add_argument("action", choices=["standby", "promote", "status"])
+    addr(sp)
+    sp.add_argument(
+        "--rank", type=int, default=0,
+        help="standby rank (promotion order; rank 0 self-promotes first)",
+    )
+    sp.add_argument(
+        "--head", default=None,
+        help="active head TCP address for a cross-host standby "
+        "(tcp:host:port[,tcp:host2:port2...])",
+    )
+    sp.add_argument("--json", action="store_true", help="raw JSON status")
+    sp.set_defaults(fn=cmd_head)
+
     sp = sub.add_parser("microbenchmark", help="single-node perf microbenchmarks")
     sp.add_argument("--quick", action="store_true", help="scaled-down run")
     sp.add_argument(
@@ -1329,6 +1455,12 @@ def main(argv=None):
         "--obsplane", action="store_true",
         help="flight-recorder cost model: armed record events/s, disabled "
         "gate rate, journal memory at cap, tasks/s with the plane on/off",
+    )
+    sp.add_argument(
+        "--ha", action="store_true",
+        help="HA-plane failover chaos: SIGKILL the active head mid-workload "
+        "(detect->promote->first-op latency, acked-KV loss=0, duplicate "
+        "side effects=0)",
     )
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.set_defaults(fn=cmd_microbenchmark)

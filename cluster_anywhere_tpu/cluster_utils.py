@@ -47,6 +47,7 @@ class Cluster:
         os.makedirs(self.session_dir, exist_ok=True)
         self._node_seq = 0
         self._agents: Dict[str, subprocess.Popen] = {}
+        self._standbys: Dict[int, subprocess.Popen] = {}  # rank -> proc
         self._connected = False
         resources = dict(head_resources or {"CPU": 0.0})
         resources.setdefault("memory", float(self.config.object_store_memory))
@@ -91,6 +92,105 @@ class Cluster:
         snapshot and re-adopts live workers, agents, and drivers."""
         self._spawn_head()
 
+    # ---------------------------------------------------------------- HA plane
+    def add_standby(self, rank: int = 0, env_overrides: Optional[Dict[str, str]] = None) -> str:
+        """Start a warm-standby head at `rank` (promotion order: rank 0
+        self-promotes first).  It subscribes to the active head's replication
+        stream and holds the full registry in memory; returns its TCP addr."""
+        env = self._base_env()
+        env["CA_RESOURCES"] = json.dumps(self._head_resources)
+        env["CA_HEAD_PERSIST"] = "1"
+        env["CA_HEAD_STANDBY"] = "1"
+        env["CA_HEAD_STANDBY_RANK"] = str(rank)
+        env["CA_HEAD_ADDR"] = self.head_ring()
+        if env_overrides:
+            env.update(env_overrides)
+        ready = os.path.join(self.session_dir, f"head.standby{rank}.ready")
+        try:
+            os.unlink(ready)
+        except FileNotFoundError:
+            pass
+        log = open(
+            os.path.join(self.session_dir, f"head.standby{rank}.log"), "ab"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cluster_anywhere_tpu.core.head"],
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        log.close()
+        self._standbys[rank] = proc
+        self._wait_for_file(ready, 30)
+        return self.standby_addr(rank)
+
+    def standby_addr(self, rank: int = 0) -> str:
+        return open(
+            os.path.join(self.session_dir, f"head.standby{rank}.addr")
+        ).read().strip()
+
+    def head_ring(self) -> str:
+        """Comma-separated head address list: active first, then standbys in
+        rank order — the CA_HEAD_ADDR / init(address=...) failover spec."""
+        addrs = [self.head_tcp]
+        for rank in sorted(self._standbys):
+            try:
+                a = self.standby_addr(rank)
+            except FileNotFoundError:
+                continue
+            if a and a not in addrs:
+                addrs.append(a)
+        return ",".join(addrs)
+
+    def kill_standby(self, rank: int = 0):
+        proc = self._standbys.pop(rank, None)
+        if proc is None:
+            raise ValueError(f"no standby at rank {rank}")
+        try:
+            os.kill(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait(timeout=10)
+
+    def promote_standby(self, rank: int = 0, timeout: float = 10) -> dict:
+        """Explicitly promote the rank's standby (the `ca head promote`
+        path); returns its ha_status afterwards.  With ha_auto_promote on,
+        standbys promote themselves after the grace window and this is only
+        needed for deterministic tests / manual failover."""
+        from .core.protocol import BlockingClient
+
+        c = BlockingClient(self.standby_addr(rank))
+        c._sock.settimeout(timeout)
+        try:
+            return c.call("head_promote")
+        finally:
+            c.close()
+
+    def wait_promoted(self, timeout: float = 30) -> str:
+        """Block until a standby has claimed head.addr (promotion rewrites
+        it); adopts the promoted process as the cluster's head proc and
+        returns the new active addr."""
+        deadline = time.monotonic() + timeout
+        old = self.head_tcp
+        addr_path = os.path.join(self.session_dir, "head.addr")
+        while time.monotonic() < deadline:
+            try:
+                cur = open(addr_path).read().strip()
+            except FileNotFoundError:
+                cur = ""
+            if cur and cur != old:
+                self.head_tcp = cur
+                for rank, proc in list(self._standbys.items()):
+                    try:
+                        if self.standby_addr(rank) == cur:
+                            self._head_proc = self._standbys.pop(rank)
+                    except FileNotFoundError:
+                        pass
+                return cur
+            time.sleep(0.05)
+        raise TimeoutError("no standby promoted within the window")
+
     def _base_env(self) -> dict:
         env = dict(os.environ)
         env["CA_SESSION_DIR"] = self.session_dir
@@ -129,7 +229,7 @@ class Cluster:
         if resources:
             shape.update({k: float(v) for k, v in resources.items()})
         env = self._base_env()
-        env["CA_HEAD_ADDR"] = self.head_tcp
+        env["CA_HEAD_ADDR"] = self.head_ring()  # active first, then standbys
         env["CA_NODE_ID"] = nid
         env["CA_NODE_RESOURCES"] = json.dumps(shape)
         if labels:
@@ -207,6 +307,11 @@ class Cluster:
         for nid in list(self._agents):
             try:
                 self.remove_node(nid)
+            except Exception:
+                pass
+        for rank in list(self._standbys):
+            try:
+                self.kill_standby(rank)
             except Exception:
                 pass
         if self._head_proc.poll() is None:

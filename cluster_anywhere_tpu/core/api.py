@@ -122,6 +122,11 @@ def init(
     already-running cluster as an additional driver.
     Mirrors ray.init (python/ray/_private/worker.py:1275).
 
+    A tcp `address=` may be a comma-separated list naming the active head
+    plus warm standbys ("tcp:h1:6379,tcp:h2:6379"): the driver dials the
+    first reachable entry and fails over along the list (plus any standbys
+    learned at register time) when the active head dies mid-session.
+
     Config overrides pass as keywords, e.g. `init(log_to_driver=False)` to
     opt this driver out of the cluster log stream (worker prints echoed with
     task/worker/node attribution — see util/logplane.py)."""

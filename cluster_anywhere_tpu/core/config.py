@@ -116,6 +116,34 @@ class CAConfig:
     dial_timeout_s: float = 15.0
     io_timeout_s: float = 60.0
 
+    # --- HA plane (warm-standby head replication / epoch-fenced failover) ---
+    # master switch for the head-replication machinery.  With no standby
+    # subscribed the active head's only HA cost is a per-snapshot-tick flag
+    # check, so this stays on by default.
+    ha_plane: bool = True
+    # table-delta replication tick on the active head (rides the persist
+    # loop); also the standby-liveness heartbeat period on the stream
+    ha_repl_interval_s: float = 0.25
+    # bounded re-stage window: replication records kept in memory for
+    # standbys that reconnect with a watermark; older watermarks get a full
+    # state transfer instead
+    ha_repl_log_max: int = 4096
+    # how long an acked KV commit waits for standby acks before the slow
+    # standby is dropped from the sync set (availability over sync once a
+    # replica is gone)
+    ha_sync_commit_timeout_s: float = 2.0
+    # standby-side: how long the active head must stay unreachable (stream
+    # closed AND redials failing) before self-promotion; each standby rank
+    # waits one extra grace period per rank so replicas don't race
+    ha_failover_grace_s: float = 2.0
+    # standby self-promotes after the grace window (off = promotion only via
+    # `ca head promote` / head_promote RPC)
+    ha_auto_promote: bool = True
+    # restarting head probes the current head.addr occupant before claiming
+    # authority: a live head with a >= epoch means THIS process is the stale
+    # one — demote at boot instead of split-braining the registry
+    ha_boot_probe: bool = True
+
     # --- tasks / actors ---
     default_max_retries: int = 3
     lineage_cap: int = 8192  # task specs kept for object reconstruction

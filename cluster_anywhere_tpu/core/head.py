@@ -312,21 +312,40 @@ class Head:
         self._clients: Dict[str, dict] = {}  # client_id -> conn state
         self._register_waiters: Dict[str, asyncio.Future] = {}
         self.subscribers: Dict[str, List[Any]] = {}  # channel -> [writer]
-        host = getattr(config, "head_host", "127.0.0.1")
-        # a restarted head rebinds the SAME tcp port (agents/remote workers
-        # reconnect to the address they were given)
-        port = 0
-        addr_file = os.path.join(session_dir, "head.addr")
-        if os.path.exists(addr_file):
-            try:
-                prev = open(addr_file).read().strip()
-                if prev.startswith("tcp:"):
-                    port = int(prev.rpartition(":")[2])
-            except (OSError, ValueError):
-                pass
-        self.server = Server(
-            [self.sock_path, f"tcp:{host}:{port}"], self._handle, self._on_disconnect
+        # --- HA plane (warm-standby replication / epoch-fenced authority) --
+        # role FSM: standby --promote--> active --observe higher epoch-->
+        # demoted.  A standby holds the replicated cluster state in memory
+        # (self._ha_shadow, fed by the active head's replication stream) and
+        # serves only ha_status/head_promote until it promotes; a demoted
+        # head refuses everything, releases its sockets, and exits.
+        self.ha_role = "standby" if os.environ.get("CA_HEAD_STANDBY") else "active"
+        self.ha_rank = int(os.environ.get("CA_HEAD_STANDBY_RANK", "0") or 0)
+        # monotonic authority epoch, minted at promotion and persisted next
+        # to the node-incarnation table: PR 15's "which head is
+        # authoritative for this node" generalized to "which head is
+        # authoritative, period".  Stamped (`hep`) on authority-bearing
+        # traffic exactly like node incarnations (`ninc`).
+        self.head_epoch = 1
+        self._ha_observed_epoch = 0  # highest successor epoch seen (demoted)
+        self._ha_restored_addr: Optional[str] = None  # own addr from snapshot
+        self._repl_seq = 0
+        self._repl_dirty = False
+        self._repl_log: deque = deque(
+            maxlen=int(getattr(config, "ha_repl_log_max", 4096))
         )
+        self._repl_subs: Dict[str, dict] = {}  # standby client_id -> sub
+        self._repl_table_digests: Dict[str, int] = {}
+        self._repl_last_lag_event = 0.0
+        # standby-side stream/apply state
+        self._ha_shadow: Optional[dict] = None
+        self._ha_watermark = 0
+        self._ha_active_conn = None
+        self._ha_active_addr: Optional[str] = None
+        self._ha_last_rx = 0.0
+        self._ha_loops_started = False
+        self._ha_tasks: List[Any] = []
+        self._ha_replog = None
+        self._sock_server: Optional[Server] = None
         self.stats = {
             "leases_granted": 0,
             "tasks_pushed": 0,
@@ -468,27 +487,72 @@ class Head:
         # fault tolerance (gcs_server.h StorageType analogue, file-backed):
         # debounced snapshots of the cluster tables; a restarted head loads
         # them and re-adopts live workers/agents/drivers
-        self._ckpt_path = os.path.join(session_dir, "head.ckpt")
+        self._ckpt_path = os.environ.get("CA_HEAD_CKPT") or os.path.join(
+            session_dir, "head.ckpt"
+        )
         self._dirty = False
         self._restored = False
         # torn-snapshot tolerance: head.ckpt is written via tmp+rename and
         # rotated to .bak first, so a corrupt/missing primary (kill -9 inside
-        # _save_snapshot, disk fault) falls back to the previous good one
-        for path in (self._ckpt_path, self._ckpt_path + ".bak"):
-            if not os.path.exists(path):
-                continue
-            try:
-                self._load_snapshot(path)
-                self._restored = True
-                if path != self._ckpt_path:
-                    self._log_event("snapshot_fallback_bak", path=path)
-                break
-            except Exception as e:
-                self._log_event(
-                    "snapshot_load_failed", path=path, error=repr(e)
-                )
+        # _save_snapshot, disk fault) falls back to the previous good one.
+        # Standbys skip this — their state comes from the replication stream
+        # (plus their own journal), never from the active head's snapshot.
+        if self.ha_role == "active":
+            for path in (self._ckpt_path, self._ckpt_path + ".bak"):
+                if not os.path.exists(path):
+                    continue
+                try:
+                    self._load_snapshot(path)
+                    self._restored = True
+                    if path != self._ckpt_path:
+                        self._log_event("snapshot_fallback_bak", path=path)
+                    break
+                except Exception as e:
+                    self._log_event(
+                        "snapshot_load_failed", path=path, error=repr(e)
+                    )
         # pull-side file maps for serving n0's object chunks
         self._pull_maps: Dict[str, Any] = {}
+        # listener — constructed AFTER the snapshot load so a restored
+        # `ha.tcp_addr` can pin the port.  An active head rebinds the SAME
+        # tcp port (agents/remote workers reconnect to the address they were
+        # given), preferring its own persisted addr over the head.addr file,
+        # which a successor head may have claimed since (failover); a
+        # standby binds an ephemeral port and its own rank-suffixed socket.
+        host = getattr(config, "head_host", "127.0.0.1")
+        port = 0
+        # deferred-socket restart: when head.addr names a DIFFERENT head than
+        # the one this snapshot belonged to, a successor may own the session
+        # unix socket — don't bind (or unlink!) head.sock until the boot
+        # probe proves this head is still authoritative
+        self._ha_sock_deferred = False
+        if self.ha_role == "active":
+            cur = ""
+            try:
+                cur = open(os.path.join(session_dir, "head.addr")).read().strip()
+            except OSError:
+                pass
+            prev = self._ha_restored_addr or cur
+            if prev.startswith("tcp:"):
+                try:
+                    port = int(prev.rpartition(":")[2])
+                except ValueError:
+                    port = 0
+            if (
+                self._restored and cur and prev and cur != prev
+                and bool(getattr(config, "ha_boot_probe", True))
+            ):
+                self._ha_sock_deferred = True
+        else:
+            self.sock_path = os.path.join(
+                session_dir, f"head.standby{self.ha_rank}.sock"
+            )
+        addrs = (
+            [f"tcp:{host}:{port}"]
+            if self._ha_sock_deferred
+            else [self.sock_path, f"tcp:{host}:{port}"]
+        )
+        self.server = Server(addrs, self._handle, self._on_disconnect)
 
     def _add_node(self, node: NodeRec) -> NodeRec:
         node.index = self._node_index
@@ -530,11 +594,10 @@ class Head:
         return out
 
     # ------------------------------------------------------ fault tolerance
-    def _save_snapshot(self):
-        """Atomically persist the cluster tables (kill -9 of the head must
-        not lose actors/PGs/KV/object locations; gcs_table_storage.h role)."""
-        import msgpack
-
+    def _snapshot_state(self) -> dict:
+        """The cluster tables as one plain dict — the unit of persistence
+        (snapshot file) AND of replication (full transfers / table deltas to
+        warm standbys all serialize the same tables)."""
         state = {
             "nodes": [
                 {
@@ -627,8 +690,22 @@ class Head:
                 [cid, [[oid, info] for oid, info in d.items()]]
                 for cid, d in self.owner_digests.items()
             ],
+            # HA plane: the authority epoch rides the snapshot next to the
+            # node-incarnation table, plus our own tcp addr so a restarted
+            # head rebinds ITS port (not a successor's from head.addr)
+            "ha": {
+                "epoch": self.head_epoch,
+                "tcp_addr": self.tcp_addr or self._ha_restored_addr or "",
+            },
         }
-        blob = msgpack.packb(state, use_bin_type=True)
+        return state
+
+    def _save_snapshot(self):
+        """Atomically persist the cluster tables (kill -9 of the head must
+        not lose actors/PGs/KV/object locations; gcs_table_storage.h role)."""
+        import msgpack
+
+        blob = msgpack.packb(self._snapshot_state(), use_bin_type=True)
         tmp = self._ckpt_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
@@ -647,6 +724,11 @@ class Head:
 
         with open(path or self._ckpt_path, "rb") as f:
             state = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+        self._load_state(state)
+
+    def _load_state(self, state: dict):
+        """Adopt a full cluster-state dict (_snapshot_state schema) — shared
+        by snapshot restore and standby promotion (the replicated shadow)."""
         now = time.monotonic()
         for cid in state.get("departed_clients") or []:
             self._departed_clients[cid] = None
@@ -715,6 +797,9 @@ class Head:
         self.stats.update(state["stats"])
         for cid, entries in state.get("owner_digests") or ():
             self.owner_digests[cid] = {bytes(oid): info for oid, info in entries}
+        ha = state.get("ha") or {}
+        self.head_epoch = max(self.head_epoch, int(ha.get("epoch") or 1))
+        self._ha_restored_addr = ha.get("tcp_addr") or None
 
     async def _persist_loop(self):
         """Debounced snapshot writer: at most one disk write per interval.
@@ -723,6 +808,10 @@ class Head:
         time nudge alone misses holders whose leases go idle later)."""
         while not self._shutdown.is_set():
             await asyncio.sleep(0.25)
+            if self.ha_role == "demoted":
+                # a fenced zombie must not clobber the successor's snapshot
+                # or keep streaming stale deltas
+                continue
             if self.pending_leases:
                 self._last_reclaim_nudge = 0.0  # bypass the debounce
                 self._nudge_lease_holders(requester="")
@@ -732,6 +821,8 @@ class Head:
                 # blocks: revoke the unleased slots (reclaim arbiter role)
                 self._last_central_demand = time.monotonic()
                 self._reclaim_delegations()
+            if self._repl_subs:
+                self._repl_tick()
             if self._dirty:
                 self._dirty = False
                 try:
@@ -742,6 +833,7 @@ class Head:
     # head event kind -> flight-recorder plane (prefix match, first wins);
     # unmatched kinds file under "head"
     _FLIGHTREC_PLANES = (
+        ("ha_", "ha"),
         ("rpc_fenced", "fence"),
         ("agent_register_fenced", "fence"),
         ("node_readopted", "fence"),
@@ -790,6 +882,553 @@ class Head:
         for ev in evs:
             if isinstance(ev, dict):
                 self.flightrec.append(ev)
+
+    # ------------------------------------------------------------- HA plane
+    # Warm-standby replication + epoch-fenced promotion.  The active head
+    # streams its registry mutations — the same tables _snapshot_state
+    # serializes — to subscribed standbys over a versioned record stream
+    # (the DeltaReporter idiom from core/ownership.py, head-scale): per-table
+    # deltas ride the persist tick, KV commits replicate SYNCHRONOUSLY
+    # before their reply (acked == survives head death), and a bounded
+    # in-memory log re-stages records for standbys that reconnect with a
+    # watermark.  Authority is the monotonic head epoch; see _handle's gate.
+
+    _HA_PASSIVE_METHODS = frozenset({"ha_status", "head_promote"})
+
+    def _ha_standby_addrs(self) -> List[str]:
+        return sorted(
+            {s["addr"] for s in self._repl_subs.values() if s.get("addr")}
+        )
+
+    def _ha_ring_broadcast(self) -> None:
+        """Push the current standby ring + head epoch to every connected
+        agent.  Register replies already carry both, but an agent that
+        joined BEFORE a standby subscribed would otherwise never learn the
+        successor's address — and a one-head ring means no failover."""
+        standbys = self._ha_standby_addrs()
+        for node in list(self.nodes.values()):
+            if node.state == "dead" or node.conn is None:
+                continue
+            try:
+                node.conn.notify(
+                    "ha_ring", standbys=standbys, head_epoch=self.head_epoch,
+                )
+            except Exception:
+                pass
+        frame = {"m": "ha_ring", "standbys": standbys,
+                 "head_epoch": self.head_epoch}
+        for cid, state in list(self._clients.items()):
+            if cid in self._repl_subs:
+                continue  # the standby already knows the ring (it IS in it)
+            try:
+                write_frame(state["writer"], frame)
+            except Exception:
+                pass
+
+    def _ha_status_dict(self) -> dict:
+        lag = 0
+        if self._repl_subs:
+            lag = self._repl_seq - min(s["acked"] for s in self._repl_subs.values())
+        return {
+            "role": self.ha_role,
+            "epoch": self.head_epoch,
+            "rank": self.ha_rank,
+            "seq": self._repl_seq,
+            "watermark": self._ha_watermark,
+            "addr": self.tcp_addr,
+            "active_addr": self._ha_active_addr,
+            "repl_lag": lag,
+            "standbys": [
+                {"addr": s.get("addr"), "rank": s.get("rank", 0),
+                 "acked": s["acked"], "lag": self._repl_seq - s["acked"]}
+                for s in self._repl_subs.values()
+            ],
+            "promotions": self.stats.get("ha_promotions", 0),
+            "demotions": self.stats.get("ha_demotions", 0),
+        }
+
+    async def _h_ha_status(self, state, msg, reply, reply_err):
+        reply(**self._ha_status_dict())
+
+    def _ha_refuse(self, state, msg, reply_err, stale_client: bool = False) -> None:
+        """Refuse an RPC this head has no authority to execute (standby or
+        demoted role, or a client stamped with a superseded head epoch).
+
+        Deliberately NOT a FencedError: that error (and the `fenced` push)
+        tells a worker ITS node was declared dead, making it cancel leases
+        and exit — wrong when the HEAD is the stale party.  A plain
+        ConnectionError + closed socket sends the client back through its
+        redial ring, where the register reply teaches it the real epoch."""
+        self.stats["ha_refused_rpcs"] = self.stats.get("ha_refused_rpcs", 0) + 1
+        if msg.get("i") is not None:
+            if self.ha_role == "standby":
+                reply_err(ConnectionError(
+                    f"standby head (rank {self.ha_rank}) is not active; "
+                    f"active head: {self._ha_active_addr or 'unknown'}"
+                ))
+            else:
+                reply_err(ConnectionError(
+                    f"head epoch {self.head_epoch} is no longer "
+                    f"authoritative (successor epoch "
+                    f"{self._ha_observed_epoch or '>' + str(self.head_epoch)})"
+                    if self.ha_role == "demoted"
+                    else f"request stamped with a superseded head epoch "
+                         f"(current: {self.head_epoch}); re-register"
+                ))
+        if self.ha_role == "demoted" or stale_client:
+            try:
+                fence_close(state["writer"])
+            except Exception:
+                pass
+
+    # -- active side: record stream --------------------------------------
+    async def _h_head_replicate(self, state, msg, reply, reply_err):
+        """A standby subscribes to the replication stream.  Records then
+        flow as `repl` push frames on this connection — one ordered stream,
+        so a table delta can never overtake a KV record it already
+        contains.  Re-subscribes send their durable watermark: inside the
+        re-stage window they get just the gap, otherwise a full transfer."""
+        peer_epoch = int(msg.get("hepoch") or 0)
+        if peer_epoch > self.head_epoch:
+            # the subscriber outranks us — it was promoted while we were
+            # away.  Demote; the FencedError marks this as an authority
+            # verdict (the one case a head fences a head).
+            self._ha_demote(peer_epoch, via="head_replicate")
+            reply_err(FencedError(
+                f"head epoch {self.head_epoch} superseded by promoted "
+                f"standby at epoch {peer_epoch}"
+            ))
+            return
+        cid = (msg.get("client_id") or state.get("client_id")
+               or f"standby@{msg.get('addr') or id(state)}")
+        state["client_id"] = cid
+        self._clients[cid] = state
+        sub = {
+            "writer": state["writer"],
+            "addr": msg.get("addr") or "",
+            "rank": int(msg.get("rank") or 0),
+            "acked": int(msg.get("watermark") or 0),
+            "event": asyncio.Event(),
+        }
+        self._repl_subs[cid] = sub
+        self._repl_table_digests.clear()  # next delta tick re-baselines
+        self._log_event(
+            "ha_standby_sub", addr=sub["addr"], rank=sub["rank"],
+            watermark=sub["acked"], seq=self._repl_seq,
+        )
+        self._ha_ring_broadcast()
+        reply(epoch=self.head_epoch, seq=self._repl_seq)
+        watermark = sub["acked"]
+        base = self._repl_log[0][0] if self._repl_log else self._repl_seq + 1
+        if watermark and watermark + 1 >= base and watermark <= self._repl_seq:
+            # bounded re-stage: replay only the records past the standby's
+            # durable watermark (all still in the in-memory window)
+            for seq, rec in list(self._repl_log):
+                if seq > watermark:
+                    self._repl_push(cid, sub, rec)
+        else:
+            # fresh standby, or a watermark older than the window: full
+            # state transfer supersedes whatever it holds
+            import msgpack
+
+            blob = msgpack.packb(self._snapshot_state(), use_bin_type=True)
+            sub["acked"] = 0
+            self._repl_push(
+                cid, sub,
+                {"t": "full", "seq": self._repl_seq, "state": blob,
+                 "epoch": self.head_epoch},
+            )
+
+    async def _h_head_replicate_ack(self, state, msg, reply, reply_err):
+        sub = self._repl_subs.get(state.get("client_id") or "")
+        if sub is not None:
+            sub["acked"] = max(sub["acked"], int(msg.get("seq") or 0))
+            sub["event"].set()
+
+    def _repl_push(self, cid: str, sub: dict, rec: dict) -> None:
+        try:
+            # push stream consumed by _ha_on_repl_push on the standby:
+            # ca-lint: ignore[rpc-unknown-method]
+            write_frame(sub["writer"], {"m": "repl", **rec})
+        except Exception:
+            self._repl_drop_sub(cid, "write_failed")
+
+    def _repl_send(self, rec: dict) -> None:
+        """Append to the bounded re-stage log and push to every standby."""
+        self._repl_log.append((rec["seq"], rec))
+        self.stats["ha_records_streamed"] = (
+            self.stats.get("ha_records_streamed", 0) + 1
+        )
+        for cid, sub in list(self._repl_subs.items()):
+            self._repl_push(cid, sub, rec)
+
+    def _repl_drop_sub(self, cid: str, reason: str) -> None:
+        sub = self._repl_subs.pop(cid, None)
+        if sub is None:
+            return
+        sub["event"].set()  # wake any sync commit waiting on this replica
+        self.stats["ha_standbys_lost"] = (
+            self.stats.get("ha_standbys_lost", 0) + 1
+        )
+        self._log_event("ha_standby_lost", addr=sub.get("addr"), reason=reason)
+        self._ha_ring_broadcast()
+
+    async def _repl_commit(self, rec: dict) -> None:
+        """Synchronously replicate one record: return once every live
+        standby acked it (applied in memory AND journaled) or got dropped
+        at the timeout (availability over sync once a replica is gone).
+        The caller's reply is the client-visible ack, so this is what makes
+        'acked' mean 'survives head death'."""
+        self._repl_seq += 1
+        rec = {**rec, "seq": self._repl_seq, "epoch": self.head_epoch}
+        self._repl_send(rec)
+        self.stats["ha_sync_commits"] = self.stats.get("ha_sync_commits", 0) + 1
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + float(
+            getattr(self.config, "ha_sync_commit_timeout_s", 2.0)
+        )
+        for cid in list(self._repl_subs):
+            while True:
+                sub = self._repl_subs.get(cid)
+                if sub is None or sub["acked"] >= rec["seq"]:
+                    break
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    self.stats["ha_sync_commit_timeouts"] = (
+                        self.stats.get("ha_sync_commit_timeouts", 0) + 1
+                    )
+                    self._repl_drop_sub(cid, "sync_commit_timeout")
+                    break
+                sub["event"].clear()
+                try:
+                    # asyncio.Event.wait (coroutine), awaited via wait_for:
+                    # ca-lint: ignore[async-blocking-call]
+                    await asyncio.wait_for(sub["event"].wait(), remaining)
+                except asyncio.TimeoutError:
+                    pass
+
+    def _repl_tick(self) -> None:
+        """Table-delta replication (rides the persist loop): serialize the
+        snapshot tables and stream only those whose bytes changed since the
+        last tick.  A no-op tick degrades to a bare heartbeat so standbys
+        can tell a quiet head from a dead one."""
+        import zlib as _zlib
+
+        import msgpack
+
+        if self._repl_dirty:
+            self._repl_dirty = False
+            changed = {}
+            for name, val in self._snapshot_state().items():
+                blob = msgpack.packb(val, use_bin_type=True)
+                digest = _zlib.crc32(blob)
+                if self._repl_table_digests.get(name) != digest:
+                    self._repl_table_digests[name] = digest
+                    changed[name] = blob
+            if changed:
+                self._repl_seq += 1
+                self._repl_send(
+                    {"t": "tables", "seq": self._repl_seq,
+                     "tables": changed, "epoch": self.head_epoch}
+                )
+                return
+        self._repl_send(
+            {"t": "hb", "seq": self._repl_seq, "epoch": self.head_epoch}
+        )
+
+    # -- standby side: subscribe/apply loop ------------------------------
+    async def _ha_standby_loop(self):
+        """Standby FSM: recover the local journal, subscribe to the active
+        head with the durable watermark, apply pushed records, and promote
+        when the active head stays unreachable past the grace window
+        (rank-staggered so replicas never race for the epoch)."""
+        from ..util import replog
+        from ..util.aio import dial
+
+        path = os.path.join(
+            self.session_dir, f"head.standby{self.ha_rank}.replog"
+        )
+        records, torn = replog.recover(path)
+        if torn:
+            self._log_event("ha_repl_torn_tail", path=path, intact=len(records))
+        self._ha_shadow, self._ha_watermark = replog.replay(records)
+        self._ha_replog = replog.ReplLogWriter(path)
+        addrs = [
+            a for a in (os.environ.get("CA_HEAD_ADDR") or "").split(",") if a
+        ]
+        grace = float(getattr(self.config, "ha_failover_grace_s", 2.0))
+        grace *= 1.0 + self.ha_rank  # rank stagger
+        auto = bool(getattr(self.config, "ha_auto_promote", True))
+        from .worker import _redial_backoff
+
+        down_since: Optional[float] = None
+        attempt = 0
+        while not self._shutdown.is_set() and self.ha_role == "standby":
+            loop = asyncio.get_running_loop()
+            now = loop.time()
+            conn = self._ha_active_conn
+            if conn is not None and not conn.closed:
+                if now - self._ha_last_rx > max(grace, 2.0):
+                    # socket open but the stream went silent (partitioned
+                    # or wedged active): treat as down and redial
+                    await conn.close()
+                else:
+                    down_since = None
+                    attempt = 0
+                    await asyncio.sleep(0.1)
+                    continue
+            self._ha_active_conn = None
+            if down_since is None:
+                down_since = now
+            for addr in addrs:
+                try:
+                    conn = await dial(
+                        addr, purpose="head (standby sync)",
+                        timeout=min(2.0, self.config.dial_timeout_s),
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    continue
+                conn.set_push_handler(self._ha_on_repl_push)
+                # assigned before the subscribe call: replayed records can
+                # arrive on this conn before call() returns, and the push
+                # handler acks through _ha_active_conn
+                self._ha_active_conn = conn
+                self._ha_last_rx = loop.time()
+                try:
+                    r = await conn.call(
+                        "head_replicate",
+                        client_id=f"standby-{self.ha_rank}-{os.getpid()}",
+                        addr=self.tcp_addr, rank=self.ha_rank,
+                        watermark=self._ha_watermark,
+                        hepoch=self.head_epoch, timeout=5,
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    self._ha_active_conn = None
+                    try:
+                        await conn.close()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        pass
+                    continue
+                self.head_epoch = max(self.head_epoch, int(r.get("epoch") or 1))
+                self._ha_active_addr = addr
+                down_since = None
+                attempt = 0
+                self._log_event(
+                    "ha_standby_synced", active=addr, epoch=self.head_epoch,
+                    watermark=self._ha_watermark,
+                )
+                break
+            if self._ha_active_conn is not None:
+                continue
+            now = loop.time()
+            if auto and down_since is not None and now - down_since > grace:
+                await self._ha_promote(reason="active head unreachable")
+                return
+            attempt += 1
+            await asyncio.sleep(min(_redial_backoff(attempt), 0.5))
+
+    async def _ha_on_repl_push(self, msg):
+        if msg.get("m") != "repl":
+            return
+        loop = asyncio.get_running_loop()
+        self._ha_last_rx = loop.time()
+        ep = int(msg.get("epoch") or 0)
+        if ep > self.head_epoch:
+            self.head_epoch = ep
+        t = msg.get("t")
+        if t == "hb":
+            return
+        seq = int(msg.get("seq") or 0)
+        if t != "full" and seq <= self._ha_watermark:
+            return  # re-stage overlap: already applied and journaled
+        from ..util import replog
+
+        rec = {k: v for k, v in msg.items() if k != "m"}
+        try:
+            self._ha_shadow = replog.apply_record(self._ha_shadow, rec)
+        except Exception as e:
+            # never ack a record we could not apply: drop the stream and
+            # resubscribe from the durable watermark instead
+            self._log_event("ha_apply_failed", seq=seq, error=repr(e))
+            conn = self._ha_active_conn
+            if conn is not None:
+                await conn.close()
+            return
+        if self._ha_replog is not None:
+            try:
+                if t == "full":
+                    self._ha_replog.reset()  # full state supersedes history
+                self._ha_replog.append(rec)
+            except OSError:
+                pass
+        self._ha_watermark = seq
+        conn = self._ha_active_conn
+        if conn is not None and not conn.closed:
+            try:
+                conn.notify("head_replicate_ack", seq=seq)
+            except Exception:
+                pass
+
+    # -- role transitions --------------------------------------------------
+    async def _ha_promote(self, reason: str) -> dict:
+        """Standby -> active: adopt the replicated state, mint the successor
+        epoch, claim the session discovery files (head.addr / head.sock /
+        head.ready), and start the active-only loops."""
+        if self.ha_role == "active":
+            return self._ha_status_dict()
+        if self.ha_role == "demoted":
+            raise RuntimeError("demoted head cannot promote")
+        if self._ha_shadow is not None:
+            self._load_state(self._ha_shadow)  # maxes head_epoch with ha.epoch
+        self.head_epoch += 1  # the successor epoch: strictly above anything seen
+        self.ha_role = "active"
+        self._restored = True  # suppress prestart; re-adopt live survivors
+        self.stats["ha_promotions"] = self.stats.get("ha_promotions", 0) + 1
+        conn, self._ha_active_conn = self._ha_active_conn, None
+        if conn is not None and not conn.closed:
+            try:
+                await conn.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+        # re-anchor liveness: the restored tables carry the OLD head's view;
+        # survivors get the same reconnect grace a snapshot restart gives
+        now = time.monotonic()
+        for node in self.nodes.values():
+            node.last_heartbeat = now
+        for w in self.workers.values():
+            w.last_heartbeat = now
+        # claim the discovery files: session-dir drivers and head.addr
+        # readers now find THIS head
+        sock = os.path.join(self.session_dir, "head.sock")
+        try:
+            os.unlink(sock)
+        except OSError:
+            pass
+        try:
+            self._sock_server = Server([sock], self._handle, self._on_disconnect)
+            await self._sock_server.start()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._log_event("ha_promote_sock_failed", error=repr(e))
+            self._sock_server = None
+        addr_file = os.path.join(self.session_dir, "head.addr")
+        with open(addr_file + ".tmp", "w") as f:
+            f.write(self.tcp_addr or "")
+        os.replace(addr_file + ".tmp", addr_file)
+        ready = os.path.join(self.session_dir, "head.ready")
+        with open(ready + ".tmp", "w") as f:
+            f.write(str(os.getpid()))
+        os.replace(ready + ".tmp", ready)
+        self._ckpt_path = os.path.join(self.session_dir, "head.ckpt")
+        self._dirty = True
+        try:
+            self._save_snapshot()
+        except Exception as e:
+            self._log_event("snapshot_save_failed", error=repr(e))
+        self._ha_start_active_loops()
+        self._log_event(
+            "ha_promote", epoch=self.head_epoch, reason=reason,
+            watermark=self._ha_watermark, nodes=len(self.nodes),
+            workers=len(self.workers),
+        )
+        return self._ha_status_dict()
+
+    async def _h_head_promote(self, state, msg, reply, reply_err):
+        try:
+            reply(**(await self._ha_promote(reason=msg.get("reason") or "rpc")))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            reply_err(e)
+
+    def _ha_demote(self, observed: Optional[int], via: str) -> None:
+        """Active -> demoted: a successor epoch exists, so every table here
+        is a zombie's view.  Stop persisting/streaming, drop all clients so
+        nothing keeps talking to this registry, and exit shortly — the
+        successor owns the workers and the shm namespace now."""
+        if self.ha_role == "demoted":
+            return
+        was = self.ha_role
+        self.ha_role = "demoted"
+        if observed:
+            self._ha_observed_epoch = max(self._ha_observed_epoch, observed)
+        self.stats["ha_demotions"] = self.stats.get("ha_demotions", 0) + 1
+        self._log_event(
+            "ha_demote", epoch=self.head_epoch,
+            observed=observed or self._ha_observed_epoch, via=via, was=was,
+        )
+        for st in list(self._clients.values()):
+            try:
+                fence_close(st["writer"])
+            except Exception:
+                pass
+        spawn_bg(self._ha_demote_exit())
+
+    async def _ha_demote_exit(self):
+        # small grace so refusal replies flush before the process exits
+        await asyncio.sleep(0.5)
+        self._shutdown.set()
+
+    async def _ha_boot_probe(self) -> bool:
+        """A restarting head checks whether head.addr now names a DIFFERENT
+        live head before claiming authority: if that head answers with an
+        epoch >= ours, THIS process is the stale one — demote at boot
+        instead of split-braining the registry.  True = demoted."""
+        if not bool(getattr(self.config, "ha_boot_probe", True)):
+            return False
+        try:
+            other = open(
+                os.path.join(self.session_dir, "head.addr")
+            ).read().strip()
+        except OSError:
+            return False
+        if not other or other == self.tcp_addr:
+            return False
+        from ..util.aio import dial
+
+        from ..util.aio import finally_await
+
+        try:
+            conn = await dial(other, purpose="head (boot probe)", timeout=2.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return False  # unreachable: nothing live to defer to
+        try:
+            st = await conn.call("ha_status", timeout=2.0)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return False
+        finally:
+            await finally_await(conn.close(), "boot-probe close")
+        ep = int(st.get("epoch") or 0)
+        if st.get("role") == "active" and ep >= self.head_epoch:
+            self._ha_demote(ep, via="boot_probe")
+            return True
+        return False
+
+    def _ha_start_active_loops(self) -> None:
+        from ..util.aio import spawn_logged
+
+        if self._ha_loops_started:
+            return
+        self._ha_loops_started = True
+        self._ha_tasks = [
+            spawn_logged(self._monitor_loop(), "head-monitor"),
+            spawn_logged(self._persist_loop(), "head-persist"),
+            spawn_logged(self._log_tail_loop(), "head-log-tail"),
+            spawn_logged(self._loop_lag_loop(), "head-loop-lag"),
+        ]
 
     # ---------------------------------------------------------------- utils
     def _pub(self, channel: str, data: dict):
@@ -1609,6 +2248,9 @@ class Head:
                 node.addr, purpose=f"agent {node.node_id}",
                 peer_node=node.node_id,
             )
+            # head->agent calls carry the authority epoch: after a failover
+            # the agent fences any call still arriving from the OLD head
+            node.conn.stamp = {"hep": self.head_epoch}
         except asyncio.CancelledError:
             raise  # head shutdown: must not declare the node dead
         except Exception as e:
@@ -2181,6 +2823,8 @@ class Head:
             "metrics_snapshot", "autoscaler_state", "list_pgs", "pg_wait",
             "get_actor", "task_events", "metrics_report", "flightrec",
             "log_sub", "log_batch", "log_fetch", "timeseries", "profile",
+            "ha_status", "head_replicate", "head_replicate_ack",
+            "head_promote",
         }
     )
 
@@ -2259,6 +2903,25 @@ class Head:
         if h is None:
             reply_err(ValueError(f"unknown head method {m}"))
             return
+        # head-epoch authority gate (HA plane) — the node-incarnation fence
+        # below, generalized to the head itself.  Ordering matters: learn of
+        # a successor (demote) BEFORE refusing anything, and refuse
+        # non-active roles BEFORE stale-stamp clients, so a standby/zombie
+        # never executes an authority-bearing handler.
+        hep = msg.get("hep")
+        if hep is not None and hep > self.head_epoch:
+            # a peer proves a successor head was promoted past us: THIS
+            # process is the zombie — demote before touching any table
+            self._ha_demote(hep, via=f"rpc:{m}")
+        if self.ha_role != "active" and m not in self._HA_PASSIVE_METHODS:
+            self._ha_refuse(state, msg, reply_err)
+            return
+        if hep is not None and hep < self.head_epoch and m != "register":
+            # an RPC stamped under a superseded head epoch: make the sender
+            # re-register (adopting the current epoch) before any
+            # authority-bearing side effect can land
+            self._ha_refuse(state, msg, reply_err, stale_client=True)
+            return
         # incarnation fence: authority-bearing RPCs from workers/agents are
         # stamped with their node's incarnation (Connection.stamp / agent
         # fields); a stamp that no longer matches the node table means the
@@ -2276,6 +2939,7 @@ class Head:
         self.rpc_counts[m] += 1
         if m not in self._READONLY_METHODS:
             self._dirty = True  # persisted by the debounced snapshot loop
+            self._repl_dirty = True  # replicated by the next HA delta tick
         tk = self._method_tags_key(m)
         self._dispatch_inflight += 1
         self._self_hist_observe(
@@ -2287,6 +2951,14 @@ class Head:
         t0 = time.perf_counter()
         try:
             await h(state, msg, reply, reply_err)
+        except FencedError as e:
+            # one of OUR outbound calls (made from inside the handler) was
+            # epoch-fenced by an agent or successor head: a newer authority
+            # exists somewhere — demote instead of retrying as a zombie.
+            # Incarnation fences (node-scoped) pass through untouched.
+            if "head epoch" in str(e):
+                self._ha_demote(None, via=f"handler:{m}")
+            reply_err(e)
         finally:
             self._dispatch_inflight -= 1
             self._self_hist_observe(
@@ -2404,6 +3076,8 @@ class Head:
             session=self.session_name,
             resources=self._agg_total(),
             head_tcp=self.tcp_addr,
+            head_epoch=self.head_epoch,
+            standbys=self._ha_standby_addrs(),
             **extra,
         )
         # late joiners learn about in-progress drains (their retries on those
@@ -2461,6 +3135,8 @@ class Head:
                 reply(
                     node_id=node_id, session=self.session_name,
                     head_tcp=self.tcp_addr, incarnation=existing.incarnation,
+                    head_epoch=self.head_epoch,
+                    standbys=self._ha_standby_addrs(),
                 )
                 self._service_queue()
                 return
@@ -2514,7 +3190,9 @@ class Head:
             extra["net_chaos_epoch"] = self._net_chaos_epoch
         reply(
             node_id=node_id, session=self.session_name,
-            head_tcp=self.tcp_addr, incarnation=inc, **extra,
+            head_tcp=self.tcp_addr, incarnation=inc,
+            head_epoch=self.head_epoch, standbys=self._ha_standby_addrs(),
+            **extra,
         )
         self._service_queue()
 
@@ -2838,6 +3516,15 @@ class Head:
         exists = msg["key"] in ns
         if not (msg.get("overwrite", True) is False and exists):
             ns[msg["key"]] = msg["value"]
+            if self._repl_subs:
+                # acked-commit guarantee: the reply below IS the ack the
+                # client keys side effects off, so the commit must be
+                # standby-resident (synchronously replicated) first
+                await self._repl_commit(
+                    {"t": "kv", "op": "put", "ns": msg.get("ns", ""),
+                     "key": msg["key"], "value": msg["value"],
+                     "overwrite": msg.get("overwrite", True)}
+                )
         reply(added=not exists)
 
     async def _h_kv_get(self, state, msg, reply, reply_err):
@@ -2853,6 +3540,10 @@ class Head:
             # (collectives) would otherwise leave O(ops) empty dicts in
             # the KV and in every debounced snapshot
             del self.kv[ns_name]
+        if deleted and self._repl_subs:
+            await self._repl_commit(
+                {"t": "kv", "op": "del", "ns": ns_name, "key": msg["key"]}
+            )
         reply(deleted=deleted)
 
     async def _h_kv_keys(self, state, msg, reply, reply_err):
@@ -4205,6 +4896,9 @@ class Head:
         self._clients.pop(cid, None)
         self.client_addrs.pop(cid, None)  # p2p dials now fall back to head
         self._log_subs.pop(cid, None)  # departed drivers stop receiving logs
+        if cid in self._repl_subs:
+            # a departed standby must not gate sync commits
+            self._repl_drop_sub(cid, "disconnect")
         if state.get("role") == "agent":
             node = self.nodes.get(state.get("node_id"))
             if node is not None:
@@ -4377,6 +5071,28 @@ class Head:
                     self._timeseries_tick(time.time())
                 except Exception:
                     pass  # retention must never take down the monitor
+            # HA observability: the epoch gauge is always live; replication
+            # lag (records the slowest standby hasn't acked) gauges + a
+            # throttled flight-recorder event while standbys are subscribed
+            self._self_gauge_set(
+                "ca_head_ha_epoch", "current head authority epoch",
+                float(self.head_epoch),
+            )
+            if self._repl_subs:
+                lag = self._repl_seq - min(
+                    s["acked"] for s in self._repl_subs.values()
+                )
+                self._self_gauge_set(
+                    "ca_head_ha_repl_lag",
+                    "replication records not yet acked by the slowest standby",
+                    float(lag),
+                )
+                if now - self._repl_last_lag_event > 10.0:
+                    self._repl_last_lag_event = now
+                    self._log_event(
+                        "ha_replicate_lag", lag=lag, seq=self._repl_seq,
+                        standbys=len(self._repl_subs),
+                    )
             for rec in list(self.workers.values()):
                 if rec.state == "dead":
                     continue
@@ -4523,15 +5239,39 @@ class Head:
             self._kill_worker_rec(victim)
 
     async def run(self):
-        try:
-            os.unlink(self.sock_path)  # stale socket from a killed head
-        except FileNotFoundError:
-            pass
+        if not self._ha_sock_deferred:
+            try:
+                os.unlink(self.sock_path)  # stale socket from a killed head
+            except FileNotFoundError:
+                pass
         await self.server.start()
         # advertise the TCP endpoint for agents / cross-host clients
         for a in self.server.bound_addrs:
             if a.startswith("tcp:"):
                 self.tcp_addr = a
+        if self.ha_role == "standby":
+            await self._run_standby()
+            return
+        if self._restored and await self._ha_boot_probe():
+            # a successor head owns this session: stay demoted (refusing
+            # everything) until the demote-exit grace fires.  head.addr is
+            # left alone — it names the real head.
+            await self._shutdown.wait()
+            await self._teardown()
+            return
+        if self._ha_sock_deferred:
+            # the probe found no live authority behind head.addr: this head
+            # IS the cluster again — claim the session socket like a
+            # promotion does
+            try:
+                os.unlink(self.sock_path)
+            except OSError:
+                pass
+            self._sock_server = Server(
+                [self.sock_path], self._handle, self._on_disconnect
+            )
+            await self._sock_server.start()
+            self._ha_sock_deferred = False
         with open(os.path.join(self.session_dir, "head.addr"), "w") as f:
             f.write(self.tcp_addr or "")
         # prestart one worker per CPU (worker_pool.h prestart behavior);
@@ -4571,12 +5311,7 @@ class Head:
         # named + exception-logged: a dead monitor/persist loop is a head
         # that stops detecting node death or persisting state — it must
         # warn the moment it dies, not at GC time
-        from ..util.aio import spawn_logged
-
-        monitor = spawn_logged(self._monitor_loop(), "head-monitor")
-        persister = spawn_logged(self._persist_loop(), "head-persist")
-        log_tail = spawn_logged(self._log_tail_loop(), "head-log-tail")
-        loop_lag = spawn_logged(self._loop_lag_loop(), "head-loop-lag")
+        self._ha_start_active_loops()
         # readiness marker for the driver — atomic rename: a reader must
         # never observe the file existing but empty (the pid parse treats
         # that as a dead cluster and refuses to connect)
@@ -4585,15 +5320,51 @@ class Head:
             f.write(str(os.getpid()))
         os.replace(ready_path + ".tmp", ready_path)
         await self._shutdown.wait()
-        monitor.cancel()
-        persister.cancel()
-        log_tail.cancel()
-        loop_lag.cancel()
+        for t in self._ha_tasks:
+            t.cancel()
         if self.dashboard is not None:
             await self.dashboard.stop()
         await self._teardown()
 
+    async def _run_standby(self):
+        """Warm-standby service loop: advertise the rank-suffixed discovery
+        files, run the subscribe/apply FSM, and — on promotion — continue
+        as the active head (the standby loop already started the active
+        loops and claimed the session files)."""
+        from ..util.aio import spawn_logged
+
+        addr_file = os.path.join(
+            self.session_dir, f"head.standby{self.ha_rank}.addr"
+        )
+        with open(addr_file + ".tmp", "w") as f:
+            f.write(self.tcp_addr or "")
+        os.replace(addr_file + ".tmp", addr_file)
+        standby_task = spawn_logged(
+            self._ha_standby_loop(), f"head-standby{self.ha_rank}"
+        )
+        ready = os.path.join(
+            self.session_dir, f"head.standby{self.ha_rank}.ready"
+        )
+        with open(ready + ".tmp", "w") as f:
+            f.write(str(os.getpid()))
+        os.replace(ready + ".tmp", ready)
+        await self._shutdown.wait()
+        standby_task.cancel()
+        for t in self._ha_tasks:
+            t.cancel()
+        if self._ha_replog is not None:
+            self._ha_replog.close()
+        await self._teardown()
+
     async def _teardown(self):
+        if self.ha_role != "active":
+            # a never-promoted standby or a fenced zombie owns NOTHING of
+            # the session (workers, shm namespace, discovery files all
+            # belong to the active head): just release the sockets
+            if self._sock_server is not None:
+                await self._sock_server.stop()
+            await self.server.stop()
+            return
         for node in self.nodes.values():
             if node.conn is not None and not node.conn.closed:
                 try:
@@ -4613,6 +5384,8 @@ class Head:
                     os.kill(rec.pid, signal.SIGKILL)
                 except ProcessLookupError:
                     pass
+        if self._sock_server is not None:
+            await self._sock_server.stop()
         await self.server.stop()
         # GC all shm segments of this session (local host; agents clean their
         # own namespaces on shutdown)

@@ -40,7 +40,7 @@ from .config import CAConfig, set_config
 from .errors import FencedError
 from .head import read_shm_chunk
 from .ownership import DeltaReporter, quantize_load
-from .protocol import Server, spawn_bg
+from .protocol import AddrRing, Server, addr_list, spawn_bg
 
 
 def node_load_sample() -> Dict[str, float]:
@@ -185,7 +185,11 @@ class NodeAgent:
     def __init__(self):
         self.session_dir = os.environ["CA_SESSION_DIR"]
         self.session_name = os.path.basename(self.session_dir)
-        self.head_addr = os.environ["CA_HEAD_ADDR"]
+        # CA_HEAD_ADDR may be a comma-separated list (active head first,
+        # warm standbys after): the ring rotates through candidates on
+        # failover, and register replies merge in standbys learned later
+        self._head_ring = AddrRing(addr_list(os.environ["CA_HEAD_ADDR"]))
+        self.head_addr = self._head_ring.current or os.environ["CA_HEAD_ADDR"]
         self.node_id = os.environ["CA_NODE_ID"]
         import json
 
@@ -241,6 +245,13 @@ class NodeAgent:
         # believed.  None = not yet registered / purged for a fresh rejoin.
         self.incarnation: Optional[int] = None
         self._fencing = False  # single-flight guard for _fence_reset
+        # HA plane: highest head epoch this agent has observed (register
+        # replies and hep-stamped head RPCs).  A call stamped with a LOWER
+        # epoch comes from a superseded head (a zombie that healed from a
+        # partition still believing it owns the cluster): refuse it with
+        # FencedError — the refusal is how the old head learns to demote.
+        self.head_epoch = 0
+        self.ha_zombie_rpcs = 0  # fenced old-head calls (chaos test hook)
         # network-chaos plane: partition/straggler injection from the spec
         # this process was started with (runtime `ca chaos set` broadcasts
         # arrive as net_chaos pushes)
@@ -279,7 +290,13 @@ class NodeAgent:
     def _spawn_worker(self, wid: str, purpose: str, pool: str) -> None:
         env = dict(os.environ)
         env["CA_SESSION_DIR"] = self.session_dir
-        env["CA_HEAD_SOCK"] = self.head_addr  # workers dial the head over TCP
+        # workers dial the head over TCP; they inherit the whole head ring
+        # (live active first) so a worker spawned pre-failover can re-anchor
+        # to a promoted standby it never registered with
+        ring = list(self._head_ring.addrs)
+        if self.head_addr in ring:
+            ring.remove(self.head_addr)
+        env["CA_HEAD_SOCK"] = ",".join([self.head_addr] + ring)
         env["CA_WORKER_ID"] = wid
         env["CA_WORKER_SOCK"] = "tcp:127.0.0.1:0"  # bind ephemeral, advertise
         env["CA_NODE_ID"] = self.node_id
@@ -323,6 +340,28 @@ class NodeAgent:
 
     async def _handle(self, state, msg, reply, reply_err):
         m = msg["m"]
+        hep = msg.get("hep")
+        if hep is not None:
+            if hep > self.head_epoch:
+                self.head_epoch = hep
+            elif hep < self.head_epoch:
+                # a superseded head's RPC (zombie authority): refuse and tell
+                # it WHY — the "head epoch" marker in the message is the old
+                # head's demote trigger.  Never execute the body: spawns and
+                # kills from a fenced head are duplicate side effects.
+                self.ha_zombie_rpcs += 1
+                from ..util import flightrec
+
+                if flightrec.REC is not None:
+                    flightrec.REC.record(
+                        "ha", "ha_fence_old_head",
+                        method=m, offered=hep, known=self.head_epoch,
+                    )
+                reply_err(FencedError(
+                    f"call stamped by superseded head epoch {hep} "
+                    f"(current head epoch: {self.head_epoch})"
+                ))
+                return
         if m == "lease_grant":
             # node-local grant (hot path): a dict move, no head round-trip.
             # An exhausted block replies granted=False — the submitter falls
@@ -501,9 +540,19 @@ class NodeAgent:
             if msg.get("ninc") is None or msg.get("ninc") == self.incarnation:
                 spawn_bg(self._fence_reset())
             reply()
+        elif m == "ha_ring":
+            # runtime standby-ring dissemination (HA plane): an agent that
+            # registered before any standby subscribed learns failover
+            # targets here, not just via its register reply
+            self._head_ring.merge(msg.get("standbys") or [])
+            ep = msg.get("head_epoch")
+            if ep is not None and ep > self.head_epoch:
+                self.head_epoch = ep
+            reply()
         # operator liveness probe: ca-lint: ignore[rpc-dead-handler]
         elif m == "ping":
-            reply(node_id=self.node_id, n_workers=len(self.procs))
+            reply(node_id=self.node_id, n_workers=len(self.procs),
+                  head_epoch=self.head_epoch)
         else:
             reply_err(ValueError(f"unknown agent method {m}"))
 
@@ -631,9 +680,13 @@ class NodeAgent:
     def _auth(self, fields: Dict[str, Any]) -> Dict[str, Any]:
         """Stamp an authority-bearing head notify with this node's
         incarnation (fencing: a stale stamp is refused, and the refusal is
-        how a healed zombie learns its death verdict)."""
+        how a healed zombie learns its death verdict).  The head epoch rides
+        beside it: a demoted head that still answers this node's RPCs sees
+        its successor's epoch and learns the same verdict in reverse."""
         if self.incarnation is not None:
             fields["ninc"] = self.incarnation
+        if self.head_epoch:
+            fields["hep"] = self.head_epoch
         return fields
 
     async def _heartbeat_loop(self):
@@ -856,9 +909,15 @@ class NodeAgent:
 
     def _adopt_register_reply(self, reply: dict) -> None:
         """Take the head-minted incarnation (the authority token every
-        stamped RPC carries) and any active runtime chaos schedule."""
+        stamped RPC carries), the head epoch and standby list (HA plane),
+        and any active runtime chaos schedule."""
         if reply.get("incarnation") is not None:
             self.incarnation = reply["incarnation"]
+        ep = reply.get("head_epoch")
+        if ep is not None:
+            self.head_epoch = max(self.head_epoch, int(ep))
+        if reply.get("standbys"):
+            self._head_ring.merge(reply["standbys"])
         if reply.get("net_chaos"):
             try:
                 netchaos.install(
@@ -978,8 +1037,13 @@ class NodeAgent:
             try:
                 from ..util.aio import dial  # lazy: util/__init__ → core
 
+                # walk the head ring: after a failover the successor standby
+                # answers on a different addr than the dead active
+                addr = self._head_ring.current or self.head_addr
+                netchaos.register_addr(addr, "n0")
                 conn = await dial(
-                    self.head_addr, purpose="head", timeout=5, peer_node="n0"
+                    addr, purpose="head",
+                    timeout=self.config.dial_timeout_s, peer_node="n0",
                 )
                 conn.set_push_handler(self._on_head_push)
                 fields = {
@@ -1005,6 +1069,24 @@ class NodeAgent:
                     timeout=5,
                     **fields,
                 )
+                offered = reg_reply.get("head_epoch")
+                if (offered is not None and self.head_epoch
+                        and int(offered) < self.head_epoch):
+                    # a resurrected OLD head answered here: re-anchoring to
+                    # it would split the cluster — rotate toward the
+                    # successor instead (the zombie demotes on its own once
+                    # it sees the higher epoch on stamped traffic)
+                    from ..util import flightrec
+
+                    if flightrec.REC is not None:
+                        flightrec.REC.record(
+                            "ha", "ha_fence_old_head",
+                            method="register", offered=int(offered),
+                            known=self.head_epoch,
+                        )
+                    await conn.close()
+                    self._head_ring.rotate()
+                    continue
                 # the restarted head has no delta state for this node: the
                 # next node_sync must be a full resync.  Reset BEFORE
                 # adopting the connection so a failure here still closes
@@ -1012,6 +1094,11 @@ class NodeAgent:
                 self.reporter.reset()
                 self._adopt_register_reply(reg_reply)
                 self.head = conn
+                # _watch_head is the sole writer of head_addr; `addr` is the
+                # ring slot THIS register round-trip succeeded against, so a
+                # concurrent ring merge must not retarget the assignment:
+                # ca-lint: ignore[async-await-race]
+                self.head_addr = addr
                 down_since = None
             except asyncio.CancelledError:
                 if conn is not None:
@@ -1030,6 +1117,10 @@ class NodeAgent:
                     # registering failed: a leaked half-open socket per retry
                     # tick adds up fast while the head flaps
                     await conn.close()
+                # this candidate is dead or refusing: try the next head in
+                # the ring on the following attempt (single-head rings are a
+                # no-op rotate)
+                self._head_ring.rotate()
                 # jittered: N agents redialing a restarted head must not
                 # arrive as one synchronized thundering herd
                 await asyncio.sleep(0.3 + random.random() * 0.4)

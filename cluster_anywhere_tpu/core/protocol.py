@@ -461,9 +461,10 @@ class Connection:
         self._closed = False
         self._on_push: Optional[Callable[[dict], Awaitable[None]]] = None
         # authority stamp: fields merged into every outgoing request/notify
-        # (worker processes set {"inc": <node incarnation>} after register,
-        # so the head can fence RPCs minted under a dead incarnation).
-        # Drivers never stamp; the template fast path is driver-only.
+        # (worker processes set {"ninc": <node incarnation>, "hep": <head
+        # epoch>} after register, so the head can fence RPCs minted under a
+        # dead incarnation — and agents can fence calls from a superseded
+        # head).  Drivers never stamp; the template fast path is driver-only.
         self.stamp: Optional[dict] = None
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
@@ -615,6 +616,56 @@ class Connection:
     @property
     def closed(self) -> bool:
         return self._closed
+
+
+def addr_list(spec) -> list:
+    """Split a comma-separated address list (CA_HEAD_ADDR / CA_HEAD_SOCK may
+    name the active head plus its warm standbys)."""
+    return [a.strip() for a in (spec or "").split(",") if a.strip()]
+
+
+class AddrRing:
+    """Head-address rotation for HA failover: dialers walk the ring on
+    connect failure (active head first, then each standby) and merge the
+    `standbys` list every register reply carries, so a client started with
+    one address still learns every promotion candidate."""
+
+    def __init__(self, addrs):
+        self._addrs: list = []
+        self._i = 0
+        self.merge(addrs)
+
+    def merge(self, addrs) -> int:
+        """Append unseen addresses (order preserved); returns # added."""
+        added = 0
+        for a in addrs or ():
+            if a and a not in self._addrs:
+                self._addrs.append(a)
+                added += 1
+        return added
+
+    @property
+    def addrs(self) -> list:
+        return list(self._addrs)
+
+    @property
+    def current(self):
+        return self._addrs[self._i % len(self._addrs)] if self._addrs else None
+
+    def rotate(self):
+        """Advance to the next candidate (after a dial/register failure)."""
+        if self._addrs:
+            self._i = (self._i + 1) % len(self._addrs)
+        return self.current
+
+    def promote(self, addr: str) -> None:
+        """Make `addr` the ring's current pick (a successful connect)."""
+        if addr not in self._addrs:
+            self._addrs.append(addr)
+        self._i = self._addrs.index(addr)
+
+    def __len__(self) -> int:
+        return len(self._addrs)
 
 
 def parse_addr(addr: str):

@@ -43,8 +43,10 @@ from .object_store import MemoryStore, ShmObjectStore, _Entry
 from .protocol import (
     TRACE_FIELD,
     WIRE_STATS,
+    AddrRing,
     Connection,
     MsgTemplate,
+    addr_list,
     spawn_bg,
 )
 from .ownership import OWNER_STATS, OwnerLedger
@@ -148,6 +150,14 @@ def _redial_backoff(attempt: int, rng: Optional[random.Random] = None) -> float:
     arriving as one synchronized storm."""
     base = min(0.25 * (2 ** max(0, min(attempt - 1, 4))), 4.0)
     return base * (0.5 + (rng or random).random())
+
+
+def _head_epoch_regressed(known: int, offered: Optional[int]) -> bool:
+    """True when a register reply proves the answering head is a superseded
+    zombie: it offers an authority epoch strictly below one this process
+    already adopted from a successor.  Clients refuse such a head (close,
+    rotate to the next ring address) instead of handing it their state."""
+    return bool(known) and offered is not None and int(offered) < known
 
 
 def global_worker() -> "Worker":
@@ -441,7 +451,9 @@ class LeasePool:
                     self.requests_outstanding -= 1
                     self._fail_waiters(ConnectionError("cluster head unreachable"))
                     return
-                await asyncio.sleep(0.5)
+                # jittered like every other head redial: a failover must not
+                # turn N waiting lease pools into a synchronized retry storm
+                await asyncio.sleep(_redial_backoff(attempts))
                 continue
             except asyncio.CancelledError:
                 raise  # shutdown: don't convert cancellation into waiter errors
@@ -678,7 +690,13 @@ class Worker:
         self.mode = mode  # "driver" | "worker"
         self.session_dir = session_dir
         self.session_name = os.path.basename(session_dir)
-        self.head_sock = head_sock
+        # HA plane: head_sock may be a comma-separated ring (active head
+        # first, then warm standbys).  Failed dials rotate through it; the
+        # standbys list on every register reply merges in, so a client
+        # started with one address still learns every promotion candidate.
+        self._head_ring = AddrRing(addr_list(head_sock))
+        self.head_sock = self._head_ring.current or head_sock
+        self.head_epoch = 0  # highest head authority epoch observed
         self.config = config or get_config()
         self.client_id = client_id or f"{mode}-{os.getpid()}-{os.urandom(3).hex()}"
         self.serve_addr = serve_addr
@@ -953,10 +971,7 @@ class Worker:
             # server (core_worker.h); without one, every driver-owned ref
             # resolution would fall back to polling the head
             await self._start_p2p_server()
-        from ..util.aio import dial  # lazy: util/__init__ reaches into core
-
-        netchaos.register_addr(self.head_sock, "n0")
-        self.head = await dial(self.head_sock, purpose="head", peer_node="n0")
+        self.head = await self._dial_head()
         self.head.set_push_handler(self._on_push)
         reply = await self.head.call(
             "register",
@@ -973,18 +988,54 @@ class Worker:
         self._maybe_log_sub(self.head)
         self._housekeeping_task = spawn_bg(self._housekeeping())
 
+    async def _dial_head(self) -> Connection:
+        """Dial the head address ring: each candidate once, starting at the
+        current pick, rotating on failure.  Raises the last error when every
+        candidate is down (callers treat that as 'head still restarting')."""
+        from ..util.aio import dial  # lazy: util/__init__ reaches into core
+
+        last: Optional[BaseException] = None
+        for _ in range(max(1, len(self._head_ring))):
+            addr = self._head_ring.current or self.head_sock
+            netchaos.register_addr(addr, "n0")
+            try:
+                conn = await dial(addr, purpose="head", peer_node="n0")
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                last = e
+                self._head_ring.rotate()
+                continue
+            # `addr` is the ring slot this dial succeeded against; a ring
+            # merge landing during the dial must not retarget it:
+            # ca-lint: ignore[async-await-race]
+            self.head_sock = addr
+            return conn
+        raise last if last is not None else ConnectionError("no head address")
+
     def _adopt_register_reply(self, reply: dict) -> None:
         """Post-register adoption: worker processes stamp their node's
-        incarnation onto every head RPC (the fencing token — a stale stamp
-        after a partition verdict is refused, which is how zombie tasks are
-        stopped before they commit duplicate side effects), and any active
-        runtime chaos schedule is installed locally."""
+        incarnation AND the head authority epoch onto every head RPC (the
+        fencing tokens — a stale ninc after a partition verdict, or a stale
+        hep after a head failover, is refused before side effects land), and
+        any active runtime chaos schedule is installed locally."""
+        ep = reply.get("head_epoch")
+        if ep is not None:
+            self.head_epoch = max(self.head_epoch, int(ep))
+        if reply.get("standbys"):
+            # learn every promotion candidate for the next failover
+            self._head_ring.merge(reply["standbys"])
         if self.mode == "worker":
             # set OR clear: a reply without node_inc (snapshotless head
             # restart racing the agent's rejoin) must not leave any prior
             # stamp semantics ambiguous on the fresh connection
             ni = reply.get("node_inc")
-            self.head.stamp = {"ninc": ni} if ni is not None else None
+            stamp = {}
+            if ni is not None:
+                stamp["ninc"] = ni
+            if ep is not None:
+                stamp["hep"] = int(ep)
+            self.head.stamp = stamp or None
         if reply.get("net_chaos"):
             try:
                 netchaos.install(
@@ -1042,6 +1093,14 @@ class Worker:
                 )
             except (ValueError, TypeError):
                 pass
+            return
+        if msg.get("m") == "ha_ring":
+            # runtime standby-ring dissemination (HA plane): learn failover
+            # targets that subscribed after this worker registered
+            self._head_ring.merge(msg.get("standbys") or [])
+            ep = msg.get("head_epoch")
+            if ep is not None and int(ep) > self.head_epoch:
+                self.head_epoch = int(ep)
             return
         if msg.get("m") == "owner_refs":
             # the head settling against THIS owner's ledger: releasing a
@@ -1347,13 +1406,27 @@ class Worker:
 
     async def _reconnect_head(self) -> bool:
         """Redial and re-register with the head (gcs_client_reconnection
-        analogue).  Sets _head_fenced if the head refuses us (it declared
-        this worker dead — the process must exit, not retry)."""
-        from ..util.aio import dial  # lazy: util/__init__ reaches into core
-
+        analogue), walking the HA address ring on failure.  Sets
+        _head_fenced if the head refuses us (it declared this worker dead —
+        the process must exit, not retry)."""
+        if not self.client_mode:
+            # failover: a promoted standby rewrites the session's head.addr
+            # — fold the current occupant into the ring before dialing, so
+            # even a client configured with only the dead head's address
+            # finds the successor
+            try:
+                cur = open(
+                    os.path.join(self.session_dir, "head.addr")
+                ).read().strip()
+                if cur:
+                    self._head_ring.merge([cur])
+            except OSError:
+                pass
         try:
-            conn = await dial(self.head_sock, purpose="head", peer_node="n0")
-        except OSError:
+            conn = await self._dial_head()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
             return False
         conn.set_push_handler(self._on_push)
         try:
@@ -1383,6 +1456,25 @@ class Worker:
             await conn.close()  # before anything that could raise (str(e) can)
             if "declared dead" in str(e):
                 self._fence_now()
+            else:
+                # a standby's refusal (or any other register failure): try
+                # the next ring candidate on the following tick
+                self._head_ring.rotate()
+            return False
+        if _head_epoch_regressed(self.head_epoch, reply.get("head_epoch")):
+            # a resurrected OLD head answered this redial: refuse it — we
+            # already adopted a successor's epoch, and handing this zombie
+            # our registration would fork the registry
+            from ..util import flightrec
+
+            if flightrec.REC is not None:
+                flightrec.REC.record(
+                    "ha", "ha_fence_old_head", client_id=self.client_id,
+                    offered=int(reply.get("head_epoch") or 0),
+                    known=self.head_epoch,
+                )
+            await conn.close()
+            self._head_ring.rotate()
             return False
         self.head = conn
         self._adopt_register_reply(reply)

@@ -367,6 +367,9 @@ class Dashboard:
                         n_objects=len(h.objects),
                         n_nodes=len(h._alive_nodes()),
                     ),
+                    # HA plane: role/epoch/replication state so the summary
+                    # answers "can this cluster lose its head right now?"
+                    "ha": h._ha_status_dict(),
                 }
             )
         if path == "/api/nodes":

@@ -323,6 +323,35 @@ def train_plane() -> Dict[str, Any]:
     return {"runs": runs, "counters": counters}
 
 
+def ha_plane() -> Dict[str, Any]:
+    """HA-plane summary straight from the active head: role, head epoch,
+    replication seq, subscribed standbys (addr/rank/acked watermark),
+    replication lag (records the slowest standby hasn't acked), and the
+    failover counters (promotions, demotions, fenced zombie RPCs, sync-
+    commit timeouts) — the one-call answer to 'can this cluster lose its
+    head right now?'."""
+    r = _head("ha_status")
+    stats = {}
+    try:
+        stats = _head("stats")["stats"]
+    except Exception:
+        pass
+    return {
+        "role": r.get("role"),
+        "epoch": r.get("epoch"),
+        "seq": r.get("seq"),
+        "addr": r.get("addr"),
+        "standbys": r.get("standbys") or [],
+        "repl_lag": r.get("repl_lag"),
+        "promotions": stats.get("ha_promotions", 0),
+        "demotions": stats.get("ha_demotions", 0),
+        "standbys_lost": stats.get("ha_standbys_lost", 0),
+        "sync_commit_timeouts": stats.get("ha_sync_commit_timeouts", 0),
+        "records_streamed": stats.get("ha_records_streamed", 0),
+        "refused_rpcs": stats.get("ha_refused_rpcs", 0),
+    }
+
+
 def timeseries(
     names: Optional[List[str]] = None,
     *,
@@ -702,6 +731,7 @@ __all__ = [
     "summarize_objects",
     "lease_plane",
     "owner_plane",
+    "ha_plane",
     "metrics_plane",
     "timeseries",
     "profile",
